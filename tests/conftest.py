@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-device sharding semantics (the analog of the reference's
+gloo-on-one-box trick, test_utils.py:205-238) are exercised without TPU pods
+by asking XLA's host platform for 8 virtual devices. Must run before jax
+initializes a backend, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,183 @@
+"""Manifest schema: YAML/JSON round-trips and per-rank availability rules.
+
+Structural model: reference tests/test_manifest.py:244-331.
+"""
+
+import copy
+
+import pytest
+
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_manifest_for_rank,
+    is_container_entry,
+    is_replicated,
+)
+
+
+def _array(location: str, replicated: bool = False, byte_range=None) -> ArrayEntry:
+    return ArrayEntry(
+        location=location,
+        serializer="buffer_protocol",
+        dtype="float32",
+        shape=[4, 4],
+        replicated=replicated,
+        byte_range=byte_range,
+    )
+
+
+def _sample_metadata() -> SnapshotMetadata:
+    manifest = {
+        "0/model": DictEntry(keys=["weight", "bias", "stats", "step", "lr", "name"]),
+        "0/model/weight": _array("replicated/model/weight", replicated=True),
+        "0/model/bias": _array("0/model/bias"),
+        "0/model/stats": ObjectEntry(
+            location="0/model/stats",
+            serializer="pickle",
+            obj_type="dict",
+            replicated=False,
+        ),
+        "0/model/step": PrimitiveEntry.from_object(123),
+        "0/model/lr": PrimitiveEntry.from_object(0.1),
+        "0/model/name": PrimitiveEntry.from_object("net"),
+        "0/sharded": ShardedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            shards=[Shard(offsets=[0, 0], sizes=[4, 4], array=_array("sharded/s/0"))],
+        ),
+        "0/big": ChunkedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            chunks=[
+                Shard(offsets=[0, 0], sizes=[4, 4], array=_array("0/big/chunk_0")),
+                Shard(offsets=[4, 0], sizes=[4, 4], array=_array("0/big/chunk_1")),
+            ],
+            replicated=False,
+        ),
+        "0/misc": ListEntry(),
+        "0/misc/0": PrimitiveEntry.from_object(True),
+        "0/od": OrderedDictEntry(keys=["k"]),
+        "0/od/k": PrimitiveEntry.from_object(b"\x00\x01"),
+        "1/model": DictEntry(keys=["weight", "bias"]),
+        "1/model/weight": _array("replicated/model/weight", replicated=True),
+        "1/model/bias": _array("1/model/bias"),
+        "1/sharded": ShardedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            shards=[Shard(offsets=[4, 0], sizes=[4, 4], array=_array("sharded/s/1"))],
+        ),
+    }
+    return SnapshotMetadata(version="0.1.0", world_size=2, manifest=manifest)
+
+
+def test_yaml_roundtrip() -> None:
+    md = _sample_metadata()
+    restored = SnapshotMetadata.from_yaml(md.to_yaml())
+    assert restored == md
+
+
+def test_json_stays_yaml_loadable() -> None:
+    """The huge-manifest escape hatch: JSON-emitted metadata must load
+    through the YAML path (reference invariant: tests/test_manifest.py:259-281).
+    """
+    md = _sample_metadata()
+    restored = SnapshotMetadata.from_yaml(md.to_json())
+    assert restored == md
+
+
+def test_primitive_values_exact() -> None:
+    for value in [0, -17, True, False, "str", b"\xff\x00", 0.1, 1e-300, -0.0]:
+        entry = PrimitiveEntry.from_object(value)
+        out = entry.get_value()
+        assert type(out) is type(value)
+        assert out == value or (value != value and out != out)
+    # float exactness through serialization
+    e = PrimitiveEntry.from_object(0.1)
+    restored = SnapshotMetadata(
+        version="0", world_size=1, manifest={"0/x": e}
+    ).to_yaml()
+    md = SnapshotMetadata.from_yaml(restored)
+    assert md.manifest["0/x"].get_value() == 0.1
+
+
+def test_unknown_entry_type_raises() -> None:
+    md_yaml = _sample_metadata().to_yaml().replace("type: Array", "type: Cube", 1)
+    with pytest.raises(ValueError):
+        SnapshotMetadata.from_yaml(md_yaml)
+
+
+def test_get_manifest_for_rank_rules() -> None:
+    md = _sample_metadata()
+    m0 = get_manifest_for_rank(md, 0)
+    m1 = get_manifest_for_rank(md, 1)
+
+    # Per-rank entries stay with their owner.
+    assert "model/bias" in m0 and m0["model/bias"].location == "0/model/bias"
+    assert "model/bias" in m1 and m1["model/bias"].location == "1/model/bias"
+    assert "big" in m0 and "big" not in m1
+    assert "misc" in m0 and "misc" not in m1
+
+    # Replicated entries are available everywhere.
+    assert m0["model/weight"].replicated and m1["model/weight"].replicated
+
+    # Sharded entries merge across ranks and are available everywhere.
+    for m in (m0, m1):
+        assert [s.offsets for s in m["sharded"].shards] == [[0, 0], [4, 0]]
+
+
+def test_get_manifest_for_rank_beyond_world_size() -> None:
+    """An elastic-restore rank > world_size still sees replicated + sharded
+    entries (with container chains), just not per-rank state."""
+    md = _sample_metadata()
+    m5 = get_manifest_for_rank(md, 5)
+    assert "model/weight" in m5
+    assert "model" in m5  # ancestor container grafted
+    assert "model/bias" not in m5
+    assert [s.offsets for s in m5["sharded"].shards] == [[0, 0], [4, 0]]
+
+
+def test_graft_does_not_mutate_global_manifest() -> None:
+    md = _sample_metadata()
+    before = copy.deepcopy(md)
+    get_manifest_for_rank(md, 5)
+    get_manifest_for_rank(md, 1)
+    assert md == before
+
+
+def test_helpers() -> None:
+    assert is_container_entry(ListEntry())
+    assert is_container_entry(DictEntry(keys=[]))
+    assert not is_container_entry(_array("x"))
+    assert is_replicated(_array("x", replicated=True))
+    assert not is_replicated(ListEntry())
+
+
+def test_byte_range_tuple() -> None:
+    assert _array("x").byte_range_tuple is None
+    assert _array("x", byte_range=[3, 9]).byte_range_tuple == (3, 9)
+
+
+def test_graft_preserves_int_dict_keys() -> None:
+    """Regression: grafted per-rank manifests must keep int dict keys int
+    (review finding)."""
+    from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+    md = SnapshotMetadata(
+        version="0",
+        world_size=2,
+        manifest={
+            "0/layers": DictEntry(keys=[0]),
+            "0/layers/0": _array("replicated/layers/0", replicated=True),
+        },
+    )
+    m1 = get_manifest_for_rank(md, 1)
+    assert m1["layers"].keys == [0]

@@ -1,0 +1,105 @@
+"""Per-dtype zero-copy round-trips.
+
+Structural model: reference tests/test_serialization.py:32-101.
+"""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.serialization import (
+    STRING_TO_DTYPE,
+    Serializer,
+    array_as_memoryview,
+    array_from_memoryview,
+    array_size_bytes,
+    dtype_to_string,
+    obj_type_name,
+    pickle_load_from_bytes,
+    pickle_save_as_bytes,
+    string_to_dtype,
+)
+
+
+def _rand_array(dtype: np.dtype, shape=(16, 9)) -> np.ndarray:
+    rng = np.random.default_rng(0)
+    if dtype.kind in ("i", "u") or dtype.name in ("int4", "uint4"):
+        return rng.integers(0, 4, size=shape).astype(dtype)
+    if dtype.kind == "b":
+        return rng.integers(0, 2, size=shape).astype(bool)
+    if dtype.kind == "c":
+        return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(
+            dtype
+        )
+    return rng.standard_normal(shape).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype_str", sorted(STRING_TO_DTYPE))
+def test_roundtrip_every_dtype(dtype_str: str) -> None:
+    dtype = string_to_dtype(dtype_str)
+    arr = _rand_array(dtype)
+    mv = array_as_memoryview(arr)
+    assert mv.nbytes == array_size_bytes(arr.shape, dtype_str)
+    restored = array_from_memoryview(mv, dtype_str, arr.shape)
+    assert restored.dtype == arr.dtype
+    np.testing.assert_array_equal(np.asarray(restored), np.asarray(arr))
+    # Zero-copy both ways.
+    assert np.shares_memory(arr, restored)
+
+
+@pytest.mark.parametrize("dtype_str", ["float32", "bfloat16"])
+def test_roundtrip_0d(dtype_str: str) -> None:
+    arr = np.array(1.5, dtype=string_to_dtype(dtype_str))
+    mv = array_as_memoryview(arr)
+    restored = array_from_memoryview(mv, dtype_str, ())
+    assert restored.shape == ()
+    assert restored == arr
+
+
+def test_dtype_string_mapping_is_bijective() -> None:
+    for s, dt in STRING_TO_DTYPE.items():
+        assert dtype_to_string(dt) == s
+        assert string_to_dtype(s) == dt
+
+
+def test_unsupported_dtype_raises() -> None:
+    with pytest.raises(ValueError):
+        dtype_to_string(np.dtype([("a", np.int32)]))
+
+
+def test_non_contiguous_rejected() -> None:
+    arr = np.arange(16, dtype=np.float32).reshape(4, 4)[:, ::2]
+    with pytest.raises(ValueError):
+        array_as_memoryview(arr)
+
+
+def test_wrong_buffer_size_rejected() -> None:
+    arr = np.zeros(4, dtype=np.float32)
+    with pytest.raises(ValueError):
+        array_from_memoryview(array_as_memoryview(arr), "float32", (5,))
+
+
+def test_pickle_roundtrip() -> None:
+    obj = {"a": [1, 2, (3, "x")], "b": {4, 5}}
+    assert pickle_load_from_bytes(pickle_save_as_bytes(obj)) == obj
+
+
+def test_serializer_enum_values() -> None:
+    assert Serializer.BUFFER_PROTOCOL.value == "buffer_protocol"
+    assert Serializer.PICKLE.value == "pickle"
+
+
+def test_obj_type_name() -> None:
+    assert obj_type_name({}) == "dict"
+    assert obj_type_name(np.zeros(1)) == "numpy.ndarray"
+
+
+def test_jax_array_to_numpy_roundtrip() -> None:
+    import jax.numpy as jnp
+
+    x = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4)
+    host = np.asarray(x)
+    mv = array_as_memoryview(np.ascontiguousarray(host))
+    restored = array_from_memoryview(mv, "bfloat16", (3, 4))
+    np.testing.assert_array_equal(
+        np.asarray(restored, dtype=np.float32), np.asarray(host, dtype=np.float32)
+    )

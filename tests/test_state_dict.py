@@ -1,0 +1,97 @@
+"""StateDict / PyTreeState / RngState adapters."""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchsnapshot_tpu import PyTreeState, RngState, StateDict
+from torchsnapshot_tpu.state_dict import pytree_to_state_dict, state_dict_to_pytree
+
+
+def test_state_dict_adapter() -> None:
+    sd = StateDict(epoch=3, steps=[1, 2])
+    out = sd.state_dict()
+    assert out == {"epoch": 3, "steps": [1, 2]}
+    sd2 = StateDict(epoch=0, steps=[])
+    sd2.load_state_dict(out)
+    assert dict(sd2) == {"epoch": 3, "steps": [1, 2]}
+
+
+def test_pytree_state_dict_conversion_namedtuple() -> None:
+    tree = {"a": [jnp.ones(2), (1, 2)], "b": {"c": 3.0}}
+    sd = pytree_to_state_dict(tree)
+    assert isinstance(sd["a"], list)
+    assert isinstance(sd["a"][1], dict)  # tuple became {"0":..,"1":..}
+    rebuilt = state_dict_to_pytree(sd, tree)
+    assert isinstance(rebuilt["a"][1], tuple)
+    assert rebuilt["a"][1] == (1, 2)
+
+
+def test_pytree_state_with_optax() -> None:
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros(3)}
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    holder = PyTreeState(opt_state)
+    sd = holder.state_dict()
+
+    # Simulate restore into a freshly-initialized state.
+    fresh = PyTreeState(opt.init(jax.tree_util.tree_map(lambda x: x * 0, params)))
+    fresh.load_state_dict(sd)
+    restored = fresh.tree
+    assert type(restored) is type(opt_state)
+    chex.assert_trees_all_equal(restored, opt_state)
+
+
+def test_pytree_state_single_leaf() -> None:
+    holder = PyTreeState(jnp.arange(4))
+    sd = holder.state_dict()
+    fresh = PyTreeState(jnp.zeros(4, dtype=jnp.int32))
+    fresh.load_state_dict(sd)
+    np.testing.assert_array_equal(np.asarray(fresh.tree), np.arange(4))
+
+
+def test_rng_state_typed_and_raw_keys() -> None:
+    typed = jax.random.key(7)
+    raw = jax.random.PRNGKey(9)
+    rng = RngState({"typed": typed, "raw": raw})
+    sd = rng.state_dict()
+
+    fresh = RngState({"typed": jax.random.key(0), "raw": jax.random.PRNGKey(0)})
+    fresh.load_state_dict(sd)
+    assert jnp.array_equal(
+        jax.random.key_data(fresh.keys["typed"]), jax.random.key_data(typed)
+    )
+    assert jnp.array_equal(fresh.keys["raw"], raw)
+    # Restored typed key is usable.
+    jax.random.normal(fresh.keys["typed"], (2,))
+
+
+def test_pytree_state_int_keyed_dict() -> None:
+    """Regression: int-keyed dicts must restore (review finding)."""
+    tree = {5: jnp.arange(3), 7: jnp.ones(2)}
+    holder = PyTreeState(tree)
+    sd = holder.state_dict()
+    fresh = PyTreeState({5: jnp.zeros(3, jnp.int32), 7: jnp.zeros(2)})
+    fresh.load_state_dict(sd)
+    np.testing.assert_array_equal(np.asarray(fresh.tree[5]), np.arange(3))
+    assert set(fresh.tree.keys()) == {5, 7}
+
+
+def test_pytree_state_mixed_keys() -> None:
+    tree = {1: jnp.arange(2), "a": jnp.ones(2)}
+    holder = PyTreeState(tree)
+    fresh = PyTreeState({1: jnp.zeros(2, jnp.int32), "a": jnp.zeros(2)})
+    fresh.load_state_dict(holder.state_dict())
+    assert set(fresh.tree.keys()) == {1, "a"}
+
+
+def test_pytree_state_leaf_sentinel_collision() -> None:
+    """Regression: a user dict keyed '__leaf__' must not be misrouted."""
+    tree = {"__leaf__": jnp.arange(3)}
+    holder = PyTreeState(tree)
+    fresh = PyTreeState({"__leaf__": jnp.zeros(3, jnp.int32)})
+    fresh.load_state_dict(holder.state_dict())
+    np.testing.assert_array_equal(np.asarray(fresh.tree["__leaf__"]), np.arange(3))

@@ -1,0 +1,37 @@
+"""torchsnapshot_tpu: a TPU-native checkpointing framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of TorchSnapshot
+(see SURVEY.md at the repo root): performant, memory-bounded, distributed
+checkpointing of arbitrary pytree application state, with zero-copy
+serialization, async device→host staging overlapped with storage I/O,
+write-load partitioning of replicated state, shard-level persistence of
+``NamedSharding``-partitioned ``jax.Array`` s with elastic resharding on
+restore, atomic commit, and pluggable storage backends.
+"""
+
+from .knobs import (
+    enable_batching,
+    override_max_chunk_size_bytes,
+    override_max_shard_size_bytes,
+    override_per_rank_memory_budget_bytes,
+    override_slab_size_threshold_bytes,
+)
+from .rng_state import RngState, RNGState
+from .state_dict import PyTreeState, StateDict
+from .stateful import AppState, Stateful
+from .version import __version__
+
+__all__ = [
+    "AppState",
+    "PyTreeState",
+    "RngState",
+    "RNGState",
+    "StateDict",
+    "Stateful",
+    "__version__",
+    "enable_batching",
+    "override_max_chunk_size_bytes",
+    "override_max_shard_size_bytes",
+    "override_per_rank_memory_budget_bytes",
+    "override_slab_size_threshold_bytes",
+]

@@ -1,0 +1,103 @@
+"""Core I/O request/response types and the storage plugin interface.
+
+Reference parity: torchsnapshot/io_types.py:29-103. A *write request* pairs a
+storage path with a :class:`BufferStager` that produces the bytes (device →
+host staging + serialization); a *read request* pairs a path (and optional
+byte range) with a :class:`BufferConsumer` that absorbs the bytes
+(deserialization + copy into the destination). The scheduler owns when each
+stage runs; storage plugins own how bytes hit the backing store.
+"""
+
+from __future__ import annotations
+
+import abc
+from concurrent.futures import Executor
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+BufferType = Union[bytes, bytearray, memoryview]
+
+
+@dataclass
+class WriteIO:
+    """A fully-staged write: raw bytes destined for ``path``."""
+
+    path: str
+    buf: BufferType
+
+
+@dataclass
+class ReadIO:
+    """A read of ``path``; ``byte_range`` is a half-open ``[start, end)``
+    window, or ``None`` for the whole blob. ``buf`` is populated by the
+    storage plugin."""
+
+    path: str
+    byte_range: Optional[Tuple[int, int]] = None
+    buf: Optional[memoryview] = None
+
+
+class BufferStager(abc.ABC):
+    """Produces the bytes for a write request.
+
+    ``stage_buffer`` may run expensive work (device→host transfer,
+    serialization) on ``executor``; the scheduler admits it only when the
+    staging cost fits the host-memory budget.
+    """
+
+    @abc.abstractmethod
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType: ...
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int: ...
+
+
+class BufferConsumer(abc.ABC):
+    @abc.abstractmethod
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int: ...
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    byte_range: Optional[Tuple[int, int]] = None
+
+
+class StoragePlugin(abc.ABC):
+    """Abstract storage backend (reference: io_types.py:67-103).
+
+    Implementations are used from a single asyncio event loop; blocking work
+    must be dispatched to executors/threads internally. ``read`` fills
+    ``read_io.buf`` (respecting ``byte_range``); ``write`` persists
+    ``write_io.buf`` at ``write_io.path`` relative to the plugin root.
+    """
+
+    @abc.abstractmethod
+    async def write(self, write_io: WriteIO) -> None: ...
+
+    @abc.abstractmethod
+    async def read(self, read_io: ReadIO) -> None: ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    def sync_close(self) -> None:
+        """Convenience for callers without a running loop."""
+        from .event_loop import run_in_fresh_event_loop
+
+        run_in_fresh_event_loop(self.close())

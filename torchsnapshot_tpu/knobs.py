@@ -1,0 +1,123 @@
+"""Environment-variable knobs with test-friendly override context managers.
+
+Reference parity: torchsnapshot/knobs.py:21-98. Same knob surface (max chunk
+size, max shard size, slab threshold, batching toggle, per-rank memory budget
+override, partitioner kill-switch), re-homed under the ``TORCHSNAPSHOT_TPU_``
+prefix. Values are read lazily on every call so tests and subprocesses can
+flip them at any time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Generator, Optional
+
+_MAX_CHUNK_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_MAX_CHUNK_SIZE_BYTES"
+_MAX_SHARD_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_MAX_SHARD_SIZE_BYTES"
+_SLAB_SIZE_THRESHOLD_BYTES_ENV = "TORCHSNAPSHOT_TPU_SLAB_SIZE_THRESHOLD_BYTES"
+_ENABLE_BATCHING_ENV = "TORCHSNAPSHOT_TPU_ENABLE_BATCHING"
+_PER_RANK_MEMORY_BUDGET_BYTES_ENV = "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES"
+_DISABLE_PARTITIONER_ENV = "TORCHSNAPSHOT_TPU_DISABLE_PARTITIONER"
+_PER_RANK_IO_CONCURRENCY_ENV = "TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY"
+_STAGING_THREADS_ENV = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
+
+_DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
+_DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
+_DEFAULT_SLAB_SIZE_THRESHOLD_BYTES: int = 128 * 1024 * 1024
+
+
+def _get_int_env(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return int(val)
+
+
+def get_max_chunk_size_bytes() -> int:
+    """Arrays larger than this are split into chunks written independently."""
+    return _get_int_env(_MAX_CHUNK_SIZE_BYTES_ENV, _DEFAULT_MAX_CHUNK_SIZE_BYTES)
+
+
+def get_max_shard_size_bytes() -> int:
+    """Device shards larger than this are subdivided before writing."""
+    return _get_int_env(_MAX_SHARD_SIZE_BYTES_ENV, _DEFAULT_MAX_SHARD_SIZE_BYTES)
+
+
+def get_slab_size_threshold_bytes() -> int:
+    """Write requests smaller than this are eligible for slab batching."""
+    return _get_int_env(
+        _SLAB_SIZE_THRESHOLD_BYTES_ENV, _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES
+    )
+
+
+def is_batching_enabled() -> bool:
+    """Batching is opt-in; presence of the env var turns it on
+    (reference: knobs.py:53-57)."""
+    return _ENABLE_BATCHING_ENV in os.environ
+
+
+def get_per_rank_memory_budget_bytes_override() -> Optional[int]:
+    val = os.environ.get(_PER_RANK_MEMORY_BUDGET_BYTES_ENV)
+    return int(val) if val is not None else None
+
+
+def is_partitioner_disabled() -> bool:
+    return _DISABLE_PARTITIONER_ENV in os.environ
+
+
+def get_per_rank_io_concurrency() -> int:
+    """Max concurrent storage I/O ops per process (reference: scheduler.py:30)."""
+    return _get_int_env(_PER_RANK_IO_CONCURRENCY_ENV, 16)
+
+
+def get_staging_threads() -> int:
+    """Threads for device->host staging / (de)serialization
+    (reference: scheduler.py:29)."""
+    return _get_int_env(_STAGING_THREADS_ENV, 4)
+
+
+@contextlib.contextmanager
+def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
+    prev = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
+
+
+@contextlib.contextmanager
+def override_max_chunk_size_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_MAX_CHUNK_SIZE_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_max_shard_size_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_MAX_SHARD_SIZE_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_slab_size_threshold_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_SLAB_SIZE_THRESHOLD_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_batching() -> Generator[None, None, None]:
+    with _override_env(_ENABLE_BATCHING_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_per_rank_memory_budget_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_PER_RANK_MEMORY_BUDGET_BYTES_ENV, str(nbytes)):
+        yield

@@ -1,0 +1,83 @@
+"""RNG state capture for JAX programs.
+
+Reference parity: torchsnapshot/rng_state.py:13-38 (``RNGState`` wrapping
+``torch.get_rng_state``). JAX has no global RNG — randomness flows through
+explicit ``jax.random`` keys — so the TPU-native equivalent holds the user's
+current key(s). ``Snapshot.take`` treats at most one :class:`RngState` in the
+app state specially: it is saved first and restored afterwards so taking a
+snapshot has no RNG side effect (reference invariant: snapshot.py:340-346,
+858-877). With explicit keys there is no hidden global to protect, but the
+ordering contract is preserved so the semantics match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .state_dict import pytree_to_state_dict, state_dict_to_pytree
+
+_KEY_DATA = "__prng_key_data__"
+
+
+def _is_typed_key(leaf: Any) -> bool:
+    import jax
+
+    return hasattr(leaf, "dtype") and jax.dtypes.issubdtype(
+        leaf.dtype, jax.dtypes.prng_key
+    )
+
+
+def _encode_keys(keys: Any) -> Any:
+    """Map typed PRNG-key leaves to serializable {key_data, impl} records."""
+    import jax
+
+    def conv(leaf: Any) -> Any:
+        if _is_typed_key(leaf):
+            return {
+                _KEY_DATA: np.asarray(jax.random.key_data(leaf)),
+                "impl": str(jax.random.key_impl(leaf)),
+            }
+        return leaf
+
+    return jax.tree_util.tree_map(conv, keys)
+
+
+class RngState:
+    """Stateful holding one or more ``jax.random`` keys (any key pytree).
+
+    Raw uint32 keys are plain arrays and serialize via the regular array
+    path; typed keys (``jax.random.key``) are persisted as their key data
+    plus impl name and re-wrapped on restore. ``.keys`` holds the live
+    pytree; after ``restore`` it contains the checkpointed keys.
+    """
+
+    def __init__(self, keys: Any) -> None:
+        self.keys = keys
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"keys": pytree_to_state_dict(_encode_keys(self.keys))}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        import jax
+
+        target = _encode_keys(self.keys)
+        restored = state_dict_to_pytree(state_dict["keys"], target)
+
+        def unconv(x: Any) -> Any:
+            if isinstance(x, dict) and _KEY_DATA in x:
+                return jax.random.wrap_key_data(
+                    np.asarray(x[_KEY_DATA]), impl=x["impl"]
+                )
+            return x
+
+        self.keys = jax.tree_util.tree_map(
+            unconv,
+            restored,
+            is_leaf=lambda x: isinstance(x, dict) and _KEY_DATA in x,
+        )
+
+
+# Alias matching the reference class name (torchsnapshot/rng_state.py:13).
+RNGState = RngState

@@ -1,0 +1,186 @@
+"""Stateful adapters for plain values and pure pytrees.
+
+Reference parity: torchsnapshot/state_dict.py:13-41 (``StateDict``).
+TPU-native addition: :class:`PyTreeState`, which adapts an *immutable* JAX
+pytree (flax params, optax optimizer state, namedtuple trees, ...) into the
+``Stateful`` protocol. The reference has no equivalent because torch state is
+mutable in place; JAX state is replaced, not mutated, so the adapter holds the
+current tree and swaps it on ``load_state_dict``.
+"""
+
+from __future__ import annotations
+
+from collections import UserDict
+from typing import Any, Dict, Generic, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class StateDict(UserDict):
+    """Dict wrapper that makes plain values participate in checkpointing.
+
+    ``state_dict()`` returns the underlying data; ``load_state_dict``
+    replaces it wholesale.
+    """
+
+    def state_dict(self) -> Dict[str, Any]:
+        return self.data
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.data = dict(state_dict)
+
+
+def _node_children(tree: Any) -> Optional[List[Tuple[str, Any]]]:
+    """Return ``[(str_key, child)]`` for a pytree node's immediate subtrees,
+    or ``None`` if ``tree`` is a leaf.
+
+    Uses a one-level flatten (``is_leaf`` fires for everything except the
+    node itself), so namedtuples, flax FrozenDicts, and custom registered
+    nodes all decompose without special cases.
+    """
+    import jax
+
+    keyed = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: x is not tree
+    )[0]
+    if len(keyed) == 1 and keyed[0][0] == ():
+        return None
+    return [(_path_key_to_str(path[0]), child) for path, child in keyed]
+
+
+def _path_key_to_str(key: Any) -> str:
+    import jax
+
+    tu = jax.tree_util
+    if isinstance(key, tu.DictKey):
+        return str(key.key)
+    if isinstance(key, tu.SequenceKey):
+        return str(key.idx)
+    if isinstance(key, tu.GetAttrKey):
+        return key.name
+    if isinstance(key, tu.FlattenedIndexKey):
+        return str(key.key)
+    return str(key)
+
+
+def pytree_to_state_dict(tree: Any) -> Any:
+    """Convert an arbitrary pytree to nested dict/list/leaf structure.
+
+    Dicts stay dicts and lists stay lists (so the result round-trips through
+    ``flatten()`` naturally); every other pytree node (tuples, namedtuples,
+    custom nodes) becomes a dict keyed by stringified field/index. Leaves
+    pass through unchanged.
+    """
+    if isinstance(tree, dict):
+        return {k: pytree_to_state_dict(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [pytree_to_state_dict(v) for v in tree]
+    children = _node_children(tree)
+    if children is None:
+        return tree
+    return {key: pytree_to_state_dict(child) for key, child in children}
+
+
+def state_dict_to_pytree(state_dict: Any, target: Any) -> Any:
+    """Rebuild a pytree with ``target``'s structure from a nested state dict.
+
+    Inverse of :func:`pytree_to_state_dict`: ``target`` supplies the treedef
+    (container/namedtuple types), ``state_dict`` supplies the leaf values.
+    """
+    import jax
+
+    # Plain dicts/lists are handled natively (mirrors pytree_to_state_dict):
+    # this preserves int and mixed-type dict keys, which jax's sorted
+    # keypath flatten cannot represent.
+    if isinstance(target, dict):
+        if not isinstance(state_dict, dict):
+            raise TypeError(
+                f"Expected a dict to restore a dict node, got "
+                f"{type(state_dict).__name__}"
+            )
+        return {
+            k: state_dict_to_pytree(_lookup(state_dict, k), v)
+            for k, v in target.items()
+        }
+    if isinstance(target, list):
+        if isinstance(state_dict, dict):
+            seq = [state_dict[str(i)] for i in range(len(target))]
+        else:
+            seq = list(state_dict)
+        return [state_dict_to_pytree(s, v) for s, v in zip(seq, target)]
+
+    children = _node_children(target)
+    if children is None:
+        return state_dict  # leaf position: take the restored value
+    rebuilt = []
+    for key, child in children:
+        if isinstance(state_dict, dict):
+            sub = _lookup(state_dict, key)
+        elif isinstance(state_dict, (list, tuple)):
+            sub = state_dict[int(key)]
+        else:
+            raise TypeError(
+                f"Cannot index a {type(state_dict).__name__} with key {key!r} "
+                f"while rebuilding a pytree node of type {type(target).__name__}"
+            )
+        rebuilt.append(state_dict_to_pytree(sub, child))
+    node_def = jax.tree_util.tree_structure(target, is_leaf=lambda x: x is not target)
+    return jax.tree_util.tree_unflatten(node_def, rebuilt)
+
+
+def _lookup(state_dict: Dict[Any, Any], key: Any) -> Any:
+    """Fetch ``key`` tolerating the str<->int aliasing that stringified
+    pytree paths introduce."""
+    if key in state_dict:
+        return state_dict[key]
+    alias: Any = None
+    if isinstance(key, str):
+        body = key[1:] if key[:1] in "+-" else key
+        if body.isdigit():
+            alias = int(key)
+    elif isinstance(key, int):
+        alias = str(key)
+    if alias is not None and alias in state_dict:
+        return state_dict[alias]
+    raise KeyError(
+        f"state dict is missing key {key!r} (available: {list(state_dict.keys())})"
+    )
+
+
+class PyTreeState(Generic[T]):
+    """Adapt an immutable pytree into the ``Stateful`` protocol.
+
+    Usage::
+
+        app_state = {"params": PyTreeState(params), "opt": PyTreeState(opt_state)}
+        Snapshot.take(path, app_state)
+        ...
+        snapshot.restore(app_state)
+        params = app_state["params"].tree   # restored values, same treedef
+
+    ``load_state_dict`` rebuilds restored leaves into the existing tree's
+    structure, so namedtuple/custom-node trees (e.g. optax states) round-trip
+    with their original types intact.
+    """
+
+    def __init__(self, tree: T) -> None:
+        self.tree: T = tree
+
+    def _is_facade(self) -> bool:
+        """True when the tree serializes to a non-dict and needs the
+        ``__leaf__`` facade. Decided from the live tree's structure, so a
+        user dict that happens to contain a ``__leaf__`` key is unambiguous."""
+        return not isinstance(pytree_to_state_dict(self.tree), dict)
+
+    def state_dict(self) -> Dict[str, Any]:
+        sd = pytree_to_state_dict(self.tree)
+        if not isinstance(sd, dict):
+            # Single-leaf/list trees still need a dict facade for the protocol.
+            return {"__leaf__": sd}
+        return sd
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        if self._is_facade():
+            self.tree = state_dict_to_pytree(state_dict["__leaf__"], self.tree)
+            return
+        self.tree = state_dict_to_pytree(state_dict, self.tree)

@@ -1,0 +1,21 @@
+"""The ``Stateful`` protocol: anything that can produce and absorb a state dict.
+
+Reference parity: torchsnapshot/stateful.py:13-23. In the JAX world most
+checkpointable things are pure pytrees (params, optax states) rather than
+mutable modules, so the protocol is complemented by :class:`PyTreeState`
+(state_dict.py) which adapts an immutable pytree into a ``Stateful``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    def state_dict(self) -> Dict[str, Any]: ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None: ...
+
+
+AppState = Dict[str, Stateful]

@@ -1,0 +1,6 @@
+"""Version of the torchsnapshot_tpu package.
+
+Reference parity: torchsnapshot/version.py:17 (``__version__ = "0.0.3"``).
+"""
+
+__version__ = "0.1.0"
