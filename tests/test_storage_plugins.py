@@ -1,0 +1,82 @@
+"""FS and memory storage plugin round-trips, ranged reads, deletes."""
+
+import asyncio
+
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture(params=["fs", "memory"])
+def plugin(request, tmp_path):
+    if request.param == "fs":
+        p = FSStoragePlugin(root=str(tmp_path))
+        yield p
+    else:
+        name = f"test-{id(request)}"
+        p = MemoryStoragePlugin(name=name)
+        yield p
+        MemoryStoragePlugin.drop_store(name)
+
+
+def test_write_read_roundtrip(plugin) -> None:
+    async def go():
+        payload = bytes(range(256)) * 4
+        await plugin.write(WriteIO(path="a/b/data", buf=payload))
+        read_io = ReadIO(path="a/b/data")
+        await plugin.read(read_io)
+        assert bytes(read_io.buf) == payload
+
+        ranged = ReadIO(path="a/b/data", byte_range=(256, 512))
+        await plugin.read(ranged)
+        assert bytes(ranged.buf) == bytes(range(256))
+
+        await plugin.delete("a/b/data")
+        with pytest.raises(Exception):
+            await plugin.read(ReadIO(path="a/b/data"))
+        await plugin.close()
+
+    _run(go())
+
+
+def test_write_accepts_memoryview_and_bytearray(plugin) -> None:
+    async def go():
+        await plugin.write(WriteIO(path="mv", buf=memoryview(b"hello")))
+        await plugin.write(WriteIO(path="ba", buf=bytearray(b"world")))
+        r1, r2 = ReadIO(path="mv"), ReadIO(path="ba")
+        await plugin.read(r1)
+        await plugin.read(r2)
+        assert bytes(r1.buf) == b"hello" and bytes(r2.buf) == b"world"
+
+    _run(go())
+
+
+def test_url_dispatch(tmp_path) -> None:
+    assert isinstance(url_to_storage_plugin(str(tmp_path)), FSStoragePlugin)
+    assert isinstance(url_to_storage_plugin(f"fs://{tmp_path}"), FSStoragePlugin)
+    assert isinstance(url_to_storage_plugin("memory://x"), MemoryStoragePlugin)
+    with pytest.raises(RuntimeError, match="Unsupported storage scheme"):
+        url_to_storage_plugin("warpdrive://x")
+
+
+def test_fs_overwrite(tmp_path) -> None:
+    async def go():
+        p = FSStoragePlugin(root=str(tmp_path))
+        await p.write(WriteIO(path="f", buf=b"111111"))
+        await p.write(WriteIO(path="f", buf=b"22"))
+        r = ReadIO(path="f")
+        await p.read(r)
+        assert bytes(r.buf) == b"22"
+
+    _run(go())

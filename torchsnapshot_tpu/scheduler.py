@@ -1,0 +1,376 @@
+"""Pipelined write/read execution under a host-memory budget.
+
+Reference parity: torchsnapshot/scheduler.py. Same contract, different
+machinery: instead of explicit state-set juggling (scheduler.py:237-330),
+each request runs as its own coroutine —
+
+    write:  acquire budget -> stage (device->host + serialize, on a thread
+            pool) -> re-price budget to actual buffer size -> acquire an I/O
+            slot -> storage.write -> release budget
+    read:   acquire budget -> acquire I/O slot -> storage.read -> release
+            slot -> consume (deserialize + copy, on a thread pool) -> release
+
+Admission control lives in :class:`MemoryBudget`: a request larger than the
+whole budget is admitted only when nothing else is in flight (reference rule,
+scheduler.py:266-271), so huge buffers serialize instead of deadlocking.
+
+``execute_write_reqs`` returns a :class:`PendingIOWork` as soon as *staging*
+has finished for every request — the async-snapshot unblock point
+(scheduler.py:224-234): from then on the application may mutate/free device
+arrays while storage I/O drains in the background.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from concurrent.futures import Executor, ThreadPoolExecutor
+from typing import Awaitable, Callable, List, Optional
+
+import psutil
+
+from . import knobs
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+_MAX_PER_RANK_MEMORY_BUDGET_BYTES: int = 32 * 1024 * 1024 * 1024
+_AVAILABLE_MEMORY_MULTIPLIER: float = 0.6
+_LOG_LINE_LIMIT = 8
+
+
+def get_process_memory_budget_bytes(pg=None) -> int:
+    """Per-process host-memory budget for staging/consuming buffers.
+
+    ``min(available_host_memory * 0.6 / local_world_size, 32 GiB)`` with an
+    env-var override (reference: scheduler.py:45-65). ``local_world_size``
+    counts co-hosted processes via a hostname all-gather on ``pg`` — on TPU
+    pods this is processes per host, not chips per host.
+    """
+    override = knobs.get_per_rank_memory_budget_bytes_override()
+    if override is not None:
+        logger.info("Memory budget manually set to %d bytes", override)
+        return override
+    available = int(psutil.virtual_memory().available * _AVAILABLE_MEMORY_MULTIPLIER)
+    local_world_size = 1
+    if pg is not None and pg.get_world_size() > 1:
+        import socket
+
+        hostnames = pg.all_gather_object(socket.gethostname())
+        local_world_size = sum(1 for h in hostnames if h == socket.gethostname())
+    budget = min(available // local_world_size, _MAX_PER_RANK_MEMORY_BUDGET_BYTES)
+    logger.info("Memory budget set to %d bytes", budget)
+    return budget
+
+
+class MemoryBudget:
+    """Async counting budget with an idle-admission escape hatch.
+
+    ``acquire(cost)`` waits until ``cost`` fits, or until the pipeline is
+    completely idle (in which case an oversized request is admitted alone).
+    ``adjust(delta)`` re-prices a held reservation (staging cost vs actual
+    buffer size can differ, e.g. non-contiguous arrays); ``release`` returns
+    the final amount.
+    """
+
+    def __init__(self, total_bytes: int) -> None:
+        self.total_bytes = total_bytes
+        self.available_bytes = total_bytes
+        self.inflight = 0
+        self._cond: asyncio.Condition = asyncio.Condition()
+
+    async def acquire(self, cost_bytes: int) -> None:
+        async with self._cond:
+            await self._cond.wait_for(
+                lambda: cost_bytes <= self.available_bytes or self.inflight == 0
+            )
+            self.available_bytes -= cost_bytes
+            self.inflight += 1
+
+    async def adjust(self, delta_bytes: int) -> None:
+        async with self._cond:
+            self.available_bytes -= delta_bytes
+            if delta_bytes < 0:
+                self._cond.notify_all()
+
+    async def release(self, cost_bytes: int) -> None:
+        async with self._cond:
+            self.available_bytes += cost_bytes
+            self.inflight -= 1
+            self._cond.notify_all()
+
+
+class _PipelineStats:
+    """Live counters backing the progress reporter."""
+
+    def __init__(self) -> None:
+        self.pending = 0
+        self.staging = 0
+        self.waiting_io = 0
+        self.io = 0
+        self.done = 0
+        self.bytes_moved = 0
+
+
+class _ProgressReporter:
+    """Rank-0 header + per-rank progress rows with RSS delta, budget and GB
+    moved (reference _WriteReporter, scheduler.py:96-175)."""
+
+    _ROW = (
+        "{rank:>4} {pending:>9} {staging:>9} {waiting:>9} {io:>9} "
+        "{rss_delta:>15} {budget:>19} {moved:>15}"
+    )
+
+    def __init__(
+        self, stats: _PipelineStats, budget: MemoryBudget, rank: int, total: int
+    ) -> None:
+        self.stats = stats
+        self.budget = budget
+        self.rank = rank
+        self.begin_ts = time.monotonic()
+        self._process = psutil.Process()
+        self.baseline_rss = self._process.memory_info().rss
+        self.report_every = max(1, math.ceil(total / _LOG_LINE_LIMIT))
+        self._header = self._ROW.format(
+            rank="Rank",
+            pending="Pending",
+            staging="Staging",
+            waiting="Writable",
+            io="I/O",
+            rss_delta="RSS Delta (GB)",
+            budget="Budget (GB)",
+            moved="Moved (GB)",
+        )
+
+    def print_header(self) -> None:
+        if self.rank == 0:
+            logger.info(self._header)
+            logger.info("-" * len(self._header))
+
+    def report(self) -> None:
+        rss_delta_gb = (self._process.memory_info().rss - self.baseline_rss) / 1024**3
+        logger.info(
+            self._ROW.format(
+                rank=self.rank,
+                pending=self.stats.pending,
+                staging=self.stats.staging,
+                waiting=self.stats.waiting_io,
+                io=self.stats.io,
+                rss_delta=f"{rss_delta_gb:.2f}",
+                budget=(
+                    f"{self.budget.available_bytes / 1024**3:.2f}/"
+                    f"{self.budget.total_bytes / 1024**3:.2f}"
+                ),
+                moved=f"{self.stats.bytes_moved / 1024**3:.2f}",
+            )
+        )
+
+    def maybe_report(self) -> None:
+        if self.stats.done % self.report_every == 0:
+            self.report()
+
+    def report_phase_done(self, phase: str) -> None:
+        elapsed = time.monotonic() - self.begin_ts
+        mbps = self.stats.bytes_moved / 1024**2 / elapsed if elapsed > 0 else 0.0
+        msg = (
+            f"Rank {self.rank} completed {phase} in {elapsed:.2f}s "
+            f"(throughput {mbps:.2f} MB/s)"
+        )
+        pad = max(0, len(self._header) - len(msg) - 2) / 2
+        logger.info(f"{'-' * math.ceil(pad)} {msg} {'-' * math.floor(pad)}")
+
+
+class PendingIOWork:
+    """Handle over storage I/O still draining after staging completed
+    (reference scheduler.py:178-217). ``complete`` re-raises the first
+    failure; the commit marker must not be written in that case."""
+
+    def __init__(
+        self,
+        io_tasks: List["asyncio.Task[None]"],
+        reporter: _ProgressReporter,
+        executor: ThreadPoolExecutor,
+    ) -> None:
+        self.io_tasks = io_tasks
+        self.reporter = reporter
+        self._executor = executor
+
+    async def complete(self) -> None:
+        try:
+            if self.io_tasks:
+                await asyncio.gather(*self.io_tasks)
+        finally:
+            self._executor.shutdown(wait=False)
+        self.reporter.report_phase_done("writing")
+
+    def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
+        event_loop.run_until_complete(self.complete())
+
+
+async def execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> PendingIOWork:
+    """Run the staged write pipeline; returns once every request is past
+    staging, with storage I/O continuing inside the returned handle."""
+    budget = MemoryBudget(memory_budget_bytes)
+    stats = _PipelineStats()
+    stats.pending = len(write_reqs)
+    reporter = _ProgressReporter(stats, budget, rank, len(write_reqs))
+    reporter.print_header()
+
+    executor = ThreadPoolExecutor(
+        max_workers=knobs.get_staging_threads(), thread_name_prefix="ts-stage"
+    )
+    io_slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
+    io_tasks: List[asyncio.Task] = []
+
+    async def write_one(req: WriteReq, buf) -> None:
+        buf_len = len(buf)
+        try:
+            async with io_slots:
+                stats.waiting_io -= 1
+                stats.io += 1
+                try:
+                    await storage.write(WriteIO(path=req.path, buf=buf))
+                finally:
+                    stats.io -= 1
+        finally:
+            del buf
+            await budget.release(buf_len)
+        stats.done += 1
+        stats.bytes_moved += buf_len
+        reporter.maybe_report()
+
+    async def stage_one(req: WriteReq) -> None:
+        """Budget-admitted staging; hands the staged buffer straight to a
+        background write task so I/O overlaps other requests' staging."""
+        cost = req.buffer_stager.get_staging_cost_bytes()
+        await budget.acquire(cost)
+        stats.pending -= 1
+        stats.staging += 1
+        try:
+            buf = await req.buffer_stager.stage_buffer(executor)
+        except BaseException:
+            stats.staging -= 1
+            await budget.release(cost)
+            raise
+        stats.staging -= 1
+        stats.waiting_io += 1
+        # Re-price the reservation: actual buffer size can differ from the
+        # staging cost (e.g. pickled objects).
+        await budget.adjust(len(buf) - cost)
+        io_tasks.append(asyncio.create_task(write_one(req, buf)))
+        del buf
+
+    staging_tasks = [asyncio.create_task(stage_one(r)) for r in write_reqs]
+    try:
+        if staging_tasks:
+            await asyncio.gather(*staging_tasks)
+    except BaseException:
+        for t in staging_tasks + io_tasks:
+            t.cancel()
+        await asyncio.gather(*staging_tasks, *io_tasks, return_exceptions=True)
+        executor.shutdown(wait=False)
+        raise
+
+    reporter.report_phase_done("staging")
+    return PendingIOWork(io_tasks=io_tasks, reporter=reporter, executor=executor)
+
+
+def sync_execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> PendingIOWork:
+    return event_loop.run_until_complete(
+        execute_write_reqs(
+            write_reqs=write_reqs,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes,
+            rank=rank,
+        )
+    )
+
+
+async def execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> None:
+    """Read pipeline: storage read -> deserialize/copy, budgeted by each
+    request's consuming cost (reference scheduler.py:357-444)."""
+    budget = MemoryBudget(memory_budget_bytes)
+    stats = _PipelineStats()
+    stats.pending = len(read_reqs)
+    reporter = _ProgressReporter(stats, budget, rank, len(read_reqs))
+
+    executor = ThreadPoolExecutor(
+        max_workers=knobs.get_staging_threads(), thread_name_prefix="ts-consume"
+    )
+    io_slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
+
+    async def read_one(req: ReadReq) -> None:
+        cost = req.buffer_consumer.get_consuming_cost_bytes()
+        await budget.acquire(cost)
+        stats.pending -= 1
+        try:
+            async with io_slots:
+                stats.io += 1
+                read_io = ReadIO(path=req.path, byte_range=req.byte_range)
+                try:
+                    await storage.read(read_io)
+                finally:
+                    stats.io -= 1
+            buf = read_io.buf
+            if buf is None:
+                raise AssertionError(
+                    f"Storage plugin did not populate buffer for {req.path}"
+                )
+            stats.staging += 1
+            try:
+                await req.buffer_consumer.consume_buffer(buf, executor)
+            finally:
+                stats.staging -= 1
+            stats.done += 1
+            stats.bytes_moved += buf.nbytes
+            del buf, read_io
+            reporter.maybe_report()
+        finally:
+            await budget.release(cost)
+
+    tasks = [asyncio.create_task(read_one(r)) for r in read_reqs]
+    try:
+        await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+    finally:
+        executor.shutdown(wait=False)
+    reporter.report_phase_done("loading")
+
+
+def sync_execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> None:
+    event_loop.run_until_complete(
+        execute_read_reqs(
+            read_reqs=read_reqs,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes,
+            rank=rank,
+        )
+    )

@@ -14,7 +14,6 @@ big-endian host would need byteswaps and is rejected loudly.
 
 from __future__ import annotations
 
-import io
 import pickle
 import sys
 from enum import Enum
@@ -171,9 +170,7 @@ def pickle_save_as_bytes(obj: Any) -> bytes:
     """Serialize an arbitrary object (reference: torch_save_as_bytes,
     serialization.py:247-254). Protocol 5 enables out-of-band-capable
     buffers and is supported by every Python this package runs on."""
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=5)
-    return buf.getvalue()
+    return pickle.dumps(obj, protocol=5)
 
 
 def pickle_load_from_bytes(data: bytes) -> Any:

@@ -107,6 +107,11 @@ def state_dict_to_pytree(state_dict: Any, target: Any) -> Any:
             seq = [state_dict[str(i)] for i in range(len(target))]
         else:
             seq = list(state_dict)
+        if len(seq) != len(target):
+            raise ValueError(
+                f"Cannot restore a list of length {len(target)} from a state "
+                f"dict with {len(seq)} elements"
+            )
         return [state_dict_to_pytree(s, v) for s, v in zip(seq, target)]
 
     children = _node_children(target)
@@ -168,9 +173,14 @@ class PyTreeState(Generic[T]):
 
     def _is_facade(self) -> bool:
         """True when the tree serializes to a non-dict and needs the
-        ``__leaf__`` facade. Decided from the live tree's structure, so a
-        user dict that happens to contain a ``__leaf__`` key is unambiguous."""
-        return not isinstance(pytree_to_state_dict(self.tree), dict)
+        ``__leaf__`` facade. Decided from the live tree's top-level structure
+        (O(1), no full conversion), so a user dict that happens to contain a
+        ``__leaf__`` key is unambiguous."""
+        if isinstance(self.tree, dict):
+            return False
+        if isinstance(self.tree, list):
+            return True
+        return _node_children(self.tree) is None
 
     def state_dict(self) -> Dict[str, Any]:
         sd = pytree_to_state_dict(self.tree)

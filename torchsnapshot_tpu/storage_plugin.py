@@ -1,0 +1,68 @@
+"""Storage plugin registry: URL scheme -> plugin, plus entry-point extension.
+
+Reference parity: torchsnapshot/storage_plugin.py:17-59. ``fs://`` is the
+default scheme for bare paths; ``memory://`` is a TPU-repo addition used by
+tests and scratch runs; ``s3://`` / ``gs://`` map to the cloud plugins
+(import-gated on their optional dependencies). Third-party plugins register
+via the ``torchsnapshot_tpu.storage_plugins`` entry-point group.
+"""
+
+from __future__ import annotations
+
+from importlib.metadata import entry_points
+from typing import Optional, Tuple
+
+from .io_types import StoragePlugin
+
+_ENTRY_POINT_GROUP = "torchsnapshot_tpu.storage_plugins"
+
+
+def _parse_url(url_path: str) -> Tuple[str, str]:
+    if "://" in url_path:
+        scheme, _, path = url_path.partition("://")
+        return (scheme or "fs", path)
+    return ("fs", url_path)
+
+
+def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    """Build the storage plugin for a snapshot location URL.
+
+    A bare path is treated as ``fs://``. Unknown schemes fall through to the
+    entry-point registry so external backends can plug in without touching
+    this package.
+    """
+    scheme, path = _parse_url(url_path)
+
+    if scheme == "fs":
+        from .storage_plugins.fs import FSStoragePlugin
+
+        return FSStoragePlugin(root=path)
+    if scheme == "memory":
+        from .storage_plugins.memory import MemoryStoragePlugin
+
+        return MemoryStoragePlugin(name=path or "default")
+    if scheme == "s3":
+        from .storage_plugins.s3 import S3StoragePlugin
+
+        return S3StoragePlugin(root=path)
+    if scheme in ("gs", "gcs"):
+        from .storage_plugins.gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(root=path)
+
+    eps = entry_points(group=_ENTRY_POINT_GROUP)
+    for ep in eps:
+        if ep.name == scheme:
+            return ep.load()(path)
+    raise RuntimeError(
+        f"Unsupported storage scheme {scheme!r} in {url_path!r} "
+        f"(built-in: fs, memory, s3, gs; entry-point group: {_ENTRY_POINT_GROUP})"
+    )
+
+
+def url_to_storage_plugin_in_event_loop(
+    url_path: str, event_loop: Optional["object"] = None
+) -> StoragePlugin:
+    """Reference-parity alias (storage_plugin.py:62); plugin construction is
+    synchronous here, so the event loop is unused but kept for API shape."""
+    return url_to_storage_plugin(url_path)

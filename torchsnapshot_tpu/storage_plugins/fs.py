@@ -1,0 +1,57 @@
+"""Local/network filesystem storage plugin.
+
+Reference parity: torchsnapshot/storage_plugins/fs.py:19-54 (aiofiles-based
+async read/write with ranged reads and a parent-directory cache). Writes are
+dispatched through aiofiles' thread pool so the event loop stays free to
+overlap staging, and fsync is deliberately left to the OS (matching the
+reference; the commit protocol tolerates torn writes because the metadata
+file is written only after all data writes return).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Set
+
+import aiofiles
+import aiofiles.os
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+
+class FSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._dir_cache: Set[str] = set()
+
+    def _full_path(self, path: str) -> str:
+        return os.path.join(self.root, path)
+
+    async def _ensure_parent_dir(self, full_path: str) -> None:
+        parent = os.path.dirname(full_path)
+        if parent and parent not in self._dir_cache:
+            await aiofiles.os.makedirs(parent, exist_ok=True)
+            self._dir_cache.add(parent)
+
+    async def write(self, write_io: WriteIO) -> None:
+        full_path = self._full_path(write_io.path)
+        await self._ensure_parent_dir(full_path)
+        async with aiofiles.open(full_path, "wb") as f:
+            await f.write(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        full_path = self._full_path(read_io.path)
+        async with aiofiles.open(full_path, "rb") as f:
+            if read_io.byte_range is None:
+                data = await f.read()
+            else:
+                start, end = read_io.byte_range
+                await f.seek(start)
+                data = await f.read(end - start)
+        read_io.buf = memoryview(data)
+
+    async def delete(self, path: str) -> None:
+        await aiofiles.os.remove(self._full_path(path))
+
+    async def close(self) -> None:
+        self._dir_cache.clear()
