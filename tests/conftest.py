@@ -2,15 +2,26 @@
 
 Multi-device sharding semantics (the analog of the reference's
 gloo-on-one-box trick, test_utils.py:205-238) are exercised without TPU pods
-by asking XLA's host platform for 8 virtual devices. Must run before jax
-initializes a backend, hence the env mutation at import time.
+by asking XLA's host platform for 8 virtual devices.
+
+This environment pre-imports jax at interpreter startup with the TPU
+platform pinned via JAX_PLATFORMS, so mutating the env here is too late for
+this process — the platform is switched through jax.config instead (the
+backend itself is created lazily, so this works as long as no test ran yet).
+The env vars are still set for the benefit of subprocesses spawned by
+multi-process tests. Set TS_TEST_ON_TPU=1 to run the suite against the real
+chip instead.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("TS_TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+        os.environ["XLA_FLAGS"] = _flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

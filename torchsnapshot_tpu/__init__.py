@@ -17,13 +17,16 @@ from .knobs import (
     override_slab_size_threshold_bytes,
 )
 from .rng_state import RngState, RNGState
+from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import PyTreeState, StateDict
 from .stateful import AppState, Stateful
 from .version import __version__
 
 __all__ = [
     "AppState",
+    "PendingSnapshot",
     "PyTreeState",
+    "Snapshot",
     "RngState",
     "RNGState",
     "StateDict",

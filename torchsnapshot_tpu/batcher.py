@@ -1,0 +1,31 @@
+"""Small-write coalescing into slab blobs.
+
+Reference parity target: torchsnapshot/batcher.py (482 LoC) — buffer-protocol
+write requests under the slab threshold are packed into ``batched/{uuid}``
+slabs with entry locations/byte_ranges rewritten, and ranged reads are merged
+into spanning reads. Lands in a later milestone; the env knob fails loudly
+until then instead of silently not batching.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .io_types import ReadReq, WriteReq
+from .manifest import Entry
+
+
+def batch_write_requests(
+    entries: List[Entry], write_reqs: List[WriteReq]
+) -> Tuple[List[Entry], List[WriteReq]]:
+    raise NotImplementedError(
+        "TORCHSNAPSHOT_TPU_ENABLE_BATCHING is set, but slab batching has not "
+        "landed yet; unset the env var"
+    )
+
+
+def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    raise NotImplementedError(
+        "TORCHSNAPSHOT_TPU_ENABLE_BATCHING is set, but slab batching has not "
+        "landed yet; unset the env var"
+    )

@@ -1,0 +1,151 @@
+"""Write-load balancing of replicated state across ranks.
+
+Reference parity: torchsnapshot/partitioner.py (302 LoC). Replicated state
+exists identically on every rank; writing it from all of them wastes
+bandwidth, writing it all from rank 0 serializes the save. Instead the
+write requests for replicated entries are partitioned across ranks with a
+greedy argmin bin-packing (reference ``_partition_write_loads``,
+partitioner.py:42-79), seeded with each rank's unavoidable non-replicated
+write load. Chunked entries are sub-partitionable: their chunks can land on
+different ranks (reference ``_is_subpartitionable``, :31-39); everything
+else is assigned whole.
+
+Rank 0 computes the assignment and broadcasts it, so every rank agrees
+without trusting floating-point reductions. Entries are *not* trimmed to
+the owned chunks (the reference trims then re-merges, :147-166 + :236-292);
+keeping complete entries everywhere and deduplicating at manifest-gather
+time yields the same committed metadata with less bookkeeping.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple
+
+from . import knobs
+from .io_types import WriteReq
+from .manifest import (
+    ChunkedArrayEntry,
+    Entry,
+    Manifest,
+    is_container_entry,
+    is_replicated,
+)
+from .pg_wrapper import PGWrapper
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+
+def _estimate_write_req_size(req: WriteReq) -> int:
+    """Staging cost is a faithful stand-in for bytes-on-storage for arrays
+    and a best-effort one for pickled objects (reference
+    _estimate_write_req_storage_size, partitioner.py:82-90)."""
+    return max(1, req.buffer_stager.get_staging_cost_bytes())
+
+
+def partition_write_reqs(
+    entries: Manifest, write_reqs: List[WriteReq], pg_wrapper: PGWrapper
+) -> Tuple[Manifest, List[WriteReq]]:
+    """Drop this rank's replicated write requests that other ranks will
+    write instead; returns (entries, kept_write_reqs).
+
+    Reference parity: partition_write_reqs (partitioner.py:169-233).
+    """
+    if pg_wrapper.get_world_size() == 1:
+        return entries, write_reqs
+    if knobs.is_partitioner_disabled():
+        raise NotImplementedError(
+            "TORCHSNAPSHOT_TPU_DISABLE_PARTITIONER is set; the reference "
+            "raises here too (partitioner.py:199-202)"
+        )
+
+    replicated_reqs: Dict[str, WriteReq] = {}
+    kept: List[WriteReq] = []
+    base_load = 0
+    for req in write_reqs:
+        if req.path.startswith("replicated/"):
+            replicated_reqs[req.path] = req
+        else:
+            kept.append(req)
+            base_load += _estimate_write_req_size(req)
+
+    # (path -> size) for this rank's replicated write requests; identical
+    # across ranks by construction (same state, same chunking knobs).
+    local_items = {
+        path: _estimate_write_req_size(req)
+        for path, req in replicated_reqs.items()
+    }
+
+    gathered_items = pg_wrapper.all_gather_object(sorted(local_items.items()))
+    gathered_loads = pg_wrapper.all_gather_object(base_load)
+
+    assignment: Dict[str, int] = {}
+    if pg_wrapper.get_rank() == 0:
+        # Union of items across ranks (a path replicated on a strict subset
+        # of ranks was already rejected by replication verification, but be
+        # permissive here); each item is assignable to any rank that has it.
+        item_holders: Dict[str, List[int]] = {}
+        item_sizes: Dict[str, int] = {}
+        for rnk, items in enumerate(gathered_items):
+            for path, size in items:
+                item_holders.setdefault(path, []).append(rnk)
+                item_sizes[path] = size
+        loads = list(gathered_loads)
+        for path in sorted(
+            item_sizes, key=lambda p: item_sizes[p], reverse=True
+        ):
+            holders = item_holders[path]
+            target = min(holders, key=lambda r: loads[r])
+            assignment[path] = target
+            loads[target] += item_sizes[path]
+    assignment = pg_wrapper.broadcast_object(assignment)
+
+    rank = pg_wrapper.get_rank()
+    for path, req in replicated_reqs.items():
+        if assignment.get(path, 0) == rank:
+            kept.append(req)
+    logger.debug(
+        "Rank %d keeps %d/%d replicated write reqs after partitioning",
+        rank,
+        len(kept) + len(replicated_reqs) - len(write_reqs),
+        len(replicated_reqs),
+    )
+    return entries, kept
+
+
+def consolidate_replicated_entries(
+    gathered_manifests: List[Manifest],
+) -> Dict[str, Entry]:
+    """Merge replicated entries across gathered rank manifests into one
+    complete entry per logical path (reference partitioner.py:236-292).
+
+    With untrimmed entries this is mostly an equality assertion; chunked
+    entries are unioned by chunk offsets for safety.
+    """
+    merged: Dict[str, Entry] = {}
+    for manifest in gathered_manifests:
+        for path, entry in manifest.items():
+            if not is_replicated(entry) or is_container_entry(entry):
+                continue
+            if path not in merged:
+                merged[path] = entry
+                continue
+            existing = merged[path]
+            if isinstance(entry, ChunkedArrayEntry) and isinstance(
+                existing, ChunkedArrayEntry
+            ):
+                by_offsets = {tuple(c.offsets): c for c in existing.chunks}
+                for chunk in entry.chunks:
+                    by_offsets.setdefault(tuple(chunk.offsets), chunk)
+                merged[path] = ChunkedArrayEntry(
+                    dtype=entry.dtype,
+                    shape=entry.shape,
+                    chunks=[by_offsets[k] for k in sorted(by_offsets)],
+                    replicated=True,
+                )
+            elif entry != existing:
+                raise AssertionError(
+                    f"Replicated entry mismatch across ranks for {path!r}: "
+                    f"{existing} != {entry}"
+                )
+    return merged

@@ -1,0 +1,89 @@
+"""Uniform object-collective interface for snapshot coordination.
+
+Reference parity: torchsnapshot/pg_wrapper.py:15-89 (``PGWrapper`` over
+``torch.distributed``). The TPU-native design moves *only metadata* through
+these collectives (manifests, plans, paths — never array data; reference
+behavior is identical, §2.11 of SURVEY.md), so they ride a small KV-store
+("coordinator") rather than ICI: in multi-process runs that's the store from
+``dist_store.py`` (TCP store or the JAX coordination service); in
+single-process runs everything degenerates to no-ops, mirroring the
+reference's uninitialized-process-group behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from .dist_store import Store
+
+
+class PGWrapper:
+    """Object collectives with a world-size-1 fast path.
+
+    ``pg`` may be ``None`` (single process), an existing :class:`PGWrapper`,
+    or a ``(store, rank, world_size)`` triple / :class:`ProcessGroup`-like
+    object exposing ``store``/``rank``/``world_size``.
+    """
+
+    def __init__(self, pg: Optional[Any] = None) -> None:
+        if pg is None:
+            self.store: Optional[Store] = None
+            self.rank = 0
+            self.world_size = 1
+        elif isinstance(pg, PGWrapper):
+            self.store = pg.store
+            self.rank = pg.rank
+            self.world_size = pg.world_size
+        else:
+            self.store = pg.store
+            self.rank = int(pg.rank)
+            self.world_size = int(pg.world_size)
+        self._op_seq = 0
+
+    def get_rank(self) -> int:
+        return self.rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def _next_prefix(self, op: str) -> str:
+        self._op_seq += 1
+        return f"__pg/{op}/{self._op_seq}"
+
+    def barrier(self) -> None:
+        if self.world_size == 1:
+            return
+        assert self.store is not None
+        self.store.barrier(
+            self._next_prefix("barrier"), self.rank, self.world_size
+        )
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        """Gather one picklable object per rank, returned in rank order."""
+        if self.world_size == 1:
+            return [obj]
+        assert self.store is not None
+        return self.store.exchange(
+            self._next_prefix("ag"), self.rank, self.world_size, obj
+        )
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        """Broadcast ``obj`` from ``src``; other ranks' inputs are ignored."""
+        if self.world_size == 1:
+            return obj
+        assert self.store is not None
+        return self.store.broadcast(
+            self._next_prefix("bc"), self.rank, self.world_size, obj, src
+        )
+
+    def scatter_object_list(self, objs: Optional[Sequence[Any]], src: int = 0) -> Any:
+        """Rank ``src`` provides one object per rank; each rank receives its
+        own. (The reference emulates this over broadcast for NCCL,
+        pg_wrapper.py:83-87; over a store it is a direct exchange.)"""
+        if self.world_size == 1:
+            assert objs is not None
+            return objs[0]
+        assert self.store is not None
+        return self.store.scatter(
+            self._next_prefix("sc"), self.rank, self.world_size, objs, src
+        )

@@ -1,0 +1,44 @@
+"""Sharded-array preparer: NamedSharding shards -> per-shard writes, elastic
+resharding on restore. (Implementation lands with the distributed layer;
+this placeholder keeps dispatch importable.)
+
+Reference parity target: ShardedTensorIOPreparer (io_preparer.py:167-391).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from .io_types import ReadReq, WriteReq
+from .manifest import Entry, ShardedArrayEntry
+
+
+class ShardedArrayIOPreparer:
+    @staticmethod
+    def prepare_write(
+        obj: Any, logical_path: str, is_async_snapshot: bool
+    ) -> Tuple[Entry, List[WriteReq]]:
+        raise NotImplementedError(
+            "Sharded jax.Array checkpointing lands with the distributed layer"
+        )
+
+    @staticmethod
+    def prepare_read(
+        entry: ShardedArrayEntry,
+        obj_out: Optional[Any],
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> List[ReadReq]:
+        raise NotImplementedError(
+            "Sharded jax.Array checkpointing lands with the distributed layer"
+        )
+
+    @staticmethod
+    def prepare_read_into(
+        entry: ShardedArrayEntry,
+        current_leaf: Optional[Any],
+        restored: dict,
+        path: str,
+    ):
+        raise NotImplementedError(
+            "Sharded jax.Array checkpointing lands with the distributed layer"
+        )

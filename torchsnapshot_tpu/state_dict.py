@@ -104,6 +104,11 @@ def state_dict_to_pytree(state_dict: Any, target: Any) -> Any:
         }
     if isinstance(target, list):
         if isinstance(state_dict, dict):
+            if len(state_dict) != len(target):
+                raise ValueError(
+                    f"Cannot restore a list of length {len(target)} from a "
+                    f"dict-shaped state dict with {len(state_dict)} elements"
+                )
             seq = [state_dict[str(i)] for i in range(len(target))]
         else:
             seq = list(state_dict)
