@@ -1,0 +1,140 @@
+"""TCP store primitives, object collectives, and LinearBarrier semantics.
+
+Structural model: reference tests/test_dist_store.py:57-194 (TCPStore +
+LinearBarrier incl. timeout and error propagation).
+"""
+
+import threading
+import time
+
+import pytest
+
+from torchsnapshot_tpu.dist_store import (
+    BarrierError,
+    InProcessStore,
+    LinearBarrier,
+    StoreTimeoutError,
+    TCPStore,
+)
+from torchsnapshot_tpu.pg_wrapper import PGWrapper
+from torchsnapshot_tpu.test_utils import ProcessGroup, get_free_port, multiprocess_test
+
+
+def test_tcp_store_primitives() -> None:
+    port = get_free_port()
+    server = TCPStore("127.0.0.1", port, is_server=True)
+    client = TCPStore("127.0.0.1", server.port, is_server=False)
+    try:
+        server.set("k", b"v")
+        assert client.try_get("k") == b"v"
+        assert client.try_get("missing") is None
+        assert client.add("ctr", 3) == 3
+        assert server.add("ctr", 2) == 5
+        client.delete("k")
+        assert server.try_get("k") is None
+        with pytest.raises(StoreTimeoutError):
+            client.get("never", timeout=0.2)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_store_collectives_threads() -> None:
+    """Exercise exchange/broadcast/scatter/barrier with threads sharing one
+    in-process store."""
+    store = InProcessStore()
+    world = 3
+    results = {}
+
+    def worker(rank: int) -> None:
+        pg = PGWrapper(ProcessGroup(store=store, rank=rank, world_size=world))
+        results[(rank, "ag")] = pg.all_gather_object(f"obj{rank}")
+        results[(rank, "bc")] = pg.broadcast_object(
+            "from0" if rank == 0 else None
+        )
+        results[(rank, "sc")] = pg.scatter_object_list(
+            [f"to{i}" for i in range(world)] if rank == 0 else None
+        )
+        pg.barrier()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for r in range(world):
+        assert results[(r, "ag")] == ["obj0", "obj1", "obj2"]
+        assert results[(r, "bc")] == "from0"
+        assert results[(r, "sc")] == f"to{r}"
+    # Collective keys are transient: nothing should linger.
+    assert store._kv == {}
+
+
+def test_linear_barrier_happy_path() -> None:
+    store = InProcessStore()
+    world = 3
+    order = []
+
+    def worker(rank: int) -> None:
+        b = LinearBarrier("test", store, rank, world)
+        b.arrive(timeout=10)
+        order.append(rank)
+        b.depart(timeout=10)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(order) == [0, 1, 2]
+    assert store._kv == {}  # cleaned up after depart
+
+
+def test_linear_barrier_error_propagation() -> None:
+    """A peer's report_error poisons every other rank's wait — no rank may
+    proceed to commit (reference dist_store.py:177-193)."""
+    store = InProcessStore()
+    world = 2
+    caught = {}
+
+    def rank0() -> None:
+        b = LinearBarrier("err", store, 0, world)
+        try:
+            b.arrive(timeout=10)
+        except BarrierError as e:
+            caught[0] = e
+
+    def rank1() -> None:
+        b = LinearBarrier("err", store, 1, world)
+        time.sleep(0.05)
+        b.report_error(RuntimeError("injected rank-1 failure"))
+
+    threads = [threading.Thread(target=rank0), threading.Thread(target=rank1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert 0 in caught
+    assert "injected rank-1 failure" in repr(caught[0].__cause__)
+
+
+def test_linear_barrier_timeout() -> None:
+    store = InProcessStore()
+    b = LinearBarrier("t", store, 0, 2)  # peer never arrives
+    with pytest.raises(StoreTimeoutError):
+        b.arrive(timeout=0.2)
+
+
+def test_barrier_depart_requires_arrive() -> None:
+    b = LinearBarrier("x", InProcessStore(), 0, 1)
+    with pytest.raises(RuntimeError, match="before arrive"):
+        b.depart()
+
+
+@multiprocess_test(nproc=2)
+def test_collectives_across_processes(pg) -> None:
+    wrapper = PGWrapper(pg)
+    gathered = wrapper.all_gather_object({"rank": pg.rank})
+    assert gathered == [{"rank": 0}, {"rank": 1}]
+    assert wrapper.broadcast_object("x" if pg.rank == 0 else None) == "x"
+    wrapper.barrier()

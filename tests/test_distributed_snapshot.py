@@ -1,0 +1,237 @@
+"""Multi-process distributed take/restore: per-rank state, replicated
+write-load partitioning, elastic world-size changes.
+
+Structural model: reference tests/test_ddp.py + test_replication_glob.py +
+test_partitioner.py distributed cases, on the TCP-store harness instead of
+gloo.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.manifest import SnapshotMetadata
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.test_utils import multiprocess_test
+
+
+def _dist_take(pg, path):
+    """Worker body: per-rank progress + replicated params."""
+    import jax.numpy as jnp
+
+    app_state = {
+        "params": ts.PyTreeState(
+            {"w": jnp.full((64, 8), 7.5, jnp.float32), "b": jnp.arange(8.0)}
+        ),
+        "progress": ts.StateDict(rank_steps=100 + pg.rank),
+    }
+    ts.Snapshot.take(path, app_state, pg=pg, replicated=["params/**"])
+    return path
+
+
+@multiprocess_test(nproc=2)
+def test_distributed_take_and_manifest(pg) -> None:
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "dist-take-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    app_state = {
+        "params": ts.PyTreeState(
+            {"w": jnp.full((64, 8), 7.5, jnp.float32), "b": jnp.arange(8.0)}
+        ),
+        "progress": ts.StateDict(rank_steps=100 + pg.rank),
+    }
+    snap = ts.Snapshot.take(path, app_state, pg=pg, replicated=["params/**"])
+
+    md = snap.metadata
+    assert md.world_size == 2
+    # Replicated entries live under rank 0 only; per-rank entries per rank.
+    assert md.manifest["0/params/w"].replicated
+    assert "1/params/w" not in md.manifest
+    assert "0/progress/rank_steps" in md.manifest
+    assert "1/progress/rank_steps" in md.manifest
+
+    # Write-load partitioning: replicated blobs exist exactly once on disk,
+    # and both ranks' write loads were used (w and b should not both land
+    # on rank 0 given b is tiny... the invariant that matters: one copy).
+    w_file = os.path.join(path, "replicated", "params", "w")
+    b_file = os.path.join(path, "replicated", "params", "b")
+    assert os.path.exists(w_file) and os.path.exists(b_file)
+
+    # Restore on both ranks into fresh state.
+    fresh = {
+        "params": ts.PyTreeState(
+            {"w": jnp.zeros((64, 8)), "b": jnp.zeros(8)}
+        ),
+        "progress": ts.StateDict(rank_steps=-1),
+    }
+    ts.Snapshot(path, pg=pg).restore(fresh)
+    assert float(fresh["params"].tree["w"][0, 0]) == 7.5
+    assert float(fresh["params"].tree["b"][5]) == 5.0
+    assert fresh["progress"]["rank_steps"] == 100 + pg.rank
+
+
+@multiprocess_test(nproc=2)
+def test_replicated_glob_must_match_everywhere(pg) -> None:
+    """A glob only some ranks declare is not treated as replicated
+    (reference _coalesce_path_and_replicated intersection semantics)."""
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "dist-glob-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    app_state = {"p": ts.PyTreeState({"w": jnp.ones(4)})}
+    replicated = ["p/**"] if pg.rank == 0 else []
+    snap = ts.Snapshot.take(path, app_state, pg=pg, replicated=replicated)
+    md = snap.metadata
+    # Not replicated anywhere -> per-rank entries on both ranks.
+    assert not md.manifest["0/p/w"].replicated
+    assert "1/p/w" in md.manifest
+
+
+@multiprocess_test(nproc=2)
+def test_elastic_restore_world2_to_world1_replicated(pg) -> None:
+    """World-size-2 snapshot restored by a single process: replicated state
+    is available; per-rank state of rank 1 is not visible to rank 0."""
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "dist-elastic-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    app_state = {
+        "params": ts.PyTreeState({"w": jnp.full(16, 3.0)}),
+        "progress": ts.StateDict(steps=pg.rank),
+    }
+    ts.Snapshot.take(path, app_state, pg=pg, replicated=["params/**"])
+
+    if pg.rank == 0:
+        # Single-process restore (no pg): world-size 1 vs snapshot world 2.
+        fresh = {
+            "params": ts.PyTreeState({"w": jnp.zeros(16)}),
+            "progress": ts.StateDict(steps=-1),
+        }
+        ts.Snapshot(path).restore(fresh)
+        assert float(fresh["params"].tree["w"][0]) == 3.0
+        assert fresh["progress"]["steps"] == 0  # rank 0's own value
+
+
+def test_partitioner_balances_loads() -> None:
+    """Unit-level: greedy assignment spreads replicated bytes by argmin load."""
+    from torchsnapshot_tpu.io_types import BufferStager, WriteReq
+    from torchsnapshot_tpu.partitioner import partition_write_reqs
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    class FakeStager(BufferStager):
+        def __init__(self, n):
+            self.n = n
+
+        async def stage_buffer(self, executor=None):
+            return b"x" * self.n
+
+        def get_staging_cost_bytes(self):
+            return self.n
+
+    class FakePG(PGWrapper):
+        """Rank 0 of a two-rank world simulated in one process: gathers
+        return symmetric data because replicated inputs are identical, and
+        rank 0's broadcast is the identity."""
+
+        def __init__(self, rank):
+            self.store = None
+            self.rank = rank
+            self.world_size = 2
+            self._op_seq = 0
+
+        def all_gather_object(self, obj):
+            return [obj, obj]
+
+        def broadcast_object(self, obj, src=0):
+            assert self.rank == src
+            return obj
+
+    reqs = [
+        WriteReq("replicated/a", FakeStager(100)),
+        WriteReq("replicated/b", FakeStager(90)),
+        WriteReq("replicated/c", FakeStager(10)),
+        WriteReq("0/own", FakeStager(5)),
+    ]
+    pg0 = FakePG(0)
+    _, kept0 = partition_write_reqs({}, list(reqs), pg0)
+    kept0_paths = {r.path for r in kept0}
+    assert "0/own" in kept0_paths
+    # Greedy: a(100)->r0? loads start [5,5]; a->rank0(or 1, tie -> 0),
+    # b(90)->other rank, c(10)-> lighter rank.
+    assert "replicated/a" in kept0_paths
+    assert "replicated/b" not in kept0_paths
+
+
+@multiprocess_test(nproc=2)
+def test_multiprocess_sharded_array(pg) -> None:
+    """True multi-host semantics: a global array sharded across two
+    *processes* (non-fully-addressable), each writing only its own shards,
+    restored with the roles reversed."""
+    import jax
+
+    coord_port = 29500 + (os.getpid() % 500) if pg.rank == 0 else None
+    coord_port = PGWrapper_bcast(pg, coord_port)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coord_port}",
+        num_processes=pg.world_size,
+        process_id=pg.rank,
+    )
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # One device per process (workers inherit the 8-virtual-device flag, so
+    # pick explicitly across process indices).
+    dev_by_proc = [
+        next(d for d in jax.devices() if d.process_index == p) for p in (0, 1)
+    ]
+    mesh = Mesh(np.array(dev_by_proc), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    full = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    xs = jax.make_array_from_callback((16, 4), sharding, lambda idx: full[idx])
+    assert not xs.is_fully_addressable
+
+    path = os.path.join(tempfile.gettempdir(), "dist-sharded-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    snap = ts.Snapshot.take(path, {"m": ts.PyTreeState({"w": xs})}, pg=pg)
+    md = snap.metadata
+    # Each rank contributed its own shard(s) under its own rank key.
+    all_shards = [
+        s for k, e in md.manifest.items() if e.type == "ShardedArray" for s in e.shards
+    ]
+    assert sorted(tuple(s.offsets) for s in all_shards) == [(0, 0), (8, 0)]
+
+    # Restore into a reversed device order (different box per process).
+    mesh2 = Mesh(np.array(dev_by_proc[::-1]), ("x",))
+    sharding2 = NamedSharding(mesh2, P("x"))
+    target = jax.make_array_from_callback(
+        (16, 4), sharding2, lambda idx: np.zeros((8, 4), np.float32)
+    )
+    fresh = {"m": ts.PyTreeState({"w": target})}
+    ts.Snapshot(path, pg=pg).restore(fresh)
+    w = fresh["m"].tree["w"]
+    local = {tuple(int(x) for x in s.index[0].indices(16)[:2]): np.asarray(s.data) for s in w.addressable_shards}
+    for (start, stop), data in local.items():
+        np.testing.assert_array_equal(data, full[start:stop])
+
+
+def PGWrapper_bcast(pg, value):
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    return PGWrapper(pg).broadcast_object(value)

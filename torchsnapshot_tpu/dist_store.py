@@ -326,9 +326,10 @@ class JaxCoordinationStore(Store):
     """KV store over the JAX distributed coordination service.
 
     Usable once ``jax.distributed.initialize`` has run; rides DCN like the
-    rest of JAX's control plane. The coordination service has no atomic
-    add, so counters are emulated with a leader-side mutex key pattern —
-    cheap at snapshot frequencies (a handful of ops per take/restore).
+    rest of JAX's control plane. Atomic counters require the coordination
+    client's ``key_value_increment`` (present in current jaxlib); on an
+    older jaxlib without it, ``add`` raises and snapshot coordination
+    should use :class:`TCPStore` instead.
     """
 
     def __init__(self) -> None:
@@ -341,7 +342,6 @@ class JaxCoordinationStore(Store):
                 "JaxCoordinationStore requires a coordinator"
             )
         self._client = client
-        self._counter_lock = threading.Lock()
 
     def set(self, key: str, value: bytes) -> None:
         self._client.key_value_set_bytes(key, value)
@@ -353,8 +353,6 @@ class JaxCoordinationStore(Store):
             return None
 
     def add(self, key: str, amount: int) -> int:
-        # The coordination service exposes no atomic integer add; emulate
-        # with its compare-and-swap-free increment endpoint if present.
         inc = getattr(self._client, "key_value_increment", None)
         if inc is not None:
             return int(inc(key, amount))

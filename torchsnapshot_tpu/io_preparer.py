@@ -304,18 +304,20 @@ def chunk_shapes(
 ) -> List[Tuple[int, int]]:
     """Split dim 0 into ``[start, stop)`` row ranges of at most the chunk
     budget (rows larger than the budget stay whole — reference
-    chunk_tensor, io_preparer.py:72-100)."""
+    chunk_tensor, io_preparer.py:72-100). Delegates to the shared
+    dim-0 box-splitting in parallel/overlap.py so dense chunking and
+    sharded-shard subdivision cannot drift apart."""
+    from .parallel.overlap import Box, subdivide_box
+    from .serialization import string_to_dtype
+
     if not shape or shape[0] <= 1:
         return [(0, shape[0] if shape else 0)]
-    rows = shape[0]
-    row_bytes = array_size_bytes(shape[1:], dtype) if len(shape) > 1 else (
-        array_size_bytes([1], dtype)
+    pieces = subdivide_box(
+        Box(tuple(0 for _ in shape), tuple(shape)),
+        max_chunk_size_bytes,
+        string_to_dtype(dtype).itemsize,
     )
-    rows_per_chunk = max(1, max_chunk_size_bytes // max(1, row_bytes))
-    return [
-        (start, min(start + rows_per_chunk, rows))
-        for start in range(0, rows, rows_per_chunk)
-    ]
+    return [(p.offsets[0], p.offsets[0] + p.sizes[0]) for p in pieces]
 
 
 class ChunkedArrayIOPreparer:

@@ -1,0 +1,107 @@
+"""N-dimensional shard-overlap math for elastic resharding.
+
+Reference parity: ``_shards_get_overlap_region_wrt_saved_tensor``
+(io_preparer.py:200-247) — but generalized. The reference only handles
+enumerable 1-d chunk specs; GSPMD shardings produce arbitrary N-d
+hyper-rectangles (mesh axes over any dims, replicated × sharded mixes,
+uneven remainders), so overlap here is a per-dimension interval
+intersection over N-d boxes.
+
+A *box* is ``(offsets, sizes)`` — the hyper-rectangle
+``[offsets[d], offsets[d] + sizes[d])`` per dimension.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Box:
+    offsets: Tuple[int, ...]
+    sizes: Tuple[int, ...]
+
+    @classmethod
+    def from_index(
+        cls, index: Sequence[slice], shape: Sequence[int]
+    ) -> "Box":
+        """Build a box from a jax ``devices_indices_map`` index (a tuple of
+        slices with possibly-None bounds)."""
+        offsets = []
+        sizes = []
+        for slc, dim in zip(index, shape):
+            start = 0 if slc.start is None else int(slc.start)
+            stop = int(dim) if slc.stop is None else int(slc.stop)
+            offsets.append(start)
+            sizes.append(stop - start)
+        # 0-d arrays / fully-replicated indices shorter than rank:
+        for dim in shape[len(index) :]:
+            offsets.append(0)
+            sizes.append(int(dim))
+        return cls(tuple(offsets), tuple(sizes))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.offsets)
+
+    def numel(self) -> int:
+        n = 1
+        for s in self.sizes:
+            n *= s
+        return n
+
+    def to_index(self) -> Tuple[slice, ...]:
+        return tuple(
+            slice(o, o + s) for o, s in zip(self.offsets, self.sizes)
+        )
+
+
+@dataclass(frozen=True)
+class Overlap:
+    """The intersection of a saved box and a destination box, expressed in
+    each one's local coordinates."""
+
+    src_slices: Tuple[slice, ...]  # into the saved shard's local array
+    dst_slices: Tuple[slice, ...]  # into the destination box's local array
+
+
+def box_overlap(saved: Box, dst: Box) -> Optional[Overlap]:
+    """Per-dimension interval intersection; None when disjoint."""
+    if saved.ndim != dst.ndim:
+        raise ValueError(
+            f"Rank mismatch: saved box has {saved.ndim} dims, destination "
+            f"has {dst.ndim}"
+        )
+    src_slices: List[slice] = []
+    dst_slices: List[slice] = []
+    for d in range(saved.ndim):
+        lo = max(saved.offsets[d], dst.offsets[d])
+        hi = min(
+            saved.offsets[d] + saved.sizes[d], dst.offsets[d] + dst.sizes[d]
+        )
+        if hi <= lo:
+            return None
+        src_slices.append(slice(lo - saved.offsets[d], hi - saved.offsets[d]))
+        dst_slices.append(slice(lo - dst.offsets[d], hi - dst.offsets[d]))
+    return Overlap(tuple(src_slices), tuple(dst_slices))
+
+
+def subdivide_box(box: Box, max_bytes: int, itemsize: int) -> List[Box]:
+    """Split a box along dim 0 into pieces of at most ``max_bytes``
+    (reference subdivide_shard, io_preparer.py:168-198; rows larger than the
+    budget stay whole)."""
+    if box.numel() * itemsize <= max_bytes or box.ndim == 0 or box.sizes[0] <= 1:
+        return [box]
+    row_elems = box.numel() // box.sizes[0]
+    rows_per_piece = max(1, max_bytes // max(1, row_elems * itemsize))
+    pieces = []
+    for start in range(0, box.sizes[0], rows_per_piece):
+        rows = min(rows_per_piece, box.sizes[0] - start)
+        pieces.append(
+            Box(
+                offsets=(box.offsets[0] + start,) + box.offsets[1:],
+                sizes=(rows,) + box.sizes[1:],
+            )
+        )
+    return pieces

@@ -1,26 +1,352 @@
-"""Sharded-array preparer: NamedSharding shards -> per-shard writes, elastic
-resharding on restore. (Implementation lands with the distributed layer;
-this placeholder keeps dispatch importable.)
+"""Sharded-array preparer: GSPMD-partitioned ``jax.Array`` checkpointing
+with elastic resharding on restore.
 
-Reference parity target: ShardedTensorIOPreparer (io_preparer.py:167-391).
+Reference parity: ShardedTensorIOPreparer (io_preparer.py:167-391) — but
+where the reference walks torch ``ShardedTensor`` chunk specs on one
+dimension, a single ``NamedSharding``-driven preparer covers every GSPMD
+layout uniformly (FSDP, TP, row/column-wise embedding sharding, sequence-dim
+sharding, replicated × sharded mixes, uneven remainders): the analysis in
+SURVEY.md §2.12.
+
+Write side:
+- ``addressable_shards`` yields this process's device shards; exactly one
+  *global* copy of each distinct shard box is written, elected by
+  ``replica_id == 0`` (each box's replica-0 device lives on exactly one
+  process, so no coordination round is needed for deduplication — the
+  write-once analog of the reference's replicated partitioning).
+- Boxes larger than the shard-size knob subdivide along dim 0 (reference
+  subdivide_shard, io_preparer.py:168-198).
+- The device→host DMA is started asynchronously at prepare time
+  (``copy_to_host_async``), so all shards' transfers overlap each other and
+  storage I/O.
+
+Read side (resharding):
+- The destination layout comes from the *current* leaf's sharding (or a
+  host array for ``read_object``); every persisted shard that overlaps a
+  locally-addressable destination box is read once and its overlap regions
+  copied out (reference groups reads the same way, io_preparer.py:317-391).
+- When an overlap is a contiguous row range of the saved shard, a ranged
+  read fetches only those bytes.
+- ``finalize`` assembles the restored host boxes into a ``jax.Array`` via
+  ``jax.make_array_from_single_device_arrays`` — one H2D per addressable
+  device, no full-array host materialization.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple
+import asyncio
+from concurrent.futures import Executor
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .io_types import ReadReq, WriteReq
-from .manifest import Entry, ShardedArrayEntry
+import numpy as np
+
+from . import knobs
+from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .manifest import ArrayEntry, Shard, ShardedArrayEntry
+from .parallel.overlap import Box, Overlap, box_overlap, subdivide_box
+from .serialization import (
+    Serializer,
+    array_as_memoryview,
+    array_from_memoryview,
+    array_size_bytes,
+    dtype_to_string,
+)
+
+
+def _shard_location(logical_path: str, box: Box) -> str:
+    """Storage path for one shard box: ``sharded/{path}_{offsets}``
+    (reference uses a ``sharded/`` prefix too, io_preparer.py:849-855)."""
+    suffix = "_".join(str(o) for o in box.offsets) or "scalar"
+    return f"sharded/{logical_path}_{suffix}"
+
+
+class _ShardBufferStager(BufferStager):
+    """Stages one (possibly row-sliced) device shard."""
+
+    def __init__(self, shard_data: Any, rows: Optional[Tuple[int, int]]) -> None:
+        self.shard_data = shard_data
+        self.rows = rows
+        try:
+            shard_data.copy_to_host_async()
+        except Exception:
+            pass
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(executor, self._stage_sync)
+
+    def _stage_sync(self) -> BufferType:
+        data = self.shard_data
+        if self.rows is not None:
+            data = data[self.rows[0] : self.rows[1]]
+        host = np.ascontiguousarray(np.asarray(data))
+        self.shard_data = None
+        return array_as_memoryview(host)
+
+    def get_staging_cost_bytes(self) -> int:
+        shape = list(self.shard_data.shape)
+        if self.rows is not None and shape:
+            shape[0] = self.rows[1] - self.rows[0]
+        return int(
+            np.dtype(self.shard_data.dtype).itemsize
+            * np.prod(shape, dtype=np.int64)
+        )
+
+
+class _OverlapConsumer(BufferConsumer):
+    """Deserializes one saved shard (or a row range of it) and copies every
+    overlap region into its destination view (reference
+    ShardedTensorBufferConsumer, io_preparer.py:460-492)."""
+
+    def __init__(
+        self,
+        dtype: str,
+        buf_shape: Tuple[int, ...],
+        copies: List[Tuple[np.ndarray, Tuple[slice, ...]]],
+    ) -> None:
+        self.dtype = dtype
+        self.buf_shape = buf_shape
+        self.copies = copies  # (dst_view, src_slices into the read buffer)
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(executor, self._consume_sync, buf)
+
+    def _consume_sync(self, buf: BufferType) -> None:
+        src = array_from_memoryview(buf, self.dtype, self.buf_shape)
+        for dst_view, src_slices in self.copies:
+            np.copyto(dst_view, src[src_slices], casting="no")
+
+    def get_consuming_cost_bytes(self) -> int:
+        return array_size_bytes(self.buf_shape, self.dtype)
 
 
 class ShardedArrayIOPreparer:
+    # ------------------------------------------------------------------
+    # write
+    # ------------------------------------------------------------------
+
     @staticmethod
     def prepare_write(
         obj: Any, logical_path: str, is_async_snapshot: bool
-    ) -> Tuple[Entry, List[WriteReq]]:
-        raise NotImplementedError(
-            "Sharded jax.Array checkpointing lands with the distributed layer"
+    ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
+        dtype_str = dtype_to_string(obj.dtype)
+        itemsize = np.dtype(obj.dtype).itemsize
+        max_shard = knobs.get_max_shard_size_bytes()
+        shards: List[Shard] = []
+        write_reqs: List[WriteReq] = []
+
+        for dev_shard in obj.addressable_shards:
+            # Write-once election: the replica-0 copy of each box exists on
+            # exactly one device globally.
+            if dev_shard.replica_id != 0:
+                continue
+            box = Box.from_index(dev_shard.index, obj.shape)
+            for piece in subdivide_box(box, max_shard, itemsize):
+                location = _shard_location(logical_path, piece)
+                rows: Optional[Tuple[int, int]] = None
+                if piece != box:
+                    row0 = piece.offsets[0] - box.offsets[0]
+                    rows = (row0, row0 + piece.sizes[0])
+                shards.append(
+                    Shard(
+                        offsets=list(piece.offsets),
+                        sizes=list(piece.sizes),
+                        array=ArrayEntry(
+                            location=location,
+                            serializer=Serializer.BUFFER_PROTOCOL.value,
+                            dtype=dtype_str,
+                            shape=list(piece.sizes),
+                            replicated=False,
+                        ),
+                    )
+                )
+                write_reqs.append(
+                    WriteReq(
+                        path=location,
+                        buffer_stager=_ShardBufferStager(dev_shard.data, rows),
+                    )
+                )
+
+        entry = ShardedArrayEntry(
+            dtype=dtype_str,
+            shape=[int(d) for d in obj.shape],
+            shards=shards,
         )
+        return entry, write_reqs
+
+    # ------------------------------------------------------------------
+    # read
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _destination_boxes(
+        entry: ShardedArrayEntry, current_leaf: Any
+    ) -> Tuple[Dict[Box, np.ndarray], Optional[Callable[[Dict[Box, np.ndarray]], Any]]]:
+        """Host buffers to read into, keyed by destination box, plus an
+        assembler back to the application's leaf flavor."""
+        from .serialization import string_to_dtype
+
+        np_dtype = string_to_dtype(entry.dtype)
+        shape = tuple(entry.shape)
+
+        from .io_preparer import is_jax_array
+
+        if is_jax_array(current_leaf):
+            import jax
+
+            sharding = current_leaf.sharding
+            target_shape = tuple(current_leaf.shape)
+            if target_shape != shape:
+                raise ValueError(
+                    f"Cannot reshard a saved array of shape {list(shape)} "
+                    f"into a leaf of shape {list(target_shape)}"
+                )
+            indices = sharding.addressable_devices_indices_map(shape)
+            boxes: Dict[Box, np.ndarray] = {}
+            device_to_box: Dict[Any, Box] = {}
+            for device, index in indices.items():
+                box = Box.from_index(index, shape)
+                if box not in boxes:
+                    boxes[box] = np.empty(box.sizes, dtype=np_dtype)
+                device_to_box[device] = box
+
+            def assemble(filled: Dict[Box, np.ndarray]) -> Any:
+                arrays = [
+                    jax.device_put(filled[device_to_box[d]], d)
+                    for d in device_to_box
+                ]
+                return jax.make_array_from_single_device_arrays(
+                    shape, sharding, arrays
+                )
+
+            return boxes, assemble
+
+        # Host destination (np.ndarray in-place, or fresh allocation).
+        if isinstance(current_leaf, np.ndarray):
+            if tuple(current_leaf.shape) != shape or current_leaf.dtype != np_dtype:
+                raise ValueError(
+                    f"Destination array (shape {current_leaf.shape}, dtype "
+                    f"{current_leaf.dtype}) does not match saved sharded "
+                    f"array (shape {list(shape)}, dtype {entry.dtype})"
+                )
+            full = current_leaf
+        else:
+            full = np.empty(shape, dtype=np_dtype)
+        full_box = Box(tuple(0 for _ in shape), shape)
+        return {full_box: full}, (lambda filled: filled[full_box])
+
+    @staticmethod
+    def prepare_read_into(
+        entry: ShardedArrayEntry,
+        current_leaf: Any,
+        restored: Dict[str, Any],
+        path: str,
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> Tuple[List[ReadReq], Optional[Callable[[], None]]]:
+        """Build resharding reads into ``restored[path]``; the returned
+        finalize callback must run after the reads complete."""
+        boxes, assemble = ShardedArrayIOPreparer._destination_boxes(
+            entry, current_leaf
+        )
+        read_reqs: List[ReadReq] = []
+
+        for saved in entry.shards:
+            saved_box = Box(tuple(saved.offsets), tuple(saved.sizes))
+            overlaps: List[Tuple[np.ndarray, Overlap]] = []
+            for dst_box, dst_buf in boxes.items():
+                ov = box_overlap(saved_box, dst_box)
+                if ov is not None:
+                    overlaps.append((dst_buf[ov.dst_slices], ov))
+            if not overlaps:
+                continue
+            read_reqs.extend(
+                ShardedArrayIOPreparer._reqs_for_saved_shard(
+                    saved, saved_box, overlaps, buffer_size_limit_bytes
+                )
+            )
+
+        def finalize() -> None:
+            restored[path] = assemble(boxes)
+
+        return read_reqs, finalize
+
+    @staticmethod
+    def _reqs_for_saved_shard(
+        saved: Shard,
+        saved_box: Box,
+        overlaps: List[Tuple[np.ndarray, Overlap]],
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> List[ReadReq]:
+        """Reads for one saved shard feeding all its overlap regions.
+
+        When every overlap spans full trailing dims (the dominant
+        row-sharded resharding pattern), the read shrinks to the covered
+        row range and — under a buffer size limit — splits into multiple
+        ranged reads so host memory stays bounded. Overlaps that slice
+        trailing dims fall back to one whole-shard read (a partial-column
+        read is not a contiguous byte range)."""
+        entry = saved.array
+        shard_shape = tuple(saved_box.sizes)
+
+        full_trailing = shard_shape and all(
+            ov.src_slices[1:] == tuple(slice(0, s) for s in shard_shape[1:])
+            for _, ov in overlaps
+        )
+
+        if full_trailing:
+            row_lo = min(ov.src_slices[0].start for _, ov in overlaps)
+            row_hi = max(ov.src_slices[0].stop for _, ov in overlaps)
+            row_bytes = array_size_bytes(shard_shape[1:], entry.dtype)
+            total = (row_hi - row_lo) * row_bytes
+            rows_per_read = row_hi - row_lo
+            if buffer_size_limit_bytes is not None and total > buffer_size_limit_bytes:
+                rows_per_read = max(1, buffer_size_limit_bytes // max(1, row_bytes))
+            if row_lo > 0 or row_hi < shard_shape[0] or rows_per_read < (
+                row_hi - row_lo
+            ):
+                base = entry.byte_range_tuple[0] if entry.byte_range_tuple else 0
+                reqs = []
+                for p0 in range(row_lo, row_hi, rows_per_read):
+                    p1 = min(p0 + rows_per_read, row_hi)
+                    copies = []
+                    for dst_view, ov in overlaps:
+                        a, b = ov.src_slices[0].start, ov.src_slices[0].stop
+                        m0, m1 = max(a, p0), min(b, p1)
+                        if m1 <= m0:
+                            continue
+                        copies.append(
+                            (
+                                dst_view[m0 - a : m1 - a],
+                                (slice(m0 - p0, m1 - p0),) + ov.src_slices[1:],
+                            )
+                        )
+                    reqs.append(
+                        ReadReq(
+                            path=entry.location,
+                            buffer_consumer=_OverlapConsumer(
+                                entry.dtype,
+                                (p1 - p0,) + shard_shape[1:],
+                                copies,
+                            ),
+                            byte_range=(
+                                base + p0 * row_bytes,
+                                base + p1 * row_bytes,
+                            ),
+                        )
+                    )
+                return reqs
+
+        copies = [(dst_view, ov.src_slices) for dst_view, ov in overlaps]
+        return [
+            ReadReq(
+                path=entry.location,
+                buffer_consumer=_OverlapConsumer(entry.dtype, shard_shape, copies),
+                byte_range=entry.byte_range_tuple,
+            )
+        ]
 
     @staticmethod
     def prepare_read(
@@ -28,17 +354,17 @@ class ShardedArrayIOPreparer:
         obj_out: Optional[Any],
         buffer_size_limit_bytes: Optional[int] = None,
     ) -> List[ReadReq]:
-        raise NotImplementedError(
-            "Sharded jax.Array checkpointing lands with the distributed layer"
+        """Reference-shaped API: reads in place into an ``np.ndarray``.
+        Callers needing jax assembly must use :meth:`prepare_read_into`
+        (whose finalize callback this entry point cannot run)."""
+        if not isinstance(obj_out, np.ndarray):
+            raise ValueError(
+                f"Reading a sharded entry through prepare_read requires an "
+                f"np.ndarray destination (got {type(obj_out)}); use "
+                f"prepare_read_into for jax.Array assembly"
+            )
+        restored: Dict[str, Any] = {}
+        reqs, _ = ShardedArrayIOPreparer.prepare_read_into(
+            entry, obj_out, restored, "__out__", buffer_size_limit_bytes
         )
-
-    @staticmethod
-    def prepare_read_into(
-        entry: ShardedArrayEntry,
-        current_leaf: Optional[Any],
-        restored: dict,
-        path: str,
-    ):
-        raise NotImplementedError(
-            "Sharded jax.Array checkpointing lands with the distributed layer"
-        )
+        return reqs

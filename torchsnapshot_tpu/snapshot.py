@@ -484,7 +484,11 @@ class Snapshot:
                 from .sharded_io_preparer import ShardedArrayIOPreparer
 
                 read_reqs, finalize = ShardedArrayIOPreparer.prepare_read_into(
-                    entry, obj_out, restored, result_path
+                    entry,
+                    obj_out,
+                    restored,
+                    result_path,
+                    buffer_size_limit_bytes=memory_budget_bytes,
                 )
             else:
                 assert isinstance(entry, (ArrayEntry, ChunkedArrayEntry))
@@ -597,7 +601,9 @@ class PendingSnapshot:
         self._thread.join()
         if self._exc_info is not None:
             raise self._exc_info
-        snapshot = Snapshot(path=self.path)
+        # Preserve the process group: restore() on the returned snapshot
+        # must keep per-rank availability and coordination semantics.
+        snapshot = Snapshot(path=self.path, pg=self.pg)
         snapshot._metadata = self._metadata
         return snapshot
 
@@ -747,8 +753,17 @@ def _restore_destination(
         import jax
 
         sharding = current_leaf.sharding
+        # Uncommitted leaves (e.g. optax step counters created by plain
+        # jnp ops) must stay uncommitted: committing them to a concrete
+        # device makes the restored state unusable in a jit alongside
+        # differently-placed arrays.
+        committed = getattr(current_leaf, "_committed", True)
 
         def convert(host: np.ndarray) -> Any:
+            if not committed:
+                import jax.numpy as jnp
+
+                return jnp.asarray(host)
             return jax.device_put(host, sharding)
 
         return dst, convert
