@@ -1,0 +1,150 @@
+"""Async take: staging-unblock semantics, background commit, and the
+no-commit-marker-on-failure invariant.
+
+Structural model: reference tests/test_async_take.py:25-115 — subclassed
+slow/faulty FS plugins patched in, asserting a failed async take leaves no
+``.snapshot_metadata``.
+"""
+
+import asyncio
+import os
+import tempfile
+import time
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.io_types import WriteIO
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.test_utils import multiprocess_test
+
+
+class SlowFSStoragePlugin(FSStoragePlugin):
+    DELAY_S = 0.3
+
+    async def write(self, write_io: WriteIO) -> None:
+        if write_io.path != SNAPSHOT_METADATA_FNAME:
+            await asyncio.sleep(self.DELAY_S)
+        await super().write(write_io)
+
+
+class FaultyFSStoragePlugin(FSStoragePlugin):
+    async def write(self, write_io: WriteIO) -> None:
+        if write_io.path != SNAPSHOT_METADATA_FNAME:
+            await asyncio.sleep(0.05)
+            raise OSError("injected storage failure")
+        await super().write(write_io)
+
+
+def _patch_plugin(cls):
+    return mock.patch(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
+        side_effect=lambda url: cls(root=url.split("://")[-1]),
+    )
+
+
+def test_async_take_roundtrip(tmp_path) -> None:
+    app_state = {
+        "p": ts.PyTreeState({"w": jnp.arange(128.0)}),
+        "prog": ts.StateDict(step=9),
+    }
+    pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+    snapshot = pending.wait()
+    assert pending.done()
+    fresh = {"p": ts.PyTreeState({"w": jnp.zeros(128)}), "prog": ts.StateDict(step=0)}
+    snapshot.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh["p"].tree["w"]), np.arange(128.0))
+    assert fresh["prog"]["step"] == 9
+
+
+def test_async_take_unblocks_before_io(tmp_path) -> None:
+    with _patch_plugin(SlowFSStoragePlugin):
+        app_state = {"p": ts.PyTreeState({"w": jnp.ones(64)})}
+        t0 = time.monotonic()
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        returned_at = time.monotonic() - t0
+        # Returned before the (deliberately slow) storage write finished...
+        assert returned_at < SlowFSStoragePlugin.DELAY_S
+        assert not os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+        # ...and the commit marker appears only after wait().
+        pending.wait()
+    assert os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+
+
+def test_failed_async_take_leaves_no_commit_marker(tmp_path) -> None:
+    with _patch_plugin(FaultyFSStoragePlugin):
+        app_state = {"p": ts.PyTreeState({"w": jnp.ones(64)})}
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        with pytest.raises(OSError, match="injected storage failure"):
+            pending.wait()
+    assert not os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+    # The failed location is restorable-from never: metadata access fails.
+    with pytest.raises(FileNotFoundError):
+        _ = ts.Snapshot(str(tmp_path)).metadata
+
+
+def test_async_take_numpy_mutation_consistency(tmp_path) -> None:
+    """Mutable (numpy) leaves must be snapshotted at async_take time even if
+    the application mutates them before I/O completes (reference defensive
+    copy semantics, io_preparer.py:555-565)."""
+    arr = np.full((32,), 1.0)
+    app_state = {"s": ts.StateDict(arr=arr)}
+    with _patch_plugin(SlowFSStoragePlugin):
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        arr[:] = -1.0  # mutate after staging returned
+        snapshot = pending.wait()
+    fresh = {"s": ts.StateDict(arr=np.zeros(32))}
+    snapshot.restore(fresh)
+    np.testing.assert_array_equal(fresh["s"]["arr"], np.full((32,), 1.0))
+
+
+@multiprocess_test(nproc=2)
+def test_async_take_peer_failure_no_commit(pg) -> None:
+    """Rank 1's storage fails; the store-barrier propagates the error so
+    rank 0 must not write the commit marker."""
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "async-fail-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+
+    plugin_cls = FaultyFSStoragePlugin if pg.rank == 1 else FSStoragePlugin
+    app_state = {"prog": ts.StateDict(rank=pg.rank), "p": ts.PyTreeState({"w": jnp.ones(8)})}
+    with mock.patch(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
+        side_effect=lambda url: plugin_cls(root=url.split("://")[-1]),
+    ):
+        pending = ts.Snapshot.async_take(path, app_state, pg=pg)
+        with pytest.raises(Exception):
+            pending.wait()
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+
+
+@multiprocess_test(nproc=2)
+def test_async_take_distributed_commit(pg) -> None:
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "async-ok-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+    app_state = {"prog": ts.StateDict(rank=pg.rank)}
+    pending = ts.Snapshot.async_take(path, app_state, pg=pg)
+    snapshot = pending.wait()
+    assert os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+    fresh = {"prog": ts.StateDict(rank=-1)}
+    snapshot.restore(fresh)
+    assert fresh["prog"]["rank"] == pg.rank

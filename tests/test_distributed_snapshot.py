@@ -18,20 +18,6 @@ from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
 from torchsnapshot_tpu.test_utils import multiprocess_test
 
 
-def _dist_take(pg, path):
-    """Worker body: per-rank progress + replicated params."""
-    import jax.numpy as jnp
-
-    app_state = {
-        "params": ts.PyTreeState(
-            {"w": jnp.full((64, 8), 7.5, jnp.float32), "b": jnp.arange(8.0)}
-        ),
-        "progress": ts.StateDict(rank_steps=100 + pg.rank),
-    }
-    ts.Snapshot.take(path, app_state, pg=pg, replicated=["params/**"])
-    return path
-
-
 @multiprocess_test(nproc=2)
 def test_distributed_take_and_manifest(pg) -> None:
     import jax.numpy as jnp

@@ -41,12 +41,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import knobs
-from .io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
+from .io_types import BufferConsumer, BufferType, ReadReq, WriteReq
 from .manifest import ArrayEntry, Shard, ShardedArrayEntry
 from .parallel.overlap import Box, Overlap, box_overlap, subdivide_box
 from .serialization import (
     Serializer,
-    array_as_memoryview,
     array_from_memoryview,
     array_size_bytes,
     dtype_to_string,
@@ -58,39 +57,6 @@ def _shard_location(logical_path: str, box: Box) -> str:
     (reference uses a ``sharded/`` prefix too, io_preparer.py:849-855)."""
     suffix = "_".join(str(o) for o in box.offsets) or "scalar"
     return f"sharded/{logical_path}_{suffix}"
-
-
-class _ShardBufferStager(BufferStager):
-    """Stages one (possibly row-sliced) device shard."""
-
-    def __init__(self, shard_data: Any, rows: Optional[Tuple[int, int]]) -> None:
-        self.shard_data = shard_data
-        self.rows = rows
-        try:
-            shard_data.copy_to_host_async()
-        except Exception:
-            pass
-
-    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(executor, self._stage_sync)
-
-    def _stage_sync(self) -> BufferType:
-        data = self.shard_data
-        if self.rows is not None:
-            data = data[self.rows[0] : self.rows[1]]
-        host = np.ascontiguousarray(np.asarray(data))
-        self.shard_data = None
-        return array_as_memoryview(host)
-
-    def get_staging_cost_bytes(self) -> int:
-        shape = list(self.shard_data.shape)
-        if self.rows is not None and shape:
-            shape[0] = self.rows[1] - self.rows[0]
-        return int(
-            np.dtype(self.shard_data.dtype).itemsize
-            * np.prod(shape, dtype=np.int64)
-        )
 
 
 class _OverlapConsumer(BufferConsumer):
@@ -138,6 +104,8 @@ class ShardedArrayIOPreparer:
         shards: List[Shard] = []
         write_reqs: List[WriteReq] = []
 
+        from .io_preparer import ArrayBufferStager
+
         for dev_shard in obj.addressable_shards:
             # Write-once election: the replica-0 copy of each box exists on
             # exactly one device globally.
@@ -146,10 +114,10 @@ class ShardedArrayIOPreparer:
             box = Box.from_index(dev_shard.index, obj.shape)
             for piece in subdivide_box(box, max_shard, itemsize):
                 location = _shard_location(logical_path, piece)
-                rows: Optional[Tuple[int, int]] = None
+                slc: Optional[slice] = None
                 if piece != box:
                     row0 = piece.offsets[0] - box.offsets[0]
-                    rows = (row0, row0 + piece.sizes[0])
+                    slc = slice(row0, row0 + piece.sizes[0])
                 shards.append(
                     Shard(
                         offsets=list(piece.offsets),
@@ -163,10 +131,15 @@ class ShardedArrayIOPreparer:
                         ),
                     )
                 )
+                # ArrayBufferStager prefetches D2H only for whole-shard
+                # writes (slc None); subdivided pieces transfer lazily so
+                # the shard-size knob's memory bound holds.
                 write_reqs.append(
                     WriteReq(
                         path=location,
-                        buffer_stager=_ShardBufferStager(dev_shard.data, rows),
+                        buffer_stager=ArrayBufferStager(
+                            dev_shard.data, is_async_snapshot, slc=slc
+                        ),
                     )
                 )
 

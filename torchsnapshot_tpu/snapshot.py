@@ -403,7 +403,11 @@ class Snapshot:
                 from .sharded_io_preparer import ShardedArrayIOPreparer
 
                 reqs, finalize = ShardedArrayIOPreparer.prepare_read_into(
-                    entry, current_leaf, restored, path
+                    entry,
+                    current_leaf,
+                    restored,
+                    path,
+                    buffer_size_limit_bytes=memory_budget_bytes,
                 )
                 read_reqs.extend(reqs)
                 if finalize is not None:
