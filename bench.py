@@ -1,0 +1,102 @@
+"""Headline benchmark: checkpoint save throughput (GB/s) from TPU HBM to
+local FS, the analog of the reference's DDP benchmark
+(benchmarks/ddp/README.md: 20 GB model, 1 node x 1 GPU -> ~13.91 s,
+~1.4 GB/s on local FS — BASELINE.md).
+
+Prints ONE JSON line:
+    {"metric": "checkpoint_save_throughput", "value": N, "unit": "GB/s",
+     "vs_baseline": N}
+
+vs_baseline is the ratio against the reference's single-accelerator
+local-FS number (1.4 GB/s). Size configurable via TS_BENCH_GB (default 4).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+
+import torchsnapshot_tpu as ts
+
+REFERENCE_SINGLE_ACCEL_GBPS = 20.0 / 13.91  # benchmarks/ddp/README.md:17
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_state(total_bytes: int) -> dict:
+    """A pytree of bf16 arrays totaling ~total_bytes on device, shaped like
+    transformer params (a few large 2-d weights + long 1-d tails)."""
+    key = jax.random.PRNGKey(0)
+    arrays = {}
+    # 256 MiB bf16 blocks: (16384, 8192) * 2 bytes
+    block_bytes = 16384 * 8192 * 2
+    n_blocks = max(1, total_bytes // block_bytes)
+    for i in range(n_blocks):
+        key, sub = jax.random.split(key)
+        arrays[f"w{i}"] = jax.random.normal(
+            sub, (16384, 8192), dtype=jnp.bfloat16
+        )
+    arrays["bias"] = jnp.ones((65536,), dtype=jnp.float32)
+    jax.block_until_ready(arrays)
+    return arrays
+
+
+def main() -> None:
+    gb = float(os.environ.get("TS_BENCH_GB", "1"))
+    total_bytes = int(gb * (1 << 30))
+    _log(f"bench: materializing ~{gb:.1f} GiB of bf16 state on {jax.devices()[0]}")
+    state = make_state(total_bytes)
+    nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+
+    # Context line: raw single-stream D2H bandwidth. On tunneled devices
+    # (axon dev setup) this caps checkpoint throughput far below what the
+    # pipeline achieves on locally-attached TPU hosts.
+    probe = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), jnp.bfloat16)
+    jax.block_until_ready(probe)
+    t0 = time.perf_counter()
+    import numpy as np
+
+    np.asarray(probe)
+    d2h = probe.nbytes / (1 << 30) / (time.perf_counter() - t0)
+    _log(f"bench: raw single-stream D2H = {d2h:.3f} GB/s")
+
+    workdir = tempfile.mkdtemp(prefix="ts_bench_", dir="/tmp")
+    try:
+        # Warm-up on a small state: first-take costs (event loop, thread
+        # pools, XLA transfer program) should not pollute the measurement.
+        warm = {"x": jnp.ones((1024, 1024), jnp.bfloat16)}
+        ts.Snapshot.take(os.path.join(workdir, "warm"), {"s": ts.PyTreeState(warm)})
+
+        path = os.path.join(workdir, "snap")
+        start = time.perf_counter()
+        ts.Snapshot.take(path, {"state": ts.PyTreeState(state)})
+        elapsed = time.perf_counter() - start
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    gbps = nbytes / (1 << 30) / elapsed
+    _log(
+        f"bench: wrote {nbytes / (1 << 30):.2f} GiB in {elapsed:.2f} s "
+        f"({gbps:.2f} GB/s)"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "checkpoint_save_throughput",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / REFERENCE_SINGLE_ACCEL_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -119,10 +119,7 @@ def test_async_take_peer_failure_no_commit(pg) -> None:
 
     plugin_cls = FaultyFSStoragePlugin if pg.rank == 1 else FSStoragePlugin
     app_state = {"prog": ts.StateDict(rank=pg.rank), "p": ts.PyTreeState({"w": jnp.ones(8)})}
-    with mock.patch(
-        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
-        side_effect=lambda url: plugin_cls(root=url.split("://")[-1]),
-    ):
+    with _patch_plugin(plugin_cls):
         pending = ts.Snapshot.async_take(path, app_state, pg=pg)
         with pytest.raises(Exception):
             pending.wait()

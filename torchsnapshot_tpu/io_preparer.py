@@ -154,8 +154,12 @@ class ArrayBufferStager(BufferStager):
         return array_as_memoryview(host)
 
     def get_staging_cost_bytes(self) -> int:
-        arr = self.arr if self.slc is None else self.arr[self.slc]
-        return int(np.dtype(arr.dtype).itemsize * np.prod(arr.shape, dtype=np.int64))
+        # Pure arithmetic — slicing a jax array here would run a device op
+        # (and allocate HBM) just to read a shape.
+        shape = tuple(self.arr.shape)
+        if self.slc is not None and shape:
+            shape = (len(range(*self.slc.indices(shape[0]))),) + shape[1:]
+        return int(np.dtype(self.arr.dtype).itemsize * np.prod(shape, dtype=np.int64))
 
 
 class ArrayBufferConsumer(BufferConsumer):
@@ -515,7 +519,7 @@ def prepare_write(
         from .sharded_io_preparer import ShardedArrayIOPreparer
 
         return ShardedArrayIOPreparer.prepare_write(
-            obj, logical_path, is_async_snapshot
+            obj, logical_path, is_async_snapshot, array_prepare_func
         )
     if _is_dense_array(obj):
         if ChunkedArrayIOPreparer.should_chunk(obj):

@@ -1,0 +1,23 @@
+from .transformer import (
+    TransformerConfig,
+    TrainState,
+    forward,
+    init_params,
+    init_train_state,
+    make_mesh,
+    make_train_step,
+    param_shardings,
+    state_shardings,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "TrainState",
+    "forward",
+    "init_params",
+    "init_train_state",
+    "make_mesh",
+    "make_train_step",
+    "param_shardings",
+    "state_shardings",
+]

@@ -1,0 +1,336 @@
+"""Flagship model: a sharded decoder-only transformer LM (pure pytree).
+
+The checkpointing framework itself carries no model (the reference,
+torchsnapshot, is model-free — SURVEY.md §0); this module provides the
+*workload* that exercises it: realistic multi-axis-sharded training state
+(params + optax optimizer state + step counter + PRNG key) over a
+``jax.sharding.Mesh``, which is exactly the state layout the sharded-array
+preparers (sharded_io_preparer.py) must persist and elastically restore.
+
+Parallelism layout (GSPMD — shardings annotated, XLA inserts collectives):
+
+- mesh axes ``('dp', 'sp', 'tp')``:
+  - **dp**  — data parallel over batch; also ZeRO/FSDP-style parameter
+    sharding: every 2-d weight shards its non-tp dim over ``dp``.
+  - **tp**  — Megatron-style tensor parallel: qkv / mlp-in are
+    column-parallel (output features over ``tp``), out-proj / mlp-out are
+    row-parallel (input features over ``tp``).
+  - **sp**  — sequence/context parallel: activations between blocks are
+    constrained to ``P('dp', 'sp', None)`` (sequence dim sharded); inside
+    attention the constraint flips to heads-sharded
+    ``P('dp', None, 'tp', None)``, so XLA inserts the sp↔tp all-to-alls
+    (Ulysses-style sequence parallelism).
+  - **ep**  — expert parallel for MoE blocks: expert-stacked weights shard
+    their expert dim over the ``sp`` axis (the standard ep=sp axis-sharing:
+    both exist to scale the same per-token dimension).
+
+Pipeline parallelism is intentionally not modeled via GSPMD annotations
+(it is a schedule, not a sharding); see parallel/pipeline.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import causal_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    n_experts: int = 0  # 0 = dense MLP in every block
+    moe_every: int = 2  # every k-th block is MoE (when n_experts > 0)
+    dtype: Any = jnp.bfloat16
+    learning_rate: float = 1e-3
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, devices: Optional[list] = None
+) -> Mesh:
+    """Build a ``('dp', 'sp', 'tp')`` mesh over ``n_devices``.
+
+    Factors are assigned tp-first (tensor parallel wants the fastest ICI
+    hops), then sp, then dp — e.g. 8 devices → (dp=2, sp=2, tp=2),
+    4 → (1, 2, 2), 2 → (1, 1, 2), 1 → (1, 1, 1).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    tp = 2 if n % 2 == 0 else 1
+    rem = n // tp
+    sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    arr = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+
+
+def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
+    return cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
+    """NamedSharding pytree matching :func:`init_params` structure."""
+
+    def ns(*spec: Any) -> NamedSharding:
+        return NamedSharding(mesh, P(*spec))
+
+    layers = []
+    for i in range(cfg.n_layers):
+        block = {
+            "ln1_scale": ns(None),
+            "ln2_scale": ns(None),
+            # column-parallel fused qkv: (d_model, 3 * d_model)
+            "wqkv": ns("dp", "tp"),
+            # row-parallel out proj: (d_model, d_model)
+            "wo": ns("tp", "dp"),
+        }
+        if _is_moe_layer(cfg, i):
+            block["router"] = ns(None, None)  # (d_model, n_experts)
+            block["w_in"] = ns("sp", "dp", "tp")  # (E, d_model, d_ff)
+            block["w_out"] = ns("sp", "tp", "dp")  # (E, d_ff, d_model)
+        else:
+            block["w_in"] = ns("dp", "tp")  # (d_model, d_ff)
+            block["w_out"] = ns("tp", "dp")  # (d_ff, d_model)
+        layers.append(block)
+    return {
+        "embed": ns("tp", "dp"),  # (vocab, d_model)
+        "layers": layers,
+        "ln_f_scale": ns(None),
+        "unembed": ns("dp", "tp"),  # (d_model, vocab)
+    }
+
+
+def init_params(
+    cfg: TransformerConfig,
+    rng: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> Params:
+    """Initialize parameters; sharded onto ``mesh`` when given.
+
+    Init math runs inside ``jax.jit`` with ``out_shardings`` so each device
+    materializes only its own shard (no full-model host copy — matters for
+    the 20 GB-class benchmark configs).
+    """
+
+    def _init(rng: jax.Array) -> Params:
+        n_keys = 3 + 5 * cfg.n_layers
+        keys = iter(jax.random.split(rng, n_keys))
+
+        def dense(key: jax.Array, shape: Tuple[int, ...]) -> jax.Array:
+            fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+            std = 1.0 / math.sqrt(fan_in)
+            return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(
+                cfg.dtype
+            )
+
+        layers = []
+        for i in range(cfg.n_layers):
+            block = {
+                "ln1_scale": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+                "ln2_scale": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+                "wqkv": dense(next(keys), (cfg.d_model, 3 * cfg.d_model)),
+                "wo": dense(next(keys), (cfg.d_model, cfg.d_model)),
+            }
+            if _is_moe_layer(cfg, i):
+                block["router"] = dense(next(keys), (cfg.d_model, cfg.n_experts))
+                block["w_in"] = dense(
+                    next(keys), (cfg.n_experts, cfg.d_model, cfg.d_ff)
+                )
+                block["w_out"] = dense(
+                    next(keys), (cfg.n_experts, cfg.d_ff, cfg.d_model)
+                )
+            else:
+                next(keys)  # keep key schedule layer-count-stable
+                block["w_in"] = dense(next(keys), (cfg.d_model, cfg.d_ff))
+                block["w_out"] = dense(next(keys), (cfg.d_ff, cfg.d_model))
+            layers.append(block)
+        return {
+            "embed": dense(next(keys), (cfg.vocab_size, cfg.d_model)),
+            "layers": layers,
+            "ln_f_scale": jnp.ones((cfg.d_model,), dtype=cfg.dtype),
+            "unembed": dense(next(keys), (cfg.d_model, cfg.vocab_size)),
+        }
+
+    if mesh is None:
+        return jax.jit(_init)(rng)
+    shardings = param_shardings(cfg, mesh)
+    return jax.jit(_init, out_shardings=shardings)(rng)
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
+
+
+def _constrain(x: jax.Array, mesh: Optional[Mesh], *spec: Any) -> jax.Array:
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def _moe_mlp(block: Params, x: jax.Array) -> jax.Array:
+    """Soft-routed MoE: every expert computed, outputs gate-combined.
+
+    Shape-static (no dynamic dispatch), so it jits cleanly and the expert
+    einsums shard over the ``sp`` (=ep) axis via the stacked-weight
+    shardings. Token-dropping top-k dispatch with all_to_all is a later
+    optimization; for checkpointing purposes the state layout is identical.
+    """
+    gates = jax.nn.softmax(
+        jnp.einsum("bsd,de->bse", x, block["router"].astype(jnp.float32)), axis=-1
+    ).astype(x.dtype)
+    h = jnp.einsum("bsd,edf->ebsf", x, block["w_in"])
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("ebsf,efd->ebsd", h, block["w_out"])
+    return jnp.einsum("ebsd,bse->bsd", y, gates)
+
+
+def forward(
+    cfg: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Token ids ``(batch, seq)`` → logits ``(batch, seq, vocab)``.
+
+    Between blocks activations are sequence-sharded (sp); inside attention
+    they are heads-sharded (tp). With ``mesh=None`` the same trace runs
+    single-device (the graft ``entry()`` path).
+    """
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _constrain(x, mesh, "dp", "sp", None)
+    b, s, d = x.shape
+    for i, block in enumerate(params["layers"]):
+        h = _rmsnorm(x, block["ln1_scale"])
+        qkv = jnp.einsum("bsd,dz->bsz", h, block["wqkv"])
+        qkv = qkv.reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
+        qkv = _constrain(qkv, mesh, "dp", None, None, "tp", None)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = causal_attention(q, k, v)
+        attn = attn.reshape(b, s, d)
+        x = x + _constrain(
+            jnp.einsum("bsz,zd->bsd", attn, block["wo"]), mesh, "dp", "sp", None
+        )
+        h = _rmsnorm(x, block["ln2_scale"])
+        if "router" in block:
+            y = _moe_mlp(block, h)
+        else:
+            y = jnp.einsum(
+                "bsf,fd->bsd", jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, block["w_in"])),
+                block["w_out"],
+            )
+        x = x + _constrain(y, mesh, "dp", "sp", None)
+    x = _rmsnorm(x, params["ln_f_scale"])
+    return jnp.einsum("bsd,dv->bsv", x, params["unembed"]).astype(jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# Training state + step
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TrainState:
+    """The checkpointable unit: what Snapshot.take persists for this model."""
+
+    params: Params
+    opt_state: Any
+    step: jax.Array  # scalar int32
+    rng: jax.Array  # PRNGKey
+
+    def as_pytree(self) -> Dict[str, Any]:
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "step": self.step,
+            "rng": self.rng,
+        }
+
+
+jax.tree_util.register_dataclass(
+    TrainState, ["params", "opt_state", "step", "rng"], []
+)
+
+
+def _optimizer(cfg: TransformerConfig) -> optax.GradientTransformation:
+    return optax.adamw(cfg.learning_rate)
+
+
+def init_train_state(
+    cfg: TransformerConfig,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+) -> TrainState:
+    rng = jax.random.PRNGKey(seed)
+    params = init_params(cfg, rng, mesh=mesh)
+    opt = _optimizer(cfg)
+    # Adam moments are zeros_like(params): GSPMD propagation shards them
+    # like the params; the scalar count replicates. No manual out_shardings.
+    opt_state = jax.jit(opt.init)(params)
+    step = jnp.zeros((), dtype=jnp.int32)
+    return TrainState(params=params, opt_state=opt_state, step=step, rng=rng)
+
+
+def state_shardings(state: TrainState) -> Dict[str, Any]:
+    """Sharding pytree of a live train state (restore destinations)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.sharding, state.as_pytree()
+    )
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
+    """Build the jitted full training step (fwd + loss + bwd + adamw)."""
+    opt = _optimizer(cfg)
+
+    def loss_fn(params: Params, tokens: jax.Array) -> jax.Array:
+        logits = forward(cfg, params, tokens, mesh=mesh)
+        targets = tokens[:, 1:]
+        logits = logits[:, :-1]
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return jnp.mean(losses)
+
+    def train_step(
+        state: TrainState, tokens: jax.Array
+    ) -> Tuple[TrainState, jax.Array]:
+        if mesh is not None:
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, P("dp", None))
+            )
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt_state = opt.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_rng = jax.random.fold_in(state.rng, state.step)
+        return (
+            TrainState(
+                params=new_params,
+                opt_state=new_opt_state,
+                step=state.step + 1,
+                rng=new_rng,
+            ),
+            loss,
+        )
+
+    return jax.jit(train_step, donate_argnums=(0,))

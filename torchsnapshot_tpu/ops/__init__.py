@@ -1,0 +1,3 @@
+from .attention import causal_attention
+
+__all__ = ["causal_attention"]

@@ -96,7 +96,10 @@ class ShardedArrayIOPreparer:
 
     @staticmethod
     def prepare_write(
-        obj: Any, logical_path: str, is_async_snapshot: bool
+        obj: Any,
+        logical_path: str,
+        is_async_snapshot: bool,
+        array_prepare_func: Optional[Callable[..., Any]] = None,
     ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
         dtype_str = dtype_to_string(obj.dtype)
         itemsize = np.dtype(obj.dtype).itemsize
@@ -138,7 +141,10 @@ class ShardedArrayIOPreparer:
                     WriteReq(
                         path=location,
                         buffer_stager=ArrayBufferStager(
-                            dev_shard.data, is_async_snapshot, slc=slc
+                            dev_shard.data,
+                            is_async_snapshot,
+                            slc=slc,
+                            array_prepare_func=array_prepare_func,
                         ),
                     )
                 )
