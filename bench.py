@@ -8,7 +8,7 @@ Prints ONE JSON line:
      "vs_baseline": N}
 
 vs_baseline is the ratio against the reference's single-accelerator
-local-FS number (1.4 GB/s). Size configurable via TS_BENCH_GB (default 4).
+local-FS number (1.4 GB/s). Size configurable via TS_BENCH_GB (default 1).
 """
 
 import json

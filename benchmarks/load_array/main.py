@@ -1,0 +1,62 @@
+"""Budget-bounded single-array load (reference benchmarks/load_tensor/main.py).
+
+Writes one large array, then reads it back with and without a memory
+budget while sampling RSS — demonstrating that ranged chunk reads keep host
+memory bounded at the budget rather than the array size.
+
+    python benchmarks/load_array/main.py --gb 2 --budget-mb 100
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from benchmarks.common import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import torchsnapshot_tpu as ts  # noqa: E402
+from torchsnapshot_tpu.utils import RSSDeltas, measure_rss_deltas  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--gb", type=float, default=2.0)
+    p.add_argument("--budget-mb", type=int, default=100)
+    args = p.parse_args()
+
+    n = int(args.gb * (1 << 30) / 4)
+    side = int(np.sqrt(n))
+    arr = np.random.default_rng(0).standard_normal((side, side)).astype(np.float32)
+    print(f"array: {arr.nbytes / (1 << 30):.2f} GiB")
+
+    work_dir = tempfile.mkdtemp(prefix="ts_bench_load_")
+    try:
+        path = os.path.join(work_dir, "snap")
+        ts.Snapshot.take(path, {"t": ts.PyTreeState({"x": arr})})
+        snapshot = ts.Snapshot(path)
+
+        for budget in (None, args.budget_mb * (1 << 20)):
+            out = np.zeros_like(arr)
+            rss = RSSDeltas()
+            t0 = time.perf_counter()
+            with measure_rss_deltas(rss):
+                snapshot.read_object("0/t/x", obj_out=out, memory_budget_bytes=budget)
+            elapsed = time.perf_counter() - t0
+            np.testing.assert_array_equal(out, arr)
+            label = "unbounded" if budget is None else f"{args.budget_mb} MB budget"
+            print(
+                f"load ({label}): {elapsed:.2f}s "
+                f"({arr.nbytes / (1 << 30) / elapsed:.2f} GB/s), "
+                f"peak RSS delta {rss.peak_bytes / (1 << 20):.0f} MB"
+            )
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

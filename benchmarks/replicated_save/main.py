@@ -1,0 +1,75 @@
+"""Replicated-state save benchmark (reference benchmarks/ddp/main.py).
+
+A DDP-equivalent workload: every process holds the same N-GiB state; the
+write-load partitioner splits the bytes across ranks so aggregate
+throughput scales with process count. Single-process by default; pass
+--nproc to fan out with the multiprocess harness.
+
+    python benchmarks/replicated_save/main.py --gb 4 [--nproc 2] [--work-dir D]
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from benchmarks.common import jax  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+import torchsnapshot_tpu as ts  # noqa: E402
+
+
+def make_state(total_bytes: int):
+    block = 64 * 1024 * 1024  # 64 MiB fp32 blocks
+    n = max(1, total_bytes // block)
+    key = jax.random.PRNGKey(0)
+    out = {}
+    for i in range(n):
+        key, sub = jax.random.split(key)
+        out[f"w{i}"] = jax.random.normal(sub, (block // 4,), jnp.float32)
+    jax.block_until_ready(out)
+    return out
+
+
+def run_rank(pg, work_dir: str, gb: float) -> None:
+    state = make_state(int(gb * (1 << 30)))
+    nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+    app_state = {"model": ts.PyTreeState(state)}
+
+    t0 = time.perf_counter()
+    ts.Snapshot.take(work_dir, app_state, pg=pg, replicated=["**"])
+    elapsed = time.perf_counter() - t0
+    rank = pg.rank if pg is not None else 0
+    if rank == 0:
+        print(
+            f"replicated save: {nbytes / (1 << 30):.2f} GiB in {elapsed:.2f}s "
+            f"= {nbytes / (1 << 30) / elapsed:.2f} GB/s"
+        )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--gb", type=float, default=4.0)
+    p.add_argument("--nproc", type=int, default=1)
+    p.add_argument("--work-dir", default=None)
+    args = p.parse_args()
+
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="ts_bench_repl_")
+    try:
+        if args.nproc == 1:
+            run_rank(None, work_dir, args.gb)
+        else:
+            from torchsnapshot_tpu.test_utils import run_multiprocess
+
+            run_multiprocess(run_rank, args.nproc, args=(work_dir, args.gb))
+    finally:
+        if args.work_dir is None:
+            shutil.rmtree(work_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Sharded-transformer checkpoint benchmark (reference benchmarks/fsdp/main.py).
+
+Builds the flagship transformer over the visible device mesh, runs one
+training step, then times Snapshot save and restore of the full sharded
+train state (params + adam moments).
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/sharded_transformer/main.py --d-model 512 --layers 8
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from benchmarks.common import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import torchsnapshot_tpu as ts  # noqa: E402
+from torchsnapshot_tpu.models import (  # noqa: E402
+    TransformerConfig,
+    init_train_state,
+    make_mesh,
+    make_train_step,
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--experts", type=int, default=0)
+    p.add_argument("--async-take", action="store_true")
+    args = p.parse_args()
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=max(4, args.d_model // 64),
+        n_layers=args.layers,
+        d_ff=args.d_model * 4,
+        n_experts=args.experts,
+    )
+    mesh = make_mesh()
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    state = init_train_state(cfg, seed=0, mesh=mesh)
+    step_fn = make_train_step(cfg, mesh=mesh)
+    tokens = jax.device_put(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 128)).astype(np.int32),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    state, _ = step_fn(state, tokens)
+    jax.block_until_ready(state.params)
+
+    tree = state.as_pytree()
+    nbytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "nbytes")
+    )
+    print(f"train state: {nbytes / (1 << 30):.2f} GiB")
+
+    work_dir = tempfile.mkdtemp(prefix="ts_bench_fsdp_")
+    try:
+        path = os.path.join(work_dir, "snap")
+        t0 = time.perf_counter()
+        if args.async_take:
+            pending = ts.Snapshot.async_take(path, {"train": ts.PyTreeState(tree)})
+            blocked = time.perf_counter() - t0
+            pending.wait()
+            total = time.perf_counter() - t0
+            print(
+                f"async save: blocked {blocked:.3f}s, total {total:.2f}s "
+                f"({nbytes / (1 << 30) / total:.2f} GB/s)"
+            )
+        else:
+            ts.Snapshot.take(path, {"train": ts.PyTreeState(tree)})
+            total = time.perf_counter() - t0
+            print(
+                f"sync save: {total:.2f}s ({nbytes / (1 << 30) / total:.2f} GB/s)"
+            )
+
+        dest = ts.PyTreeState(state.as_pytree())
+        t0 = time.perf_counter()
+        ts.Snapshot(path).restore({"train": dest})
+        total = time.perf_counter() - t0
+        print(f"restore: {total:.2f}s ({nbytes / (1 << 30) / total:.2f} GB/s)")
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

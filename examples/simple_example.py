@@ -1,0 +1,80 @@
+"""Minimal end-to-end training loop with checkpoint/resume.
+
+Reference parity: examples/simple_example.py:50-82 — app_state dict, restore
+if a snapshot exists, train, take. Here the state is a pure JAX pytree:
+params + optax optimizer state + progress counters + an explicit PRNG key.
+
+Run:  python examples/simple_example.py /tmp/simple_snapshot
+Kill it mid-run and re-run: it resumes from the last committed snapshot.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import torchsnapshot_tpu as ts
+
+NUM_EPOCHS = 4
+STEPS_PER_EPOCH = 8
+
+
+def loss_fn(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def main(path: str) -> None:
+    params = {
+        "w": jnp.zeros((16, 1), jnp.float32),
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    app_state = {
+        "params": ts.PyTreeState(params),
+        "opt": ts.PyTreeState(opt_state),
+        "progress": ts.StateDict(epoch=0),
+        "rng": ts.RngState(jax.random.PRNGKey(0)),
+    }
+
+    try:
+        snapshot = ts.Snapshot(path)
+        snapshot.restore(app_state)
+        print(f"resumed from epoch {app_state['progress']['epoch']}")
+    except FileNotFoundError:
+        print("no snapshot found; starting fresh")
+
+    @jax.jit
+    def step(params, opt_state, key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(kx, (32, 16))
+        y = x @ jnp.arange(16.0).reshape(16, 1) + jax.random.normal(ky, (32, 1)) * 0.01
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    while app_state["progress"]["epoch"] < NUM_EPOCHS:
+        params = app_state["params"].tree
+        opt_state = app_state["opt"].tree
+        key = app_state["rng"].keys
+        for _ in range(STEPS_PER_EPOCH):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = step(params, opt_state, sub)
+        app_state["params"].tree = params
+        app_state["opt"].tree = opt_state
+        app_state["rng"].keys = key
+        app_state["progress"]["epoch"] += 1
+        print(f"epoch {app_state['progress']['epoch']}: loss={float(loss):.5f}")
+        ts.Snapshot.take(path, app_state)
+
+    print("done")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/simple_snapshot")

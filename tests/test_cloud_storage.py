@@ -1,0 +1,164 @@
+"""Cloud storage plugins, offline-testable parts: the collective-progress
+retry strategy, the transient-error taxonomy, URL/root parsing, and
+dependency gating. Live bucket round-trips are env-gated the way the
+reference gates them (TORCHSNAPSHOT_ENABLE_*_TEST).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from torchsnapshot_tpu.event_loop import run_in_fresh_event_loop
+from torchsnapshot_tpu.storage_plugins.retry import (
+    CollectiveProgressRetryStrategy,
+    RetriesExhausted,
+)
+
+
+class Transient(Exception):
+    pass
+
+
+def test_retry_succeeds_after_transient_failures() -> None:
+    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=30)
+    attempts = 0
+
+    async def op():
+        nonlocal attempts
+        attempts += 1
+        if attempts < 3:
+            raise Transient()
+        return "ok"
+
+    result = run_in_fresh_event_loop(strategy.run(op, (Transient,)))
+    assert result == "ok"
+    assert attempts == 3
+
+
+def test_retry_gives_up_when_nobody_progresses() -> None:
+    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=0.01)
+
+    async def op():
+        raise Transient()
+
+    with pytest.raises(RetriesExhausted):
+        run_in_fresh_event_loop(strategy.run(op, (Transient,)))
+
+
+def test_retry_nonretriable_raises_immediately() -> None:
+    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=30)
+
+    async def op():
+        raise ValueError("hard failure")
+
+    with pytest.raises(ValueError):
+        run_in_fresh_event_loop(strategy.run(op, (Transient,)))
+
+
+def test_concurrent_progress_extends_straggler_deadline() -> None:
+    """A straggler keeps retrying while a sibling makes progress — the
+    collective-deadline semantics (reference gcs.py:214-270)."""
+    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=0.6)
+    straggler_attempts = 0
+
+    async def straggler():
+        nonlocal straggler_attempts
+        straggler_attempts += 1
+        if straggler_attempts < 3:
+            raise Transient()
+        return "eventually"
+
+    async def sibling():
+        for _ in range(20):
+            await asyncio.sleep(0.1)
+            strategy.record_progress()
+
+    async def main():
+        sib = asyncio.ensure_future(sibling())
+        try:
+            # Backoff between straggler attempts is ~1s+, far beyond the
+            # 0.6 s window: only the sibling's refreshes keep it alive.
+            return await strategy.run(straggler, (Transient,))
+        finally:
+            sib.cancel()
+
+    assert run_in_fresh_event_loop(main()) == "eventually"
+    assert straggler_attempts == 3
+
+
+def test_gcs_transient_taxonomy() -> None:
+    pytest.importorskip("google.resumable_media")
+    import requests
+    from google.resumable_media import common
+
+    from torchsnapshot_tpu.storage_plugins.gcs import _is_transient
+
+    class FakeResp:
+        def __init__(self, code):
+            self.status_code = code
+
+    for code in (408, 429, 500, 503):
+        assert _is_transient(common.InvalidResponse(FakeResp(code)), common)
+    for code in (400, 403, 404):
+        assert not _is_transient(common.InvalidResponse(FakeResp(code)), common)
+    assert _is_transient(requests.ConnectionError(), common)
+    assert _is_transient(requests.Timeout(), common)
+    assert not _is_transient(ValueError(), common)
+
+
+def test_s3_plugin_gates_missing_dependency() -> None:
+    try:
+        import aiobotocore  # noqa: F401
+
+        pytest.skip("aiobotocore installed; gating not exercised")
+    except ImportError:
+        pass
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    with pytest.raises(RuntimeError, match="aiobotocore"):
+        S3StoragePlugin(root="bucket/prefix")
+
+
+def test_gcs_root_parsing_rejects_empty_bucket() -> None:
+    pytest.importorskip("google.resumable_media")
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    with pytest.raises((ValueError, Exception)):
+        GCSStoragePlugin(root="")
+
+
+def test_registry_dispatches_schemes(tmp_path) -> None:
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    assert isinstance(url_to_storage_plugin(str(tmp_path)), FSStoragePlugin)
+    assert isinstance(
+        url_to_storage_plugin(f"fs://{tmp_path}"), FSStoragePlugin
+    )
+    assert isinstance(url_to_storage_plugin("memory://x"), MemoryStoragePlugin)
+    with pytest.raises(RuntimeError, match="Unsupported storage scheme"):
+        url_to_storage_plugin("bogus://whatever")
+
+
+@pytest.mark.skipif(
+    "TORCHSNAPSHOT_TPU_ENABLE_GCS_TEST" not in os.environ,
+    reason="live GCS test not enabled",
+)
+def test_gcs_live_roundtrip() -> None:
+    from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    plugin = url_to_storage_plugin(os.environ["TORCHSNAPSHOT_TPU_GCS_URL"])
+
+    async def go():
+        data = os.urandom(1 << 20)
+        await plugin.write(WriteIO(path="smoke/blob", buf=data))
+        io_ = ReadIO(path="smoke/blob", byte_range=(100, 1100))
+        await plugin.read(io_)
+        assert bytes(io_.buf) == data[100:1100]
+        await plugin.delete("smoke/blob")
+        await plugin.close()
+
+    run_in_fresh_event_loop(go())

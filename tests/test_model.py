@@ -78,7 +78,8 @@ def test_sharded_state_checkpoint_roundtrip(tmp_path) -> None:
     state, _ = make_train_step(cfg, mesh=mesh)(state, _tokens(cfg, mesh))
     ts.Snapshot.take(str(tmp_path), {"train": ts.PyTreeState(state.as_pytree())})
 
-    dest = ts.PyTreeState(state.as_pytree())
+    # Destination from a different seed so a silent no-op restore fails.
+    dest = ts.PyTreeState(init_train_state(cfg, seed=11, mesh=mesh).as_pytree())
     ts.Snapshot(str(tmp_path)).restore({"train": dest})
     for a, b in zip(
         jax.tree_util.tree_leaves(state.as_pytree()),

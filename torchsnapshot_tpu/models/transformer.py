@@ -113,7 +113,9 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
             block["w_out"] = ns("tp", "dp")  # (d_ff, d_model)
         layers.append(block)
     return {
-        "embed": ns("tp", "dp"),  # (vocab, d_model)
+        # d_model over tp: the token gather is then local on every device
+        # (vocab-dim sharding would force a masked-gather + collective).
+        "embed": ns(None, "tp"),  # (vocab, d_model)
         "layers": layers,
         "ln_f_scale": ns(None),
         "unembed": ns("dp", "tp"),  # (d_model, vocab)

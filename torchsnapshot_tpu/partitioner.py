@@ -25,6 +25,7 @@ from typing import Dict, List, Tuple
 from . import knobs
 from .io_types import WriteReq
 from .manifest import (
+    ArrayEntry,
     ChunkedArrayEntry,
     Entry,
     Manifest,
@@ -136,7 +137,11 @@ def consolidate_replicated_entries(
             ):
                 by_offsets = {tuple(c.offsets): c for c in existing.chunks}
                 for chunk in entry.chunks:
-                    by_offsets.setdefault(tuple(chunk.offsets), chunk)
+                    key = tuple(chunk.offsets)
+                    if key not in by_offsets or _prefer_rewritten(
+                        chunk.array, by_offsets[key].array
+                    ):
+                        by_offsets[key] = chunk
                 merged[path] = ChunkedArrayEntry(
                     dtype=entry.dtype,
                     shape=entry.shape,
@@ -144,8 +149,39 @@ def consolidate_replicated_entries(
                     replicated=True,
                 )
             elif entry != existing:
-                raise AssertionError(
-                    f"Replicated entry mismatch across ranks for {path!r}: "
-                    f"{existing} != {entry}"
-                )
+                # Slab batching rewrites an entry's location/byte_range on
+                # the one rank that owns the write; that rewritten entry is
+                # the authoritative one (the original location was never
+                # written by anybody).
+                if _is_entry_rewritten(entry, existing):
+                    merged[path] = entry
+                elif _is_entry_rewritten(existing, entry):
+                    pass  # existing already authoritative
+                else:
+                    raise AssertionError(
+                        f"Replicated entry mismatch across ranks for {path!r}: "
+                        f"{existing} != {entry}"
+                    )
     return merged
+
+
+def _prefer_rewritten(candidate: ArrayEntry, incumbent: ArrayEntry) -> bool:
+    """True when ``candidate`` is the batch-rewritten flavor of
+    ``incumbent`` (same logical payload, slab location)."""
+    return candidate.location.startswith(
+        "batched/"
+    ) and not incumbent.location.startswith("batched/")
+
+
+def _is_entry_rewritten(entry: Entry, other: Entry) -> bool:
+    if not isinstance(entry, ArrayEntry) or not isinstance(other, ArrayEntry):
+        return False
+    if not _prefer_rewritten(entry, other):
+        return False
+    # Payload-identifying fields must still agree.
+    return (
+        entry.dtype == other.dtype
+        and entry.shape == other.shape
+        and entry.serializer == other.serializer
+        and entry.replicated == other.replicated
+    )

@@ -425,6 +425,11 @@ class Snapshot:
                     )
                 )
 
+        if knobs.is_batching_enabled():
+            from .batcher import batch_read_requests
+
+            read_reqs = batch_read_requests(read_reqs)
+
         sync_execute_read_reqs(
             read_reqs=read_reqs,
             storage=storage,
@@ -506,6 +511,11 @@ class Snapshot:
                     finalize = lambda: restored.__setitem__(  # noqa: E731
                         result_path, convert(dst)
                     )
+
+            if knobs.is_batching_enabled():
+                from .batcher import batch_read_requests
+
+                read_reqs = batch_read_requests(read_reqs)
 
             sync_execute_read_reqs(
                 read_reqs=read_reqs,

@@ -1,0 +1,211 @@
+"""Google Cloud Storage plugin — the TPU-adjacent object store.
+
+Reference parity: torchsnapshot/storage_plugins/gcs.py:47-211 (resumable
+uploads, chunked/ranged downloads, transient-error taxonomy, shared
+collective-progress retry). Blocking ``google-resumable-media`` calls are
+bridged to asyncio on a dedicated thread pool, sized to the per-rank I/O
+concurrency knob so storage writes overlap.
+
+Auth: application-default credentials (the standard on TPU VMs, whose
+metadata server grants the attached service account). Bucket paths are
+``gs://bucket/prefix`` URLs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional, Tuple
+
+from .. import knobs
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from .retry import CollectiveProgressRetryStrategy
+
+logger = logging.getLogger(__name__)
+
+_UPLOAD_CHUNK_SIZE = 100 * 1024 * 1024
+_DOWNLOAD_CHUNK_SIZE = 100 * 1024 * 1024
+
+
+def _import_gcs_deps():
+    try:
+        import google.auth  # noqa: F401
+        from google.auth.transport.requests import AuthorizedSession  # noqa: F401
+        from google.resumable_media import common  # noqa: F401
+        from google.resumable_media.requests import (  # noqa: F401
+            ChunkedDownload,
+            ResumableUpload,
+        )
+    except ImportError as e:
+        raise RuntimeError(
+            "GCS support requires google-auth and google-resumable-media "
+            "(pip install google-auth google-resumable-media[requests])"
+        ) from e
+    return google.auth, AuthorizedSession, common, ChunkedDownload, ResumableUpload
+
+
+def _is_transient(exc: BaseException, common: Any) -> bool:
+    """Transient-error taxonomy (reference gcs.py:88-107): HTTP 408/429/5xx,
+    connection resets, and invalid-response wrappers are retriable."""
+    import requests
+
+    if isinstance(exc, common.InvalidResponse):
+        return exc.response.status_code in (408, 429) or (
+            500 <= exc.response.status_code < 600
+        )
+    if isinstance(exc, (requests.ConnectionError, requests.Timeout)):
+        return True
+    if isinstance(exc, common.DataCorruption):
+        return True
+    return False
+
+
+class GCSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        (
+            self._google_auth,
+            authorized_session_cls,
+            self._common,
+            self._chunked_download_cls,
+            self._resumable_upload_cls,
+        ) = _import_gcs_deps()
+
+        bucket, _, prefix = root.partition("/")
+        if not bucket:
+            raise ValueError(
+                f"Invalid GCS root {root!r}; expected 'bucket[/prefix]'"
+            )
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        credentials, _ = self._google_auth.default(
+            scopes=["https://www.googleapis.com/auth/devstorage.read_write"]
+        )
+        self._session = authorized_session_cls(credentials)
+        self._executor = ThreadPoolExecutor(
+            max_workers=knobs.get_per_rank_io_concurrency(),
+            thread_name_prefix="gcs-io",
+        )
+        self._retry = CollectiveProgressRetryStrategy()
+
+    # ------------------------------------------------------------------
+
+    def _blob_name(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    def _upload_sync(self, path: str, data: bytes) -> None:
+        blob = self._blob_name(path)
+        url = (
+            f"https://storage.googleapis.com/upload/storage/v1/b/"
+            f"{self.bucket}/o?uploadType=resumable"
+        )
+        upload = self._resumable_upload_cls(url, _UPLOAD_CHUNK_SIZE)
+        stream = io.BytesIO(data)
+        upload.initiate(
+            self._session,
+            stream,
+            {"name": blob},
+            "application/octet-stream",
+            total_bytes=len(data),
+        )
+        while not upload.finished:
+            try:
+                upload.transmit_next_chunk(self._session)
+            except self._common.InvalidResponse:
+                # Upload-recovery rewind (reference gcs.py:109-122): ask the
+                # server how far it got, reposition the stream, continue.
+                upload.recover(self._session)
+
+    def _download_sync(
+        self, path: str, byte_range: Optional[Tuple[int, int]]
+    ) -> bytes:
+        blob = self._blob_name(path).replace("/", "%2F")
+        url = (
+            f"https://storage.googleapis.com/download/storage/v1/b/"
+            f"{self.bucket}/o/{blob}?alt=media"
+        )
+        stream = io.BytesIO()
+        if byte_range is not None:
+            start, end = byte_range
+            download = self._chunked_download_cls(
+                url,
+                _DOWNLOAD_CHUNK_SIZE,
+                stream,
+                start=start,
+                end=end - 1,  # API takes an inclusive end
+            )
+        else:
+            download = self._chunked_download_cls(
+                url, _DOWNLOAD_CHUNK_SIZE, stream
+            )
+        while not download.finished:
+            download.consume_next_chunk(self._session)
+        return stream.getvalue()
+
+    def _delete_sync(self, path: str) -> None:
+        blob = self._blob_name(path).replace("/", "%2F")
+        url = (
+            f"https://storage.googleapis.com/storage/v1/b/"
+            f"{self.bucket}/o/{blob}"
+        )
+        resp = self._session.delete(url)
+        if resp.status_code not in (200, 204, 404):
+            raise self._common.InvalidResponse(resp, "delete failed")
+
+    # ------------------------------------------------------------------
+
+    async def write(self, write_io: WriteIO) -> None:
+        loop = asyncio.get_running_loop()
+        data = bytes(write_io.buf)
+
+        async def op() -> None:
+            await loop.run_in_executor(
+                self._executor, self._upload_sync, write_io.path, data
+            )
+
+        await self._run_retrying(op)
+
+    async def read(self, read_io: ReadIO) -> None:
+        loop = asyncio.get_running_loop()
+
+        async def op() -> bytes:
+            return await loop.run_in_executor(
+                self._executor,
+                self._download_sync,
+                read_io.path,
+                read_io.byte_range,
+            )
+
+        read_io.buf = memoryview(await self._run_retrying(op))
+
+    async def delete(self, path: str) -> None:
+        loop = asyncio.get_running_loop()
+
+        async def op() -> None:
+            await loop.run_in_executor(self._executor, self._delete_sync, path)
+
+        await self._run_retrying(op)
+
+    async def _run_retrying(self, op):
+        """Retry ``op`` on transient GCS errors under the shared
+        collective-progress deadline."""
+
+        async def guarded():
+            try:
+                return await op()
+            except Exception as e:
+                if _is_transient(e, self._common):
+                    raise _TransientGCSError() from e
+                raise
+
+        return await self._retry.run(
+            guarded, retriable_exceptions=(_TransientGCSError,)
+        )
+
+    async def close(self) -> None:
+        self._executor.shutdown(wait=False)
+
+
+class _TransientGCSError(Exception):
+    pass

@@ -1,0 +1,74 @@
+"""Collective-progress retry strategy for cloud storage plugins.
+
+Reference parity: the ``_RetryStrategy`` in torchsnapshot's GCS plugin
+(storage_plugins/gcs.py:214-270): rather than a fixed per-operation retry
+count, concurrent transfers share a *deadline* that is refreshed whenever
+any of them completes. As long as somebody is making progress, stragglers
+keep retrying (with exponential backoff + jitter); when nobody has
+progressed for the window, everyone gives up. This matches checkpoint
+workloads, where dozens of concurrent writes hit the same degraded backend
+and individual retry budgets either trip too early (transient brownout) or
+too late (hard outage).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Awaitable, Callable, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+DEFAULT_PROGRESS_WINDOW_SECONDS = 128.0
+_BACKOFF_BASE_SECONDS = 1.0
+_BACKOFF_MAX_SECONDS = 32.0
+
+
+class RetriesExhausted(RuntimeError):
+    pass
+
+
+class CollectiveProgressRetryStrategy:
+    """Shared-deadline retry coordinator for one storage plugin instance."""
+
+    def __init__(
+        self, progress_window_seconds: float = DEFAULT_PROGRESS_WINDOW_SECONDS
+    ) -> None:
+        self.progress_window_seconds = progress_window_seconds
+        self._deadline = time.monotonic() + progress_window_seconds
+
+    def record_progress(self) -> None:
+        """Any completed operation pushes the collective deadline out."""
+        self._deadline = time.monotonic() + self.progress_window_seconds
+
+    @property
+    def deadline_passed(self) -> bool:
+        return time.monotonic() > self._deadline
+
+    async def run(
+        self,
+        op: Callable[[], Awaitable[T]],
+        retriable_exceptions: Tuple[Type[BaseException], ...],
+    ) -> T:
+        """Run ``op``, retrying transient failures until the collective
+        deadline lapses with no progress from any concurrent operation."""
+        attempt = 0
+        while True:
+            try:
+                result = await op()
+            except retriable_exceptions as e:
+                if self.deadline_passed:
+                    raise RetriesExhausted(
+                        f"No concurrent operation progressed within "
+                        f"{self.progress_window_seconds:.0f}s; giving up "
+                        f"after {attempt + 1} attempts"
+                    ) from e
+                backoff = min(
+                    _BACKOFF_MAX_SECONDS, _BACKOFF_BASE_SECONDS * (2**attempt)
+                )
+                await asyncio.sleep(backoff * (0.5 + random.random() / 2))
+                attempt += 1
+            else:
+                self.record_progress()
+                return result

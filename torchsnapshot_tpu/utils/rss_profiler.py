@@ -1,0 +1,58 @@
+"""RSS sampling for proving bounded-memory checkpointing.
+
+Reference parity: torchsnapshot/rss_profiler.py:20-56 — a context manager
+that samples the process RSS on a background thread (100 ms period) and
+records deltas against the RSS at entry. Benchmarks use it to demonstrate
+that the scheduler's memory budget actually bounds host memory
+(reference benchmarks/torchrec/main.py:211-231).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Generator, List
+
+import psutil
+
+_SAMPLE_PERIOD_SECONDS = 0.1
+
+
+@dataclass
+class RSSDeltas:
+    """Sampled ``rss - rss_at_entry`` values, in bytes."""
+
+    deltas: List[int] = field(default_factory=list)
+
+    @property
+    def peak_bytes(self) -> int:
+        return max(self.deltas, default=0)
+
+
+@contextmanager
+def measure_rss_deltas(
+    rss_deltas: RSSDeltas,
+    sample_period_seconds: float = _SAMPLE_PERIOD_SECONDS,
+) -> Generator[None, None, None]:
+    """Sample RSS deltas into ``rss_deltas`` until the block exits."""
+    process = psutil.Process()
+    baseline = process.memory_info().rss
+    stop = threading.Event()
+
+    def sampler() -> None:
+        while not stop.is_set():
+            rss_deltas.deltas.append(process.memory_info().rss - baseline)
+            stop.wait(sample_period_seconds)
+
+    thread = threading.Thread(
+        target=sampler, name="rss-profiler", daemon=True
+    )
+    thread.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        thread.join()
+        rss_deltas.deltas.append(process.memory_info().rss - baseline)
