@@ -41,6 +41,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import causal_attention
+from ..ops.ring_attention import ring_causal_attention
 
 Params = Dict[str, Any]
 
@@ -56,6 +57,11 @@ class TransformerConfig:
     moe_every: int = 2  # every k-th block is MoE (when n_experts > 0)
     dtype: Any = jnp.bfloat16
     learning_rate: float = 1e-3
+    # "ulysses": heads-sharded attention, sp↔tp all-to-alls at the block
+    # boundary (short/medium context). "ring": sequence stays sharded and
+    # KV blocks rotate the sp ring (ops/ring_attention.py — long context,
+    # O(seq_local^2) memory per device).
+    attn_impl: str = "ulysses"
 
     @property
     def head_dim(self) -> int:
@@ -226,9 +232,17 @@ def forward(
         h = _rmsnorm(x, block["ln1_scale"])
         qkv = jnp.einsum("bsd,dz->bsz", h, block["wqkv"])
         qkv = qkv.reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
-        qkv = _constrain(qkv, mesh, "dp", None, None, "tp", None)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = causal_attention(q, k, v)
+        if cfg.attn_impl == "ring" and mesh is not None:
+            # Sequence stays sp-sharded; KV blocks rotate the ring.
+            qkv = _constrain(qkv, mesh, "dp", "sp", None, "tp", None)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            attn = ring_causal_attention(q, k, v, mesh=mesh)
+        else:
+            # Ulysses: resharding to heads-over-tp makes XLA insert the
+            # sp↔tp all-to-alls around the dense attention op.
+            qkv = _constrain(qkv, mesh, "dp", None, None, "tp", None)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            attn = causal_attention(q, k, v)
         attn = attn.reshape(b, s, d)
         x = x + _constrain(
             jnp.einsum("bsz,zd->bsd", attn, block["wo"]), mesh, "dp", "sp", None

@@ -1,3 +1,4 @@
 from .attention import causal_attention
+from .ring_attention import ring_causal_attention
 
-__all__ = ["causal_attention"]
+__all__ = ["causal_attention", "ring_causal_attention"]

@@ -1,0 +1,149 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context scaling is first-class in this framework: activations stay
+sequence-sharded across the ``sp`` mesh axis end to end, and attention —
+the one op that mixes positions — is computed by rotating key/value blocks
+around the ``sp`` ring with ``jax.lax.ppermute`` while each device keeps
+its resident query block. Per-step partial results merge with the online
+(flash-style) softmax recurrence, so the full ``(seq, seq)`` score matrix
+never materializes anywhere: memory per device is O(seq_local^2) and the
+KV transfers ride the ICI ring, overlapping with each step's einsums.
+
+This is the RingAttention construction (Liu et al., 2023; see PAPERS.md)
+expressed in idiomatic JAX: ``shard_map`` makes the per-device program
+explicit, the ring step is an ``lax.scan`` (static trip count → reverse-mode
+differentiable, compiler-schedulable), and the blockwise math is einsums
+that tile onto the MXU with f32 accumulation.
+
+The reference framework (torchsnapshot) has no sequence-parallel support at
+all (SURVEY.md §2.12: absent); this op is part of the flagship workload
+that produces the sequence-sharded training state the checkpointer must
+persist, and makes multi-million-token contexts reachable without the
+all-to-all resharding the Ulysses path in ``ops.attention`` needs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import causal_attention
+
+_NEG_INF = -1e30  # finite "masked" value: keeps exp() exact-zero-free and
+# the running max finite even for fully-masked (future) blocks.
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Per-device program: local blocks ``(b, s_local, h, d)``.
+
+    Device ``r`` holds query block ``r``; at ring step ``t`` it holds the
+    KV block originally owned by device ``(r - t) mod n`` and merges that
+    block's contribution into the (max, sum, acc) online-softmax carry.
+    """
+    r = jax.lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    qf = q.astype(jnp.float32) * scale
+
+    local_pos = jnp.arange(s)
+    q_pos = r * s + local_pos  # global positions of resident queries
+
+    def ring_step(carry, t):
+        o, m, l, k_t, v_t = carry
+        src = (r - t) % axis_size
+        k_pos = src * s + local_pos
+        # (b, h, s_q, s_k) logits on the MXU, f32 accumulation.
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            qf,
+            k_t.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask, logits, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        # A fully-masked block contributes p == exp(_NEG_INF - m) == 0.
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd",
+            p,
+            v_t.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # Rotate KV around the ring: i → i+1, so next step holds src-1's
+        # block. XLA overlaps this ppermute with the next step's einsums.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_t, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_t, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, s, d), dtype=jnp.float32)
+    m0 = jnp.full((b, h, s), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, s), dtype=jnp.float32)
+    (o, _, l, _, _), _ = jax.lax.scan(
+        ring_step, (o0, m0, l0, k, v), jnp.arange(axis_size)
+    )
+    out = o / l[..., None]  # every query sees ≥ its own position ⇒ l > 0
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def ring_causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Exact causal attention with sequence sharded over ``axis_name``.
+
+    Args:
+        q, k, v: ``(batch, seq, n_heads, head_dim)``; ``seq`` must divide
+            evenly over ``mesh.shape[axis_name]``.
+        mesh: mesh containing ``axis_name`` (and optionally ``dp``/``tp``
+            for batch/head parallelism — those partitions need no
+            collectives here). ``None`` falls back to the dense op.
+
+    Returns:
+        ``(batch, seq, n_heads, head_dim)``, numerically equal (up to f32
+        roundoff) to :func:`~torchsnapshot_tpu.ops.attention.causal_attention`.
+    """
+    if mesh is None:
+        return causal_attention(q, k, v)
+    axis_size = mesh.shape[axis_name]
+    has_dp = "dp" in mesh.axis_names
+    has_tp = "tp" in mesh.axis_names
+    spec = P("dp" if has_dp else None, axis_name, "tp" if has_tp else None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, axis_size=axis_size
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention_block_specs(
+    mesh: Mesh, axis_name: str = "sp"
+) -> Tuple[P, P]:
+    """(activation, qkv) PartitionSpecs a model should constrain to so the
+    ring path sees sequence-sharded inputs without resharding."""
+    del mesh
+    return P("dp", axis_name, None), P("dp", axis_name, None, None)
