@@ -87,6 +87,54 @@ def test_concurrent_progress_extends_straggler_deadline() -> None:
     assert straggler_attempts == 3
 
 
+def test_retry_window_starts_at_first_failure_not_construction() -> None:
+    """A long quiet period between plugin construction and the first storage
+    op must not consume the retry budget: the first transient failure still
+    gets retried."""
+    import time as _time
+
+    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=5.0)
+    _time.sleep(0.05)
+    # Simulate "constructed long ago": construction-time deadline would have
+    # lapsed already with a tiny window; with lazy start it has not.
+    strategy.progress_window_seconds = 0.5
+    attempts = 0
+
+    async def op():
+        nonlocal attempts
+        attempts += 1
+        if attempts < 2:
+            raise Transient()
+        return "ok"
+
+    assert run_in_fresh_event_loop(strategy.run(op, (Transient,))) == "ok"
+    assert attempts == 2
+
+
+def test_s3_transient_taxonomy() -> None:
+    pytest.importorskip("botocore")
+    import botocore.exceptions as be
+
+    from torchsnapshot_tpu.storage_plugins.s3 import _is_transient_s3
+
+    def client_error(code=None, status=None):
+        resp = {"Error": {}, "ResponseMetadata": {}}
+        if code is not None:
+            resp["Error"]["Code"] = code
+        if status is not None:
+            resp["ResponseMetadata"]["HTTPStatusCode"] = status
+        return be.ClientError(resp, "PutObject")
+
+    assert _is_transient_s3(client_error(code="SlowDown", status=503))
+    assert _is_transient_s3(client_error(code="Throttling"))
+    assert _is_transient_s3(client_error(status=500))
+    assert _is_transient_s3(client_error(status=429))
+    assert not _is_transient_s3(client_error(code="AccessDenied", status=403))
+    assert not _is_transient_s3(client_error(code="NoSuchKey", status=404))
+    assert _is_transient_s3(ConnectionResetError())
+    assert not _is_transient_s3(ValueError())
+
+
 def test_gcs_transient_taxonomy() -> None:
     pytest.importorskip("google.resumable_media")
     import requests
@@ -124,7 +172,9 @@ def test_gcs_root_parsing_rejects_empty_bucket() -> None:
     pytest.importorskip("google.resumable_media")
     from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
 
-    with pytest.raises((ValueError, Exception)):
+    # Bucket validation happens before the credentials lookup, so this must
+    # be the ValueError itself, not some auth failure.
+    with pytest.raises(ValueError, match="Invalid GCS root"):
         GCSStoragePlugin(root="")
 
 

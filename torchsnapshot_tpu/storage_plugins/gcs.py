@@ -16,6 +16,9 @@ from __future__ import annotations
 import asyncio
 import io
 import logging
+import random
+import time
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
@@ -27,6 +30,7 @@ logger = logging.getLogger(__name__)
 
 _UPLOAD_CHUNK_SIZE = 100 * 1024 * 1024
 _DOWNLOAD_CHUNK_SIZE = 100 * 1024 * 1024
+_MAX_RECOVER_ATTEMPTS = 8
 
 
 def _import_gcs_deps():
@@ -109,18 +113,32 @@ class GCSStoragePlugin(StoragePlugin):
             "application/octet-stream",
             total_bytes=len(data),
         )
+        recover_attempts = 0
         while not upload.finished:
             try:
                 upload.transmit_next_chunk(self._session)
-            except self._common.InvalidResponse:
+                recover_attempts = 0
+            except self._common.InvalidResponse as e:
                 # Upload-recovery rewind (reference gcs.py:109-122): ask the
-                # server how far it got, reposition the stream, continue.
+                # server how far it got, reposition the stream, continue —
+                # bounded and backed off so a sustained brownout propagates
+                # out to the collective-progress retry instead of spinning.
+                if (
+                    not _is_transient(e, self._common)
+                    or recover_attempts >= _MAX_RECOVER_ATTEMPTS
+                ):
+                    raise
+                time.sleep(
+                    min(32.0, 2.0**recover_attempts)
+                    * (0.5 + random.random() / 2)
+                )
                 upload.recover(self._session)
+                recover_attempts += 1
 
     def _download_sync(
         self, path: str, byte_range: Optional[Tuple[int, int]]
     ) -> bytes:
-        blob = self._blob_name(path).replace("/", "%2F")
+        blob = urllib.parse.quote(self._blob_name(path), safe="")
         url = (
             f"https://storage.googleapis.com/download/storage/v1/b/"
             f"{self.bucket}/o/{blob}?alt=media"
@@ -144,7 +162,7 @@ class GCSStoragePlugin(StoragePlugin):
         return stream.getvalue()
 
     def _delete_sync(self, path: str) -> None:
-        blob = self._blob_name(path).replace("/", "%2F")
+        blob = urllib.parse.quote(self._blob_name(path), safe="")
         url = (
             f"https://storage.googleapis.com/storage/v1/b/"
             f"{self.bucket}/o/{blob}"

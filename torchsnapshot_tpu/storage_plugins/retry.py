@@ -36,7 +36,11 @@ class CollectiveProgressRetryStrategy:
         self, progress_window_seconds: float = DEFAULT_PROGRESS_WINDOW_SECONDS
     ) -> None:
         self.progress_window_seconds = progress_window_seconds
-        self._deadline = time.monotonic() + progress_window_seconds
+        # The window only starts ticking at the first observed failure (not
+        # at plugin construction): a checkpoint can spend minutes in
+        # staging/collectives before its first storage op, and that quiet
+        # period must not count against the retry budget.
+        self._deadline: "float | None" = None
 
     def record_progress(self) -> None:
         """Any completed operation pushes the collective deadline out."""
@@ -44,6 +48,8 @@ class CollectiveProgressRetryStrategy:
 
     @property
     def deadline_passed(self) -> bool:
+        if self._deadline is None:
+            return False
         return time.monotonic() > self._deadline
 
     async def run(
@@ -58,6 +64,10 @@ class CollectiveProgressRetryStrategy:
             try:
                 result = await op()
             except retriable_exceptions as e:
+                if self._deadline is None:
+                    self._deadline = (
+                        time.monotonic() + self.progress_window_seconds
+                    )
                 if self.deadline_passed:
                     raise RetriesExhausted(
                         f"No concurrent operation progressed within "

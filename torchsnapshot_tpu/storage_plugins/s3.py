@@ -10,6 +10,7 @@ of the package.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Optional, Tuple
 
@@ -17,6 +18,11 @@ from ..io_types import ReadIO, StoragePlugin, WriteIO
 from .retry import CollectiveProgressRetryStrategy
 
 logger = logging.getLogger(__name__)
+
+_TRANSIENT_S3_CODES = frozenset(
+    {"SlowDown", "Throttling", "ThrottlingException", "RequestTimeout",
+     "RequestLimitExceeded", "InternalError", "ServiceUnavailable"}
+)
 
 
 def _import_aiobotocore():
@@ -27,6 +33,28 @@ def _import_aiobotocore():
             "S3 support requires aiobotocore (pip install aiobotocore)"
         ) from e
     return get_session
+
+
+def _is_transient_s3(exc: BaseException) -> bool:
+    """Throttles (503 SlowDown, 429), 5xx, and connection-level failures are
+    retriable; auth/4xx errors are not."""
+    import botocore.exceptions as be
+
+    if isinstance(exc, be.ClientError):
+        err = exc.response.get("Error", {})
+        status = exc.response.get("ResponseMetadata", {}).get("HTTPStatusCode")
+        if err.get("Code") in _TRANSIENT_S3_CODES:
+            return True
+        return status is not None and (status in (408, 429) or status >= 500)
+    if isinstance(exc, (be.EndpointConnectionError, be.ConnectionError,
+                        be.HTTPClientError, be.ReadTimeoutError,
+                        be.ConnectTimeoutError)):
+        return True
+    return isinstance(exc, (OSError, asyncio.TimeoutError))
+
+
+class _TransientS3Error(Exception):
+    pass
 
 
 class S3StoragePlugin(StoragePlugin):
@@ -42,16 +70,34 @@ class S3StoragePlugin(StoragePlugin):
         self._session = get_session()
         self._client_ctx = None
         self._client = None
+        self._client_lock = asyncio.Lock()
         self._retry = CollectiveProgressRetryStrategy()
 
     def _key(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
     async def _get_client(self):
+        # Lock so N concurrent first ops don't each enter a client context
+        # (all but the last would leak their connector).
         if self._client is None:
-            self._client_ctx = self._session.create_client("s3")
-            self._client = await self._client_ctx.__aenter__()
+            async with self._client_lock:
+                if self._client is None:
+                    self._client_ctx = self._session.create_client("s3")
+                    self._client = await self._client_ctx.__aenter__()
         return self._client
+
+    async def _run_retrying(self, op):
+        async def guarded():
+            try:
+                return await op()
+            except Exception as e:
+                if _is_transient_s3(e):
+                    raise _TransientS3Error() from e
+                raise
+
+        return await self._retry.run(
+            guarded, retriable_exceptions=(_TransientS3Error,)
+        )
 
     async def write(self, write_io: WriteIO) -> None:
         client = await self._get_client()
@@ -63,7 +109,7 @@ class S3StoragePlugin(StoragePlugin):
                 Body=bytes(write_io.buf),
             )
 
-        await self._retry.run(op, retriable_exceptions=(OSError,))
+        await self._run_retrying(op)
 
     async def read(self, read_io: ReadIO) -> None:
         client = await self._get_client()
@@ -80,11 +126,15 @@ class S3StoragePlugin(StoragePlugin):
             async with resp["Body"] as stream:
                 return await stream.read()
 
-        read_io.buf = memoryview(await self._retry.run(op, retriable_exceptions=(OSError,)))
+        read_io.buf = memoryview(await self._run_retrying(op))
 
     async def delete(self, path: str) -> None:
         client = await self._get_client()
-        await client.delete_object(Bucket=self.bucket, Key=self._key(path))
+
+        async def op() -> None:
+            await client.delete_object(Bucket=self.bucket, Key=self._key(path))
+
+        await self._run_retrying(op)
 
     async def close(self) -> None:
         if self._client_ctx is not None:
