@@ -90,14 +90,13 @@ def test_concurrent_progress_extends_straggler_deadline() -> None:
 def test_retry_window_starts_at_first_failure_not_construction() -> None:
     """A long quiet period between plugin construction and the first storage
     op must not consume the retry budget: the first transient failure still
-    gets retried."""
+    gets retried. Discriminating setup: the sleep exceeds the whole window,
+    so a construction-time deadline would already have lapsed and the old
+    code raises RetriesExhausted on the very first failure."""
     import time as _time
 
-    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=5.0)
-    _time.sleep(0.05)
-    # Simulate "constructed long ago": construction-time deadline would have
-    # lapsed already with a tiny window; with lazy start it has not.
-    strategy.progress_window_seconds = 0.5
+    strategy = CollectiveProgressRetryStrategy(progress_window_seconds=2.0)
+    _time.sleep(2.1)  # quiet period longer than the window
     attempts = 0
 
     async def op():

@@ -1,0 +1,161 @@
+"""Native I/O runtime (_native.py / native/ts_io.cpp).
+
+The reference has no native code to mirror (SURVEY.md §2.9); these tests
+pin down the contract our C++ layer adds: exact ranged reads/writes,
+scatter-pack, CRC32-C known answers, errno propagation as OSError, and
+byte-identical behavior between the native and pure-Python FS plugin
+paths (the fallback must be indistinguishable).
+"""
+
+import os
+
+import pytest
+
+from torchsnapshot_tpu import _native
+from torchsnapshot_tpu.event_loop import run_in_fresh_event_loop
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.knobs import _override_env
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+native_only = pytest.mark.skipif(
+    _native.lib() is None, reason="native runtime unavailable on this host"
+)
+
+
+@native_only
+def test_write_read_roundtrip(tmp_path) -> None:
+    p = str(tmp_path / "blob")
+    data = os.urandom(1 << 16)
+    assert _native.write_file(p, data)
+    assert _native.file_size(p) == len(data)
+    out = bytearray(len(data))
+    assert _native.pread_into(p, out)
+    assert bytes(out) == data
+
+
+@native_only
+def test_ranged_pread(tmp_path) -> None:
+    p = str(tmp_path / "blob")
+    data = bytes(range(256)) * 16
+    _native.write_file(p, data)
+    out = bytearray(100)
+    _native.pread_into(p, out, offset=300)
+    assert bytes(out) == data[300:400]
+
+
+@native_only
+def test_pread_past_eof_raises(tmp_path) -> None:
+    p = str(tmp_path / "blob")
+    _native.write_file(p, b"short")
+    with pytest.raises(OSError):
+        _native.pread_into(p, bytearray(100), offset=0)
+
+
+@native_only
+def test_missing_file_raises_enoent(tmp_path) -> None:
+    with pytest.raises(OSError) as ei:
+        _native.pread_into(str(tmp_path / "nope"), bytearray(1))
+    assert ei.value.errno == 2
+
+
+@native_only
+def test_gather_memcpy_scatter_and_bounds(tmp_path) -> None:
+    dst = bytearray(64)
+    parts = [(b"aaaa", 0), (b"bb", 62), (b"cccccc", 20)]
+    assert _native.gather_memcpy(dst, parts, n_threads=2)
+    assert bytes(dst[0:4]) == b"aaaa"
+    assert bytes(dst[62:64]) == b"bb"
+    assert bytes(dst[20:26]) == b"cccccc"
+    with pytest.raises(ValueError):
+        _native.gather_memcpy(dst, [(b"xx", 63)])
+
+
+@native_only
+def test_gather_memcpy_large_multithreaded() -> None:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    srcs = [rng.integers(0, 256, size=n, dtype=np.uint8) for n in (1 << 20, 3 << 20, 1 << 10)]
+    total = sum(s.nbytes for s in srcs)
+    dst = bytearray(total)
+    off, parts = 0, []
+    for s in srcs:
+        parts.append((s, off))
+        off += s.nbytes
+    _native.gather_memcpy(dst, parts, n_threads=4)
+    assert bytes(dst) == b"".join(s.tobytes() for s in srcs)
+
+
+@native_only
+def test_crc32c_known_answer() -> None:
+    # RFC 3720 test vector.
+    assert _native.crc32c(b"123456789") == 0xE3069283
+    assert _native.crc32c(b"") == 0
+
+
+def _fs_roundtrip(root: str) -> bytes:
+    plugin = FSStoragePlugin(root)
+
+    async def go():
+        data = os.urandom(1 << 16)
+        await plugin.write(WriteIO(path="a/b/blob", buf=data))
+        whole = ReadIO(path="a/b/blob")
+        await plugin.read(whole)
+        assert bytes(whole.buf) == data
+        ranged = ReadIO(path="a/b/blob", byte_range=(100, 1100))
+        await plugin.read(ranged)
+        assert bytes(ranged.buf) == data[100:1100]
+        await plugin.close()
+        return data
+
+    return run_in_fresh_event_loop(go())
+
+
+def test_fs_plugin_native_and_fallback_parity(tmp_path) -> None:
+    _fs_roundtrip(str(tmp_path / "native"))
+    with _override_env("TORCHSNAPSHOT_TPU_DISABLE_NATIVE", "1"):
+        plugin = FSStoragePlugin(str(tmp_path / "fallback"))
+        assert plugin._native is False
+        _fs_roundtrip(str(tmp_path / "fallback"))
+
+
+@pytest.mark.parametrize("disable_native", [False, True])
+def test_fs_ranged_read_past_eof_raises_both_paths(
+    tmp_path, disable_native
+) -> None:
+    """Short blobs are corruption: ranged reads past EOF must fail the same
+    way (OSError) whether or not the native lib is in play."""
+    ctx = (
+        _override_env("TORCHSNAPSHOT_TPU_DISABLE_NATIVE", "1")
+        if disable_native
+        else _override_env("_TS_NOOP", None)
+    )
+    with ctx:
+        plugin = FSStoragePlugin(str(tmp_path))
+
+        async def go():
+            await plugin.write(WriteIO(path="blob", buf=b"short"))
+            with pytest.raises(OSError):
+                await plugin.read(ReadIO(path="blob", byte_range=(0, 100)))
+            await plugin.close()
+
+        run_in_fresh_event_loop(go())
+
+
+def test_fs_write_falls_back_when_native_vanishes_mid_process(
+    tmp_path,
+) -> None:
+    """A plugin constructed with native available must still write correctly
+    if the disable knob flips afterwards (lib() re-checks env every call)."""
+    plugin = FSStoragePlugin(str(tmp_path))
+    with _override_env("TORCHSNAPSHOT_TPU_DISABLE_NATIVE", "1"):
+
+        async def go():
+            data = os.urandom(4096)
+            await plugin.write(WriteIO(path="blob", buf=data))
+            rio = ReadIO(path="blob")
+            await plugin.read(rio)
+            assert bytes(rio.buf) == data
+            await plugin.close()
+
+        run_in_fresh_event_loop(go())

@@ -1,0 +1,243 @@
+"""ctypes bindings for the native I/O runtime (native/ts_io.cpp).
+
+The shared library is compiled on first use with the host toolchain and
+cached next to the source (falling back to a temp dir when the package is
+installed read-only). Everything degrades gracefully: if no C++ compiler
+is available or the build fails, ``lib()`` returns ``None`` and callers
+use their pure-Python paths — behavior is identical, only slower.
+
+Why ctypes and not a CPython extension: ctypes releases the GIL around
+every foreign call, which is exactly what the scheduler's executor threads
+need (N threads → N concurrent pwrite/pread streams), and it keeps the
+package importable on machines with no toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+_DISABLE_NATIVE_ENV = "TORCHSNAPSHOT_TPU_DISABLE_NATIVE"
+
+_SRC_PATH = os.path.join(os.path.dirname(__file__), "native", "ts_io.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _compiler() -> Optional[str]:
+    for cc in ("g++", "clang++", "c++"):
+        path = shutil.which(cc)
+        if path:
+            return path
+    return None
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_SRC_PATH):
+        logger.warning("native source missing at %s", _SRC_PATH)
+        return None
+    cc = _compiler()
+    if cc is None:
+        logger.info("no C++ compiler found; using pure-Python I/O paths")
+        return None
+    with open(_SRC_PATH, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src + cc.encode()).hexdigest()[:16]
+    candidates = [
+        os.path.join(os.path.dirname(_SRC_PATH), f"_ts_io_{tag}.so"),
+        os.path.join(
+            tempfile.gettempdir(), f"torchsnapshot_tpu_{os.getuid()}",
+            f"_ts_io_{tag}.so",
+        ),
+    ]
+    for so_path in candidates:
+        if os.path.exists(so_path):
+            try:
+                return ctypes.CDLL(so_path)
+            except OSError:
+                pass  # stale/corrupt cache: rebuild below
+        out_dir = os.path.dirname(so_path)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            # Build to a temp name then rename: concurrent processes racing
+            # the build each atomically install a complete .so.
+            fd, tmp_out = tempfile.mkstemp(suffix=".so", dir=out_dir)
+            os.close(fd)
+            cmd = [
+                cc, "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+                _SRC_PATH, "-o", tmp_out,
+            ]
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+            if proc.returncode != 0:
+                logger.warning(
+                    "native build failed (%s): %s", cc, proc.stderr[-2000:]
+                )
+                os.unlink(tmp_out)
+                return None
+            os.replace(tmp_out, so_path)
+            return ctypes.CDLL(so_path)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            logger.debug("native build in %s failed: %s", out_dir, e)
+            continue
+    return None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (disabled / unbuildable)."""
+    global _lib, _load_attempted
+    if _DISABLE_NATIVE_ENV in os.environ:
+        return None
+    if _load_attempted:
+        return _lib
+    with _lock:
+        if not _load_attempted:
+            l = _build_and_load()
+            if l is not None:
+                _declare(l)
+                logger.info("native I/O runtime loaded")
+            _lib = l
+            _load_attempted = True
+    return _lib
+
+
+def _declare(l: ctypes.CDLL) -> None:
+    l.ts_write_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+    ]
+    l.ts_write_file.restype = ctypes.c_int
+    l.ts_pwrite_range.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    l.ts_pwrite_range.restype = ctypes.c_int
+    l.ts_pread_range.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    l.ts_pread_range.restype = ctypes.c_int
+    l.ts_file_size.argtypes = [ctypes.c_char_p]
+    l.ts_file_size.restype = ctypes.c_int64
+    l.ts_gather_memcpy.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    l.ts_gather_memcpy.restype = None
+    l.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+    l.ts_crc32c.restype = ctypes.c_uint32
+
+
+def _raise_errno(rc: int, path: str) -> None:
+    err = -rc
+    raise OSError(err, os.strerror(err), path)
+
+
+def _addr_of(mv: memoryview) -> int:
+    """Address of a contiguous memoryview's first byte (no copy).
+
+    The address stays valid only while ``mv`` is alive — callers keep the
+    view referenced for the duration of the foreign call. Routed through
+    ``np.frombuffer`` because ctypes' ``from_buffer`` rejects read-only
+    objects (and bytes/serialized buffers are read-only).
+    """
+    import numpy as np
+
+    if mv.nbytes == 0:
+        return 0
+    return int(np.frombuffer(mv, dtype=np.uint8).ctypes.data)
+
+
+def write_file(path: str, buf, do_fsync: bool = False) -> bool:
+    """Native whole-file write. Returns False when native is unavailable."""
+    l = lib()
+    if l is None:
+        return False
+    mv = memoryview(buf).cast("B")
+    rc = l.ts_write_file(
+        path.encode(), _addr_of(mv), mv.nbytes, 1 if do_fsync else 0
+    )
+    if rc != 0:
+        _raise_errno(rc, path)
+    return True
+
+
+def pread_into(path: str, out, offset: int = 0) -> bool:
+    """Read exactly len(out) bytes at offset into writable buffer ``out``."""
+    l = lib()
+    if l is None:
+        return False
+    mv = memoryview(out).cast("B")
+    if mv.readonly:
+        raise ValueError("pread_into requires a writable buffer")
+    rc = l.ts_pread_range(path.encode(), _addr_of(mv), mv.nbytes, offset)
+    if rc != 0:
+        _raise_errno(rc, path)
+    return True
+
+
+def file_size(path: str) -> Optional[int]:
+    l = lib()
+    if l is None:
+        return None
+    size = l.ts_file_size(path.encode())
+    if size < 0:
+        _raise_errno(int(size), path)
+    return int(size)
+
+
+def gather_memcpy(
+    dst, parts: Sequence[Tuple[object, int]], n_threads: int = 4
+) -> bool:
+    """Scatter ``parts`` = [(src_buffer, dst_offset), ...] into writable
+    ``dst`` with a multithreaded native memcpy. Returns False when native
+    is unavailable (caller falls back to Python slicing)."""
+    l = lib()
+    if l is None or not parts:
+        return l is not None
+    dst_mv = memoryview(dst).cast("B")
+    if dst_mv.readonly:
+        raise ValueError("gather_memcpy requires a writable destination")
+    n = len(parts)
+    srcs = (ctypes.c_void_p * n)()
+    sizes = (ctypes.c_uint64 * n)()
+    offsets = (ctypes.c_uint64 * n)()
+    # Keep memoryviews alive (and pinned) for the duration of the call.
+    keepalive: List[memoryview] = []
+    for i, (src, off) in enumerate(parts):
+        mv = memoryview(src).cast("B")
+        keepalive.append(mv)
+        if off + mv.nbytes > dst_mv.nbytes:
+            raise ValueError(
+                f"part {i} [{off}, {off + mv.nbytes}) exceeds dst size "
+                f"{dst_mv.nbytes}"
+            )
+        srcs[i] = _addr_of(mv)
+        sizes[i] = mv.nbytes
+        offsets[i] = off
+    l.ts_gather_memcpy(
+        _addr_of(dst_mv), srcs, sizes, offsets, n, int(n_threads)
+    )
+    return True
+
+
+def crc32c(buf, seed: int = 0) -> Optional[int]:
+    """CRC32-C of a bytes-like object, or None when native is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    mv = memoryview(buf).cast("B")
+    return int(l.ts_crc32c(_addr_of(mv), mv.nbytes, seed & 0xFFFFFFFF))

@@ -28,7 +28,7 @@ import uuid
 from concurrent.futures import Executor
 from typing import Dict, List, Optional, Tuple
 
-from . import knobs
+from . import _native, knobs
 from .io_types import (
     BufferConsumer,
     BufferStager,
@@ -104,6 +104,12 @@ class BatchedBufferStager(BufferStager):
                     f"was planned at {size}; byte ranges in the manifest "
                     f"would be wrong"
                 )
+            # Large members pack with the multithreaded native memcpy;
+            # small ones aren't worth the thread spawn.
+            if size >= (8 << 20) and _native.gather_memcpy(
+                slab, [(mv, offset)], n_threads=4
+            ):
+                continue
             view[offset : offset + size] = mv
         return slab
 

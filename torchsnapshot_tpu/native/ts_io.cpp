@@ -1,0 +1,171 @@
+// Native I/O runtime for torchsnapshot_tpu.
+//
+// The reference library has no native code (SURVEY.md §2.9) — it leans on
+// aiofiles' thread pool and torch internals. Here the file-I/O and
+// slab-packing hot paths are C++: plain C-ABI functions loaded via ctypes
+// (ctypes releases the GIL for the duration of every call, so N executor
+// threads drive N concurrent pwrite/pread streams at full bandwidth).
+//
+// Design rules:
+//  - C ABI only (no pybind11 in this image); every function is
+//    exception-free and returns 0 / -errno.
+//  - No allocation of caller-visible memory: callers own all buffers, so
+//    the Python side keeps zero-copy memoryview semantics.
+//  - Threaded gather-memcpy for slab packing: memory bandwidth on a many-
+//    core host is only reachable with multiple streams.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// Saturate transfer sizes to 1 GiB per syscall (Linux caps rw syscalls at
+// 0x7ffff000 bytes anyway; looping also gives EINTR handling a boundary).
+constexpr uint64_t kMaxIoChunk = 1ull << 30;
+
+int write_all(int fd, const char* buf, uint64_t len, uint64_t offset) {
+  while (len > 0) {
+    uint64_t n = len < kMaxIoChunk ? len : kMaxIoChunk;
+    ssize_t w = ::pwrite(fd, buf, n, static_cast<off_t>(offset));
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    buf += w;
+    offset += static_cast<uint64_t>(w);
+    len -= static_cast<uint64_t>(w);
+  }
+  return 0;
+}
+
+int read_all(int fd, char* buf, uint64_t len, uint64_t offset) {
+  while (len > 0) {
+    uint64_t n = len < kMaxIoChunk ? len : kMaxIoChunk;
+    ssize_t r = ::pread(fd, buf, n, static_cast<off_t>(offset));
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (r == 0) return -EIO;  // short file: caller asked past EOF
+    buf += r;
+    offset += static_cast<uint64_t>(r);
+    len -= static_cast<uint64_t>(r);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write `len` bytes to a fresh file at `path` (O_TRUNC). `do_fsync`:
+// 0 = none (commit protocol tolerates torn data files; metadata is the
+// barrier), 1 = fdatasync before close.
+int ts_write_file(const char* path, const void* buf, uint64_t len,
+                  int do_fsync) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (fd < 0) return -errno;
+  int rc = write_all(fd, static_cast<const char*>(buf), len, 0);
+  if (rc == 0 && do_fsync) {
+    if (::fdatasync(fd) != 0) rc = -errno;
+  }
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
+// Write `len` bytes at `offset` into an existing (or new) file without
+// truncation — used for slab writes composed of multiple ranges.
+int ts_pwrite_range(const char* path, const void* buf, uint64_t len,
+                    uint64_t offset) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_CLOEXEC, 0644);
+  if (fd < 0) return -errno;
+  int rc = write_all(fd, static_cast<const char*>(buf), len, offset);
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
+// Read exactly `len` bytes at `offset` from `path` into caller's buffer.
+int ts_pread_range(const char* path, void* buf, uint64_t len,
+                   uint64_t offset) {
+  int fd = ::open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return -errno;
+  int rc = read_all(fd, static_cast<char*>(buf), len, offset);
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
+int64_t ts_file_size(const char* path) {
+  struct stat st;
+  if (::stat(path, &st) != 0) return -static_cast<int64_t>(errno);
+  return static_cast<int64_t>(st.st_size);
+}
+
+// Scatter `n` source buffers into `dst` at `dst_offsets`, using up to
+// `n_threads` threads. Work is split by bytes, and a single large source
+// region is itself split across threads, so one 1 GiB tensor doesn't
+// serialize the pack.
+void ts_gather_memcpy(void* dst, const void** srcs, const uint64_t* sizes,
+                      const uint64_t* dst_offsets, uint64_t n,
+                      int n_threads) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; ++i) total += sizes[i];
+  if (total == 0) return;
+  if (n_threads < 1) n_threads = 1;
+  uint64_t per_thread = (total + n_threads - 1) / n_threads;
+
+  auto worker = [&](uint64_t begin, uint64_t end) {
+    // [begin, end) in concatenated-byte space.
+    uint64_t pos = 0;
+    for (uint64_t i = 0; i < n && pos < end; ++i) {
+      uint64_t lo = pos, hi = pos + sizes[i];
+      pos = hi;
+      if (hi <= begin) continue;
+      uint64_t s = begin > lo ? begin - lo : 0;
+      uint64_t e = (end < hi ? end : hi) - lo;
+      if (e <= s) continue;
+      std::memcpy(static_cast<char*>(dst) + dst_offsets[i] + s,
+                  static_cast<const char*>(srcs[i]) + s, e - s);
+    }
+  };
+
+  std::vector<std::thread> threads;
+  for (int t = 1; t < n_threads; ++t) {
+    uint64_t begin = per_thread * t;
+    if (begin >= total) break;
+    uint64_t end = begin + per_thread < total ? begin + per_thread : total;
+    threads.emplace_back(worker, begin, end);
+  }
+  worker(0, per_thread < total ? per_thread : total);
+  for (auto& th : threads) th.join();
+}
+
+// CRC32-C (Castagnoli), table-driven; for storage integrity records.
+uint32_t ts_crc32c(const void* buf, uint64_t len, uint32_t seed) {
+  struct Table {
+    uint32_t v[256];
+    Table() {
+      for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+          c = (c >> 1) ^ (0x82F63B78u & (0u - (c & 1)));
+        v[i] = c;
+      }
+    }
+  };
+  static const Table table_holder;  // magic static: thread-safe init
+  const uint32_t* table = table_holder.v;
+  uint32_t crc = ~seed;
+  const unsigned char* p = static_cast<const unsigned char*>(buf);
+  for (uint64_t i = 0; i < len; ++i)
+    crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+}  // extern "C"

@@ -30,7 +30,13 @@ logger = logging.getLogger(__name__)
 
 _UPLOAD_CHUNK_SIZE = 100 * 1024 * 1024
 _DOWNLOAD_CHUNK_SIZE = 100 * 1024 * 1024
-_MAX_RECOVER_ATTEMPTS = 8
+# In-thread recover attempts are capped LOW with short sleeps: each one
+# blocks a gcs-io executor thread, and with every worker sleeping nothing
+# can record progress on the collective deadline. Persistent failures
+# propagate out to the async retry strategy, whose asyncio.sleep backoff
+# holds no thread.
+_MAX_RECOVER_ATTEMPTS = 2
+_RECOVER_SLEEP_SECONDS = 0.5
 
 
 def _import_gcs_deps():
@@ -128,10 +134,7 @@ class GCSStoragePlugin(StoragePlugin):
                     or recover_attempts >= _MAX_RECOVER_ATTEMPTS
                 ):
                     raise
-                time.sleep(
-                    min(32.0, 2.0**recover_attempts)
-                    * (0.5 + random.random() / 2)
-                )
+                time.sleep(_RECOVER_SLEEP_SECONDS * (0.5 + random.random()))
                 upload.recover(self._session)
                 recover_attempts += 1
 
