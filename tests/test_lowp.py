@@ -1,0 +1,92 @@
+"""Low-precision codec round-trips (lowp.py) — the analog of the
+reference's per-dtype serialization tests (tests/test_serialization.py)
+applied to the q8 layouts (reference serialization.py:257-456)."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import lowp
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "bfloat16"])
+def test_per_tensor_roundtrip_error_bound(dtype) -> None:
+    import ml_dtypes
+
+    dt = np.dtype(dtype) if dtype != "bfloat16" else np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.default_rng(0)
+    arr = (rng.standard_normal((64, 33)) * 3).astype(dt)
+    buf = lowp.encode_per_tensor(arr)
+    assert len(buf) == arr.size + 16
+    out = lowp.decode_per_tensor(buf, arr.shape)
+    span = float(np.max(arr.astype(np.float32)) - np.min(arr.astype(np.float32)))
+    # Affine int8: max error is half a quantization step.
+    assert np.max(np.abs(out - arr.astype(np.float32))) <= span / 255 + 1e-6
+
+
+def test_per_tensor_zero_exactness() -> None:
+    arr = np.zeros((10, 10), dtype=np.float32)
+    arr[3, 4] = 5.0
+    out = lowp.decode_per_tensor(lowp.encode_per_tensor(arr), arr.shape)
+    assert np.all(out[arr == 0.0] == 0.0)
+
+
+def test_per_tensor_constant_array() -> None:
+    arr = np.full((7,), 2.5, dtype=np.float32)
+    out = lowp.decode_per_tensor(lowp.encode_per_tensor(arr), arr.shape)
+    assert np.max(np.abs(out - arr)) <= (2.5 / 255) + 1e-6
+
+
+def test_per_tensor_wrong_size_raises() -> None:
+    with pytest.raises(ValueError, match="bytes"):
+        lowp.decode_per_tensor(b"\x00" * 10, (64,))
+
+
+def test_per_tensor_rejects_int_arrays() -> None:
+    with pytest.raises(ValueError, match="float"):
+        lowp.encode_per_tensor(np.arange(10, dtype=np.int32))
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_per_channel_roundtrip(axis) -> None:
+    rng = np.random.default_rng(1)
+    # Per-channel shines when channel ranges differ wildly.
+    arr = rng.standard_normal((8, 16, 4)).astype(np.float32)
+    scale_per_c = 10.0 ** np.arange(arr.shape[axis])
+    arr = np.moveaxis(
+        np.moveaxis(arr, axis, 0) * scale_per_c[:, None, None], 0, axis
+    ).astype(np.float32)
+    buf = lowp.encode_per_channel(arr, axis)
+    out = lowp.decode_per_channel(buf, arr.shape)
+    moved_in = np.moveaxis(arr, axis, 0)
+    moved_out = np.moveaxis(out, axis, 0)
+    for c in range(moved_in.shape[0]):
+        span = float(np.max(moved_in[c]) - np.min(moved_in[c]))
+        span = max(span, abs(float(np.max(moved_in[c]))), 1e-6)
+        assert np.max(np.abs(moved_out[c] - moved_in[c])) <= span / 255 + 1e-6
+
+
+def test_per_channel_beats_per_tensor_on_mixed_scales() -> None:
+    rng = np.random.default_rng(2)
+    arr = np.stack(
+        [rng.standard_normal(256) * s for s in (0.01, 100.0)]
+    ).astype(np.float32)
+    pt = lowp.decode_per_tensor(lowp.encode_per_tensor(arr), arr.shape)
+    pc = lowp.decode_per_channel(lowp.encode_per_channel(arr, 0), arr.shape)
+    err_pt = np.max(np.abs(pt[0] - arr[0]))  # small-scale channel suffers
+    err_pc = np.max(np.abs(pc[0] - arr[0]))
+    assert err_pc < err_pt / 100
+
+
+def test_per_channel_layout_is_documented_format() -> None:
+    import struct
+
+    arr = np.ones((2, 3), dtype=np.float32)
+    buf = lowp.encode_per_channel(arr, 1)
+    (axis,) = struct.unpack("<q", buf[:8])
+    assert axis == 1
+    assert len(buf) == 8 + arr.size + 3 * 16
+
+
+def test_per_channel_bad_axis_raises() -> None:
+    with pytest.raises(ValueError, match="axis"):
+        lowp.quantize_per_channel(np.ones((2, 2), dtype=np.float32), 5)

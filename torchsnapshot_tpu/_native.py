@@ -68,6 +68,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             except OSError:
                 pass  # stale/corrupt cache: rebuild below
         out_dir = os.path.dirname(so_path)
+        tmp_out = None
         try:
             os.makedirs(out_dir, exist_ok=True)
             # Build to a temp name then rename: concurrent processes racing
@@ -85,13 +86,19 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 logger.warning(
                     "native build failed (%s): %s", cc, proc.stderr[-2000:]
                 )
-                os.unlink(tmp_out)
                 return None
             os.replace(tmp_out, so_path)
+            tmp_out = None
             return ctypes.CDLL(so_path)
         except (OSError, subprocess.TimeoutExpired) as e:
             logger.debug("native build in %s failed: %s", out_dir, e)
             continue
+        finally:
+            if tmp_out is not None:
+                try:
+                    os.unlink(tmp_out)
+                except OSError:
+                    pass
     return None
 
 
@@ -118,10 +125,6 @@ def _declare(l: ctypes.CDLL) -> None:
         ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
     ]
     l.ts_write_file.restype = ctypes.c_int
-    l.ts_pwrite_range.argtypes = [
-        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
-    ]
-    l.ts_pwrite_range.restype = ctypes.c_int
     l.ts_pread_range.argtypes = [
         ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
     ]

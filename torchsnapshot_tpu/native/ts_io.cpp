@@ -80,17 +80,6 @@ int ts_write_file(const char* path, const void* buf, uint64_t len,
   return rc;
 }
 
-// Write `len` bytes at `offset` into an existing (or new) file without
-// truncation — used for slab writes composed of multiple ranges.
-int ts_pwrite_range(const char* path, const void* buf, uint64_t len,
-                    uint64_t offset) {
-  int fd = ::open(path, O_WRONLY | O_CREAT | O_CLOEXEC, 0644);
-  if (fd < 0) return -errno;
-  int rc = write_all(fd, static_cast<const char*>(buf), len, offset);
-  if (::close(fd) != 0 && rc == 0) rc = -errno;
-  return rc;
-}
-
 // Read exactly `len` bytes at `offset` from `path` into caller's buffer.
 int ts_pread_range(const char* path, void* buf, uint64_t len,
                    uint64_t offset) {

@@ -30,13 +30,15 @@ logger = logging.getLogger(__name__)
 
 _UPLOAD_CHUNK_SIZE = 100 * 1024 * 1024
 _DOWNLOAD_CHUNK_SIZE = 100 * 1024 * 1024
-# In-thread recover attempts are capped LOW with short sleeps: each one
-# blocks a gcs-io executor thread, and with every worker sleeping nothing
-# can record progress on the collective deadline. Persistent failures
-# propagate out to the async retry strategy, whose asyncio.sleep backoff
-# holds no thread.
-_MAX_RECOVER_ATTEMPTS = 2
-_RECOVER_SLEEP_SECONDS = 0.5
+# In-thread recover keeps the resumable session alive through brief
+# brownouts (losing it forfeits every already-confirmed chunk: the outer
+# retry restarts the upload from byte 0). Sleeps are short and capped —
+# each blocks a gcs-io executor thread, and with every worker sleeping
+# nothing can record progress on the collective deadline — so the total
+# in-thread stall is bounded at ~8s before the failure propagates to the
+# async retry strategy, whose asyncio.sleep backoff holds no thread.
+_MAX_RECOVER_ATTEMPTS = 6
+_RECOVER_SLEEP_CAP_SECONDS = 2.0
 
 
 def _import_gcs_deps():
@@ -134,7 +136,10 @@ class GCSStoragePlugin(StoragePlugin):
                     or recover_attempts >= _MAX_RECOVER_ATTEMPTS
                 ):
                     raise
-                time.sleep(_RECOVER_SLEEP_SECONDS * (0.5 + random.random()))
+                time.sleep(
+                    min(_RECOVER_SLEEP_CAP_SECONDS, 0.25 * 2**recover_attempts)
+                    * (0.5 + random.random())
+                )
                 upload.recover(self._session)
                 recover_attempts += 1
 

@@ -1,0 +1,8 @@
+"""Framework integrations (reference parity: torchsnapshot/tricks/).
+
+- :mod:`.flax` — ``TrainStateStateful`` for flax train states.
+- :mod:`.orbax` — checkpoint migration to/from orbax format.
+
+Submodules are imported lazily by users (``from torchsnapshot_tpu.tricks
+import flax``) so optional dependencies stay optional.
+"""

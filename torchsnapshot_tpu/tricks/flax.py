@@ -1,0 +1,62 @@
+"""Flax integration: checkpoint ``TrainState`` (and any flax module state)
+with zero boilerplate.
+
+Reference parity: the ``tricks/`` integration layer — the reference ships
+a DeepSpeed engine bridge (tricks/deepspeed.py:19-104) that adapts an
+external training framework's state objects to its Stateful protocol.
+Flax is the framework of record on TPU; its ``TrainState`` is an
+immutable pytree dataclass, so the adapter holds the current state and
+swaps in the restored one (same pattern as
+:class:`~torchsnapshot_tpu.state_dict.PyTreeState`, specialized to keep
+the non-array fields — ``apply_fn``, ``tx`` — out of the checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..state_dict import pytree_to_state_dict, state_dict_to_pytree
+
+
+class TrainStateStateful:
+    """Adapt a ``flax.training.train_state.TrainState`` (or any
+    ``.replace()``-able dataclass pytree with ``params``/``opt_state``/
+    ``step`` fields) to the Stateful protocol.
+
+    Usage::
+
+        tss = TrainStateStateful(train_state)
+        Snapshot.take(path, {"train": tss})
+        ...
+        Snapshot(path).restore({"train": tss})
+        train_state = tss.state   # restored TrainState, same apply_fn/tx
+    """
+
+    _FIELDS = ("params", "opt_state", "step")
+
+    def __init__(self, state: Any) -> None:
+        for f in self._FIELDS:
+            if not hasattr(state, f):
+                raise TypeError(
+                    f"{type(state).__name__} has no {f!r} field; "
+                    f"TrainStateStateful expects a flax-style train state"
+                )
+        if not hasattr(state, "replace"):
+            raise TypeError(
+                f"{type(state).__name__} has no .replace(); "
+                f"TrainStateStateful expects a dataclass pytree"
+            )
+        self.state = state
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            f: pytree_to_state_dict(getattr(self.state, f))
+            for f in self._FIELDS
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        restored = {
+            f: state_dict_to_pytree(state_dict[f], getattr(self.state, f))
+            for f in self._FIELDS
+        }
+        self.state = self.state.replace(**restored)

@@ -1,0 +1,75 @@
+"""Orbax interop: migrate checkpoints between orbax and Snapshot formats.
+
+Reference parity: the reference's tricks layer bridges an external
+checkpoint system into its own take/restore path (tricks/deepspeed.py —
+``_save_zero_checkpoint``/``_load_zero_checkpoint`` are rerouted to
+torchsnapshot). On TPU the incumbent checkpointer is orbax; teams
+switching to this framework have orbax checkpoint dirs to carry over, and
+tooling they still run may expect orbax layout. These helpers convert in
+both directions through host memory (one pytree at a time).
+
+Orbax is import-gated: the package works without it, these two functions
+don't.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def _import_orbax():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise RuntimeError(
+            "orbax interop requires orbax-checkpoint (pip install "
+            "orbax-checkpoint)"
+        ) from e
+    return ocp
+
+
+def load_orbax_pytree(orbax_path: str, item: Optional[Any] = None) -> Any:
+    """Restore an orbax checkpoint as a host pytree.
+
+    ``item`` (optional) is a template pytree of the expected structure;
+    without it orbax restores raw (dicts + arrays).
+    """
+    ocp = _import_orbax()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if item is None:
+            return ckptr.restore(orbax_path)
+        return ckptr.restore(orbax_path, item=item)
+
+
+def migrate_orbax_to_snapshot(
+    orbax_path: str,
+    snapshot_path: str,
+    item: Optional[Any] = None,
+    key: str = "state",
+) -> None:
+    """Read an orbax checkpoint and write it as a Snapshot at
+    ``snapshot_path`` under app-state key ``key``."""
+    from ..snapshot import Snapshot
+    from ..state_dict import PyTreeState
+
+    tree = load_orbax_pytree(orbax_path, item=item)
+    Snapshot.take(snapshot_path, {key: PyTreeState(tree)})
+
+
+def migrate_snapshot_to_orbax(
+    snapshot_path: str,
+    orbax_path: str,
+    item: Any,
+    key: str = "state",
+) -> Any:
+    """Restore app-state ``key`` from a Snapshot into ``item``'s structure
+    and save it as an orbax checkpoint. Returns the restored pytree."""
+    ocp = _import_orbax()
+    from ..snapshot import Snapshot
+    from ..state_dict import PyTreeState
+
+    stateful = PyTreeState(item)
+    Snapshot(snapshot_path).restore({key: stateful})
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(orbax_path, stateful.tree)
+    return stateful.tree
