@@ -1,0 +1,66 @@
+"""Knob getters, env sourcing, and override context managers.
+
+Reference parity: torchsnapshot/knobs.py:32-98 (same knob surface under the
+TORCHSNAPSHOT_TPU_ prefix).
+"""
+
+from __future__ import annotations
+
+import os
+
+from torchsnapshot_tpu import knobs
+
+
+def test_defaults() -> None:
+    assert knobs.get_max_chunk_size_bytes() == 512 * 1024 * 1024
+    assert knobs.get_max_shard_size_bytes() == 512 * 1024 * 1024
+    assert knobs.get_slab_size_threshold_bytes() == 128 * 1024 * 1024
+    assert not knobs.is_batching_enabled()
+    assert knobs.get_per_rank_memory_budget_bytes_override() is None
+    assert not knobs.is_partitioner_disabled()
+    assert knobs.get_per_rank_io_concurrency() == 16
+    assert knobs.get_staging_threads() == 4
+
+
+def test_override_context_managers_restore_prior_value() -> None:
+    with knobs.override_max_chunk_size_bytes(1234):
+        assert knobs.get_max_chunk_size_bytes() == 1234
+        with knobs.override_max_chunk_size_bytes(99):
+            assert knobs.get_max_chunk_size_bytes() == 99
+        assert knobs.get_max_chunk_size_bytes() == 1234
+    assert knobs.get_max_chunk_size_bytes() == 512 * 1024 * 1024
+
+    with knobs.override_max_shard_size_bytes(77):
+        assert knobs.get_max_shard_size_bytes() == 77
+    with knobs.override_slab_size_threshold_bytes(55):
+        assert knobs.get_slab_size_threshold_bytes() == 55
+    with knobs.override_per_rank_memory_budget_bytes(4096):
+        assert knobs.get_per_rank_memory_budget_bytes_override() == 4096
+    assert knobs.get_per_rank_memory_budget_bytes_override() is None
+
+
+def test_batching_enabled_by_env_presence() -> None:
+    """Presence of the env var — any value — turns batching on
+    (reference knobs.py:53-57)."""
+    assert not knobs.is_batching_enabled()
+    with knobs.enable_batching():
+        assert knobs.is_batching_enabled()
+    assert not knobs.is_batching_enabled()
+    os.environ["TORCHSNAPSHOT_TPU_ENABLE_BATCHING"] = "0"
+    try:
+        assert knobs.is_batching_enabled()
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_ENABLE_BATCHING"]
+
+
+def test_env_values_read_lazily() -> None:
+    os.environ["TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY"] = "3"
+    os.environ["TORCHSNAPSHOT_TPU_STAGING_THREADS"] = "2"
+    try:
+        assert knobs.get_per_rank_io_concurrency() == 3
+        assert knobs.get_staging_threads() == 2
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY"]
+        del os.environ["TORCHSNAPSHOT_TPU_STAGING_THREADS"]
+    assert knobs.get_per_rank_io_concurrency() == 16
+    assert knobs.get_staging_threads() == 4

@@ -1,0 +1,216 @@
+"""Partitioner unit tests: greedy write-load balancing of replicated state
+and post-gather consolidation.
+
+Reference parity: tests/test_partitioner.py (partitioner.py:42-79, :169-233,
+:236-292). Multi-rank execution is simulated with threads over an
+InProcessStore — the partitioner only exchanges metadata.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Tuple
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.dist_store import InProcessStore
+from torchsnapshot_tpu.io_preparer import prepare_write
+from torchsnapshot_tpu.io_types import WriteReq
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    Shard,
+)
+from torchsnapshot_tpu.partitioner import (
+    consolidate_replicated_entries,
+    partition_write_reqs,
+)
+from torchsnapshot_tpu.pg_wrapper import PGWrapper
+from torchsnapshot_tpu.test_utils import ProcessGroup, rand_array
+
+
+def _rank_reqs(
+    rank: int, personal_rows: int, replicated_specs: Dict[str, int]
+) -> Tuple[Dict[str, Entry], List[WriteReq]]:
+    """Build one rank's manifest + write reqs: a personal array plus the
+    shared replicated arrays (identical across ranks by construction)."""
+    entries: Dict[str, Entry] = {}
+    reqs: List[WriteReq] = []
+    entry, wrs = prepare_write(
+        rand_array((personal_rows, 256), "float32", seed=rank),
+        "personal",
+        rank=rank,
+        replicated=False,
+    )
+    entries["personal"] = entry
+    reqs.extend(wrs)
+    for name, rows in replicated_specs.items():
+        entry, wrs = prepare_write(
+            rand_array((rows, 256), "float32", seed=100),
+            name,
+            rank=rank,
+            replicated=True,
+        )
+        entries[name] = entry
+        reqs.extend(wrs)
+    return entries, reqs
+
+
+def _run_partition(
+    world_size: int, personal_rows_by_rank: List[int], replicated_specs: Dict[str, int]
+) -> List[List[WriteReq]]:
+    store = InProcessStore()
+
+    def fn(rank: int) -> List[WriteReq]:
+        pg = PGWrapper(ProcessGroup(store=store, rank=rank, world_size=world_size))
+        entries, reqs = _rank_reqs(
+            rank, personal_rows_by_rank[rank], replicated_specs
+        )
+        _, kept = partition_write_reqs(entries, reqs, pg)
+        return kept
+
+    with ThreadPoolExecutor(max_workers=world_size) as ex:
+        futs = [ex.submit(fn, r) for r in range(world_size)]
+        return [f.result(timeout=60) for f in futs]
+
+
+def test_each_replicated_path_written_exactly_once() -> None:
+    replicated = {"a": 8, "b": 16, "c": 24, "d": 4, "e": 12}
+    kept_by_rank = _run_partition(3, [4, 4, 4], replicated)
+    seen: Dict[str, int] = {}
+    for rank, kept in enumerate(kept_by_rank):
+        # Every rank keeps its own personal write.
+        personal = [r for r in kept if r.path == f"{rank}/personal"]
+        assert len(personal) == 1
+        for req in kept:
+            if req.path.startswith("replicated/"):
+                assert req.path not in seen, "path assigned to two ranks"
+                seen[req.path] = rank
+    assert sorted(seen) == sorted(f"replicated/{k}" for k in replicated)
+
+
+def test_greedy_assignment_balances_loads() -> None:
+    """A rank with a heavy unavoidable personal load receives less
+    replicated work (reference _partition_write_loads, partitioner.py:42-79)."""
+    replicated = {f"r{i}": 8 for i in range(8)}
+    kept_by_rank = _run_partition(2, [512, 4], replicated)
+    rep_bytes = [
+        sum(
+            r.buffer_stager.get_staging_cost_bytes()
+            for r in kept
+            if r.path.startswith("replicated/")
+        )
+        for kept in kept_by_rank
+    ]
+    # Rank 0's personal array (512x256 fp32 = 512 KB) dwarfs the total
+    # replicated volume (8 * 8 KB); everything replicated goes to rank 1.
+    assert rep_bytes[0] == 0
+    assert rep_bytes[1] > 0
+
+
+def test_world1_keeps_everything() -> None:
+    pg = PGWrapper(None)
+    entries, reqs = _rank_reqs(0, 4, {"a": 8})
+    _, kept = partition_write_reqs(entries, reqs, pg)
+    assert kept == reqs
+
+
+def test_disable_partitioner_raises() -> None:
+    store = InProcessStore()
+    pg = PGWrapper(ProcessGroup(store=store, rank=0, world_size=2))
+    entries, reqs = _rank_reqs(0, 4, {"a": 8})
+    import os
+
+    os.environ["TORCHSNAPSHOT_TPU_DISABLE_PARTITIONER"] = "1"
+    try:
+        with pytest.raises(NotImplementedError):
+            partition_write_reqs(entries, reqs, pg)
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_DISABLE_PARTITIONER"]
+
+
+def test_chunked_replicated_chunks_spread_across_ranks() -> None:
+    """Chunked entries are sub-partitionable: with one large replicated
+    chunked array and equal base loads, both ranks get some chunks."""
+    with knobs.override_max_chunk_size_bytes(256 * 64):  # 16 rows per chunk
+        kept_by_rank = _run_partition(2, [1, 1], {"big": 64})  # 4 chunks
+    rep_counts = [
+        sum(1 for r in kept if r.path.startswith("replicated/"))
+        for kept in kept_by_rank
+    ]
+    assert sum(rep_counts) == 4
+    assert rep_counts[0] > 0 and rep_counts[1] > 0
+
+
+# ---------------------------------------------------------------------------
+# consolidate_replicated_entries
+# ---------------------------------------------------------------------------
+
+
+def _arr_entry(location: str, replicated: bool = True) -> ArrayEntry:
+    return ArrayEntry(
+        location=location,
+        serializer="buffer_protocol",
+        dtype="float32",
+        shape=[4],
+        replicated=replicated,
+    )
+
+
+def test_consolidate_identical_entries() -> None:
+    m0 = {"x": _arr_entry("replicated/x"), "y": _arr_entry("0/y", replicated=False)}
+    m1 = {"x": _arr_entry("replicated/x")}
+    merged = consolidate_replicated_entries([m0, m1])
+    assert sorted(merged) == ["x"]
+    assert merged["x"] == _arr_entry("replicated/x")
+
+
+def test_consolidate_prefers_batch_rewritten_entry() -> None:
+    plain = _arr_entry("replicated/x")
+    rewritten = ArrayEntry(
+        location="batched/u-u-i-d",
+        serializer="buffer_protocol",
+        dtype="float32",
+        shape=[4],
+        replicated=True,
+        byte_range=[0, 16],
+    )
+    for order in ([{"x": plain}, {"x": rewritten}], [{"x": rewritten}, {"x": plain}]):
+        merged = consolidate_replicated_entries(order)
+        assert merged["x"].location == "batched/u-u-i-d"
+
+
+def test_consolidate_mismatch_raises() -> None:
+    a = _arr_entry("replicated/x")
+    b = ArrayEntry(
+        location="replicated/x",
+        serializer="buffer_protocol",
+        dtype="float64",  # genuine payload mismatch
+        shape=[4],
+        replicated=True,
+    )
+    with pytest.raises(AssertionError, match="mismatch"):
+        consolidate_replicated_entries([{"x": a}, {"x": b}])
+
+
+def test_consolidate_unions_chunked_entries() -> None:
+    def chunk(start: int) -> Shard:
+        return Shard(
+            offsets=[start],
+            sizes=[4],
+            array=_arr_entry(f"replicated/big_{start}"),
+        )
+
+    def chunked(chunks: List[Shard]) -> ChunkedArrayEntry:
+        return ChunkedArrayEntry(
+            dtype="float32", shape=[8], chunks=chunks, replicated=True
+        )
+
+    m0 = {"big": chunked([chunk(0), chunk(4)])}
+    m1 = {"big": chunked([chunk(0), chunk(4)])}
+    merged = consolidate_replicated_entries([m0, m1])
+    offs = [c.offsets[0] for c in merged["big"].chunks]
+    assert offs == [0, 4]

@@ -1,0 +1,34 @@
+"""RSS profiler sanity: the sampler observes a large transient allocation.
+
+Reference parity: tests/test_rss_profiler.py (rss_profiler.py:20-56).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from torchsnapshot_tpu.utils.rss_profiler import RSSDeltas, measure_rss_deltas
+
+
+def test_measures_peak_allocation() -> None:
+    deltas = RSSDeltas()
+    nbytes = 256 * 1024 * 1024
+    with measure_rss_deltas(deltas, sample_period_seconds=0.01):
+        blob = np.ones(nbytes // 8, dtype=np.float64)
+        blob += 1.0  # touch every page
+        s = float(blob.sum())
+        del blob
+    assert s > 0
+    assert len(deltas.deltas) >= 1
+    # Peak should see most of the 256 MB allocation.
+    assert deltas.peak_bytes > nbytes // 2
+
+
+def test_no_allocation_small_peak() -> None:
+    deltas = RSSDeltas()
+    with measure_rss_deltas(deltas, sample_period_seconds=0.01):
+        x = sum(range(1000))
+    assert x == 499500
+    # A final sample is always appended at exit.
+    assert len(deltas.deltas) >= 1
+    assert deltas.peak_bytes < 64 * 1024 * 1024

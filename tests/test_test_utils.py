@@ -1,0 +1,87 @@
+"""The test harness itself: equality helpers, random data, and
+multiprocess error propagation.
+
+Reference parity: tests/test_test_utils.py (test_utils.py:72-290).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.test_utils import (
+    assert_tree_eq,
+    multiprocess_test,
+    rand_array,
+    run_multiprocess,
+    tree_eq,
+)
+
+
+def test_tree_eq_nested() -> None:
+    a = {"x": [np.arange(3), {"y": 1.5}], "z": "s"}
+    b = {"x": [np.arange(3), {"y": 1.5}], "z": "s"}
+    assert tree_eq(a, b)
+    b["x"][0] = np.array([0, 1, 3])
+    assert not tree_eq(a, b)
+
+
+def test_tree_eq_dtype_and_shape_sensitive() -> None:
+    assert not tree_eq(np.zeros(3, np.float32), np.zeros(3, np.float64))
+    assert not tree_eq(np.zeros((3, 1)), np.zeros(3))
+    assert tree_eq(np.zeros(3), np.zeros(3))
+
+
+def test_tree_eq_key_mismatch() -> None:
+    assert not tree_eq({"a": 1}, {"b": 1})
+    assert not tree_eq([1, 2], [1, 2, 3])
+
+
+def test_tree_eq_jax_leaves() -> None:
+    import jax.numpy as jnp
+
+    assert tree_eq({"w": jnp.ones(4)}, {"w": np.ones(4, np.float32)})
+
+
+def test_assert_tree_eq_raises_with_context() -> None:
+    with pytest.raises(AssertionError, match="Trees differ"):
+        assert_tree_eq({"a": 1}, {"a": 2})
+
+
+@pytest.mark.parametrize(
+    "dtype", ["float32", "float16", "int8", "uint8", "int32", "bool", "complex64"]
+)
+def test_rand_array_dtypes(dtype: str) -> None:
+    arr = rand_array((4, 3), dtype, seed=1)
+    assert arr.shape == (4, 3)
+    assert arr.dtype == np.dtype(dtype)
+    again = rand_array((4, 3), dtype, seed=1)
+    np.testing.assert_array_equal(arr, again)
+
+
+def _failing_rank_fn(pg) -> int:
+    if pg.rank == 1:
+        raise RuntimeError("rank 1 exploded")
+    return pg.rank
+
+
+def test_run_multiprocess_propagates_worker_error() -> None:
+    with pytest.raises(AssertionError, match="rank 1 exploded"):
+        run_multiprocess(_failing_rank_fn, nproc=2)
+
+
+def _rank_result_fn(pg, base: int) -> int:
+    return base + pg.rank
+
+
+def test_run_multiprocess_returns_rank_ordered_results() -> None:
+    assert run_multiprocess(_rank_result_fn, nproc=2, args=(10,)) == [10, 11]
+
+
+def test_multiprocess_test_decorator_metadata() -> None:
+    @multiprocess_test(nproc=2)
+    def my_test(pg) -> None:  # pragma: no cover - not executed here
+        pass
+
+    assert my_test.__name__ == "my_test"
+    assert callable(my_test._ts_inner_fn)

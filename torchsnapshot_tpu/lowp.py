@@ -107,15 +107,25 @@ def quantize_per_channel(
     axis %= x.ndim
     moved = np.moveaxis(x, axis, 0)
     flat = moved.reshape(moved.shape[0], -1)
-    scales = np.empty(flat.shape[0], dtype=np.float64)
-    zps = np.empty(flat.shape[0], dtype=np.int64)
-    qflat = np.empty_like(flat, dtype=_Q_DTYPE)
-    for c in range(flat.shape[0]):
-        s, z = _affine_params(flat[c])
-        scales[c], zps[c] = s, z
-        qflat[c] = np.clip(np.round(flat[c] / s) + z, _QMIN, _QMAX).astype(
-            _Q_DTYPE
-        )
+    if flat.size:
+        lo = np.minimum(flat.min(axis=1), 0.0).astype(np.float64)
+        hi = np.maximum(flat.max(axis=1), 0.0).astype(np.float64)
+    else:
+        lo = np.zeros(flat.shape[0], dtype=np.float64)
+        hi = np.zeros(flat.shape[0], dtype=np.float64)
+    degenerate = hi == lo
+    scales = np.where(degenerate, 1.0, (hi - lo) / (_QMAX - _QMIN))
+    zps = np.where(
+        degenerate,
+        0,
+        np.clip(np.round(_QMIN - lo / scales), _QMIN, _QMAX),
+    ).astype(np.int64)
+    qflat = np.clip(
+        np.round(flat / scales[:, None].astype(np.float32))
+        + zps[:, None].astype(np.float32),
+        _QMIN,
+        _QMAX,
+    ).astype(_Q_DTYPE)
     q = np.moveaxis(qflat.reshape(moved.shape), 0, axis)
     return q, scales, zps
 
@@ -147,6 +157,11 @@ def decode_per_channel(
 ) -> np.ndarray:
     mv = memoryview(buf).cast("B")
     head = struct.calcsize("<q")
+    if mv.nbytes < head:
+        raise ValueError(
+            f"per-channel q8 buffer has {mv.nbytes} bytes; too short for "
+            f"the {head}-byte axis header"
+        )
     (axis,) = struct.unpack("<q", mv[:head])
     shape = tuple(shape)
     if not 0 <= axis < len(shape):
