@@ -1,0 +1,118 @@
+"""Head-to-head vs the incumbent TPU checkpointer (orbax).
+
+Reference parity: benchmarks/deepspeed_opt/main.py compares the patched
+torchsnapshot save path against the framework-native checkpoint
+(DeepSpeed's). The TPU-native incumbent is orbax: save and restore the
+same sharded pytree with both systems and report wall time each way.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/orbax_compare/main.py --gb 1
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from benchmarks.common import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import torchsnapshot_tpu as ts  # noqa: E402
+
+
+def make_state(mesh: Mesh, total_bytes: int, seed: int):
+    """Sharded fp32 blocks approximating a model's parameter pytree."""
+    block_rows = 4096
+    block_cols = 1024
+    block_bytes = block_rows * block_cols * 4
+    n = max(1, total_bytes // block_bytes)
+    sharding = NamedSharding(mesh, P("x", None))
+    key = jax.random.PRNGKey(seed)
+    out = {}
+    for i in range(n):
+        key, sub = jax.random.split(key)
+        out[f"w{i}"] = jax.device_put(
+            jax.random.normal(sub, (block_rows, block_cols), jax.numpy.float32),
+            sharding,
+        )
+    jax.block_until_ready(out)
+    return out
+
+
+def bench_snapshot(path: str, state, dest) -> None:
+    nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+    t0 = time.perf_counter()
+    ts.Snapshot.take(path, {"m": ts.PyTreeState(state)})
+    save_s = time.perf_counter() - t0
+    dest_state = ts.PyTreeState(dest)
+    t0 = time.perf_counter()
+    ts.Snapshot(path).restore({"m": dest_state})
+    load_s = time.perf_counter() - t0
+    np.testing.assert_array_equal(
+        np.asarray(dest_state.tree["w0"]), np.asarray(state["w0"])
+    )
+    gib = nbytes / (1 << 30)
+    print(
+        f"torchsnapshot_tpu: save {save_s:.2f}s ({gib / save_s:.2f} GB/s), "
+        f"restore {load_s:.2f}s ({gib / load_s:.2f} GB/s)"
+    )
+
+
+def bench_orbax(path: str, state, dest) -> None:
+    import orbax.checkpoint as ocp
+
+    nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        t0 = time.perf_counter()
+        ckptr.save(path, state)
+        save_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restored = ckptr.restore(
+            path,
+            args=ocp.args.PyTreeRestore(
+                restore_args=jax.tree_util.tree_map(
+                    lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding), dest
+                )
+            ),
+        )
+        load_s = time.perf_counter() - t0
+    np.testing.assert_array_equal(
+        np.asarray(restored["w0"]), np.asarray(state["w0"])
+    )
+    gib = nbytes / (1 << 30)
+    print(
+        f"orbax:             save {save_s:.2f}s ({gib / save_s:.2f} GB/s), "
+        f"restore {load_s:.2f}s ({gib / load_s:.2f} GB/s)"
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--gb", type=float, default=1.0)
+    args = p.parse_args()
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    state = make_state(mesh, int(args.gb * (1 << 30)), seed=0)
+    dest = make_state(mesh, int(args.gb * (1 << 30)), seed=1)
+    nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+    print(f"state: {nbytes / (1 << 30):.2f} GiB across "
+          f"{len(jax.devices())} devices")
+
+    work_dir = tempfile.mkdtemp(prefix="ts_bench_orbax_")
+    try:
+        bench_snapshot(os.path.join(work_dir, "snap"), state, dest)
+        try:
+            bench_orbax(os.path.join(work_dir, "orbax"), state, dest)
+        except Exception as e:  # orbax optional / API drift tolerated
+            print(f"orbax comparison skipped: {e!r}")
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

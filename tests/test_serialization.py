@@ -103,3 +103,33 @@ def test_jax_array_to_numpy_roundtrip() -> None:
     np.testing.assert_array_equal(
         np.asarray(restored, dtype=np.float32), np.asarray(host, dtype=np.float32)
     )
+
+
+def test_zero_size_array_roundtrip() -> None:
+    """Arrays with a zero dimension serialize to empty blobs and restore
+    (latent crash: memoryview cannot cast views with zeros in shape)."""
+    from torchsnapshot_tpu.serialization import (
+        array_as_memoryview,
+        array_from_memoryview,
+        try_writable_byte_view,
+    )
+
+    src = np.ones((0, 3), dtype=np.float32)
+    mv = array_as_memoryview(src)
+    assert mv.nbytes == 0
+    back = array_from_memoryview(bytes(mv), "float32", (0, 3))
+    assert back.shape == (0, 3)
+    assert try_writable_byte_view(np.empty((0, 3), np.float32)) is None
+
+
+def test_zero_size_array_snapshot_roundtrip(tmp_path) -> None:
+    import torchsnapshot_tpu as ts
+
+    state = ts.StateDict(empty=np.ones((0, 3), np.float32), full=np.arange(4.0))
+    ts.Snapshot.take(str(tmp_path), {"s": state})
+    dest = ts.StateDict(
+        empty=np.zeros((0, 3), np.float32), full=np.zeros(4)
+    )
+    ts.Snapshot(str(tmp_path)).restore({"s": dest})
+    assert dest["empty"].shape == (0, 3)
+    np.testing.assert_array_equal(dest["full"], np.arange(4.0))

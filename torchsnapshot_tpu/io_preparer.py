@@ -188,6 +188,15 @@ class ArrayBufferConsumer(BufferConsumer):
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.shape, self.dtype)
 
+    def direct_destination(self) -> Optional[memoryview]:
+        from .serialization import try_writable_byte_view
+
+        if dtype_to_string(self.dst.dtype) != self.dtype or tuple(
+            self.dst.shape
+        ) != self.shape:
+            return None
+        return try_writable_byte_view(self.dst)
+
 
 class ArrayIOPreparer:
     """Dense-array preparer (reference TensorIOPreparer, io_preparer.py:631-782)."""

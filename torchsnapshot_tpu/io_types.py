@@ -30,11 +30,18 @@ class WriteIO:
 class ReadIO:
     """A read of ``path``; ``byte_range`` is a half-open ``[start, end)``
     window, or ``None`` for the whole blob. ``buf`` is populated by the
-    storage plugin."""
+    storage plugin.
+
+    ``dest``, when set, is a writable view of the read's final destination
+    (exactly the requested length). Plugins MAY read straight into it and
+    set ``buf = dest`` — skipping the intermediate allocation and the
+    consumer's copy — or ignore it and fill ``buf`` as usual.
+    """
 
     path: str
     byte_range: Optional[Tuple[int, int]] = None
     buf: Optional[memoryview] = None
+    dest: Optional[memoryview] = None
 
 
 class BufferStager(abc.ABC):
@@ -60,6 +67,13 @@ class BufferConsumer(abc.ABC):
 
     @abc.abstractmethod
     def get_consuming_cost_bytes(self) -> int: ...
+
+    def direct_destination(self) -> Optional[memoryview]:
+        """A writable byte view of this consumer's final destination, or
+        ``None`` when consuming involves more than a straight byte copy
+        (deserialization, scatter into multiple views, dtype conversion).
+        When a plugin fills it, ``consume_buffer`` is skipped entirely."""
+        return None
 
 
 @dataclass

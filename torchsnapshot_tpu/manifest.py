@@ -360,7 +360,13 @@ class SnapshotMetadata:
 
     @classmethod
     def from_yaml(cls, yaml_str: str) -> "SnapshotMetadata":
-        d = yaml.load(yaml_str, Loader=_Loader)
+        # JSON is the fast path (snapshots are committed as JSON, which is
+        # a YAML subset — reference manifest.py:19-22 invariant); anything
+        # json can't parse goes through the YAML loader.
+        try:
+            d = json.loads(yaml_str)
+        except json.JSONDecodeError:
+            d = yaml.load(yaml_str, Loader=_Loader)
         return cls(
             version=d["version"],
             world_size=d["world_size"],

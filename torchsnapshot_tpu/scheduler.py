@@ -324,7 +324,11 @@ async def execute_read_reqs(
         try:
             async with io_slots:
                 stats.io += 1
-                read_io = ReadIO(path=req.path, byte_range=req.byte_range)
+                read_io = ReadIO(
+                    path=req.path,
+                    byte_range=req.byte_range,
+                    dest=req.buffer_consumer.direct_destination(),
+                )
                 try:
                     await storage.read(read_io)
                 finally:
@@ -334,11 +338,16 @@ async def execute_read_reqs(
                 raise AssertionError(
                     f"Storage plugin did not populate buffer for {req.path}"
                 )
-            stats.staging += 1
-            try:
-                await req.buffer_consumer.consume_buffer(buf, executor)
-            finally:
-                stats.staging -= 1
+            if read_io.dest is not None and buf is read_io.dest:
+                # The plugin read straight into the destination; nothing
+                # left to deserialize or copy.
+                pass
+            else:
+                stats.staging += 1
+                try:
+                    await req.buffer_consumer.consume_buffer(buf, executor)
+                finally:
+                    stats.staging -= 1
             stats.done += 1
             stats.bytes_moved += buf.nbytes
             del buf, read_io

@@ -139,10 +139,29 @@ def array_as_memoryview(arr: np.ndarray) -> memoryview:
         )
     if arr.dtype not in SUPPORTED_DTYPES:
         raise ValueError(f"Unsupported dtype: {arr.dtype!r}")
+    if arr.size == 0:
+        # memoryview cannot cast views with zeros in shape/strides.
+        return memoryview(b"")
     if arr.ndim == 0:
         # 0-d arrays cannot change itemsize via .view; reshape is free.
         arr = arr.reshape(1)
     return memoryview(arr.view(np.uint8)).cast("B")
+
+
+def try_writable_byte_view(arr: Any) -> "memoryview | None":
+    """A writable uint8 view of ``arr``'s bytes, or ``None`` when the array
+    can't serve as a direct read destination (non-ndarray, non-contiguous,
+    read-only, unsupported dtype). Used for direct-into-destination storage
+    reads that skip the intermediate buffer."""
+    if (
+        not isinstance(arr, np.ndarray)
+        or arr.size == 0  # zero bytes: nothing to read directly into
+        or not arr.flags.c_contiguous
+        or not arr.flags.writeable
+        or arr.dtype not in SUPPORTED_DTYPES
+    ):
+        return None
+    return array_as_memoryview(arr)
 
 
 def array_from_memoryview(

@@ -88,6 +88,22 @@ class _OverlapConsumer(BufferConsumer):
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.buf_shape, self.dtype)
 
+    def direct_destination(self) -> Optional[memoryview]:
+        # Direct read only when this is a straight whole-buffer copy into
+        # one destination view (the no-resharding fast path).
+        if len(self.copies) != 1:
+            return None
+        dst_view, src_slices = self.copies[0]
+        if tuple(dst_view.shape) != self.buf_shape or src_slices != tuple(
+            slice(0, s) for s in self.buf_shape
+        ):
+            return None
+        from .serialization import try_writable_byte_view
+
+        if dtype_to_string(dst_view.dtype) != self.dtype:
+            return None
+        return try_writable_byte_view(dst_view)
+
 
 class ShardedArrayIOPreparer:
     # ------------------------------------------------------------------
@@ -193,10 +209,12 @@ class ShardedArrayIOPreparer:
                 device_to_box[device] = box
 
             def assemble(filled: Dict[Box, np.ndarray]) -> Any:
-                arrays = [
-                    jax.device_put(filled[device_to_box[d]], d)
-                    for d in device_to_box
-                ]
+                # One batched H2D dispatch for all shards (a per-device
+                # device_put loop pays per-call dispatch latency 8x over).
+                devices = list(device_to_box)
+                arrays = jax.device_put(
+                    [filled[device_to_box[d]] for d in devices], devices
+                )
                 return jax.make_array_from_single_device_arrays(
                     shape, sharding, arrays
                 )

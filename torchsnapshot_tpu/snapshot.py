@@ -263,9 +263,12 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
     ) -> None:
-        yaml_bytes = metadata.to_yaml().encode("utf-8")
+        # Committed as JSON — a YAML subset (reference manifest.py:19-22
+        # invariant), so any YAML tooling still reads it, and loading takes
+        # the fast json.loads path instead of a YAML parse.
+        metadata_bytes = metadata.to_json().encode("utf-8")
         event_loop.run_until_complete(
-            storage.write(WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=yaml_bytes))
+            storage.write(WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=metadata_bytes))
         )
 
     # ------------------------------------------------------------------

@@ -64,7 +64,11 @@ class FSStoragePlugin(StoragePlugin):
                 None, self._native_read, full_path, read_io
             )
             if data is not None:
-                read_io.buf = memoryview(data)
+                # Identity matters: the scheduler detects a direct-into-
+                # destination read by ``buf is dest``.
+                read_io.buf = (
+                    data if data is read_io.dest else memoryview(data)
+                )
                 return
         async with aiofiles.open(full_path, "rb") as f:
             if read_io.byte_range is None:
@@ -95,6 +99,12 @@ class FSStoragePlugin(StoragePlugin):
         else:
             start, end = read_io.byte_range
             length = end - start
+        if read_io.dest is not None and read_io.dest.nbytes == length:
+            # Read straight into the consumer's destination memory: no
+            # intermediate allocation, no copy in the consume stage.
+            if _native.pread_into(full_path, read_io.dest, offset=start):
+                return read_io.dest
+            return None
         out = bytearray(length)
         if not _native.pread_into(full_path, out, offset=start):
             return None
