@@ -16,6 +16,7 @@ the comparison honest.
 """
 
 import argparse
+import contextlib
 import os
 import shutil
 import sys
@@ -75,59 +76,56 @@ def main() -> None:
     budget_ctx = (
         ts.override_per_rank_memory_budget_bytes(args.memory_budget_mb << 20)
         if args.memory_budget_mb
-        else None
+        else contextlib.nullcontext()
     )
-    if budget_ctx:
-        budget_ctx.__enter__()
 
     work_dir = tempfile.mkdtemp(prefix="ts_bench_emb_")
     try:
-        # Sync take
-        sync_path = os.path.join(work_dir, "sync")
-        rss = RSSDeltas()
-        t0 = time.perf_counter()
-        with measure_rss_deltas(rss):
-            ts.Snapshot.take(sync_path, {"emb": ts.PyTreeState(tables)})
-        sync_s = time.perf_counter() - t0
-        print(
-            f"sync take:  {sync_s:.2f}s ({nbytes / (1 << 30) / sync_s:.2f} GB/s), "
-            f"peak RSS delta {rss.peak_bytes / (1 << 20):.0f} MB"
-        )
-
-        # Async take: the blocked time is what training actually pays
-        async_path = os.path.join(work_dir, "async")
-        rss = RSSDeltas()
-        t0 = time.perf_counter()
-        with measure_rss_deltas(rss):
-            pending = ts.Snapshot.async_take(
-                async_path, {"emb": ts.PyTreeState(tables)}
+        with budget_ctx:
+            # Sync take
+            sync_path = os.path.join(work_dir, "sync")
+            rss = RSSDeltas()
+            t0 = time.perf_counter()
+            with measure_rss_deltas(rss):
+                ts.Snapshot.take(sync_path, {"emb": ts.PyTreeState(tables)})
+            sync_s = time.perf_counter() - t0
+            print(
+                f"sync take:  {sync_s:.2f}s ({nbytes / (1 << 30) / sync_s:.2f} GB/s), "
+                f"peak RSS delta {rss.peak_bytes / (1 << 20):.0f} MB"
             )
-            blocked_s = time.perf_counter() - t0
-            pending.wait()
-        total_s = time.perf_counter() - t0
-        print(
-            f"async take: blocked {blocked_s:.2f}s of {total_s:.2f}s total "
-            f"({100 * blocked_s / total_s:.0f}% stall), "
-            f"peak RSS delta {rss.peak_bytes / (1 << 20):.0f} MB"
-        )
 
-        # Restore into differently-seeded tables; verify a couple of leaves.
-        dest = make_tables(mesh, args.tables, args.rows, args.dim, seed=1)
-        dest_state = ts.PyTreeState(dest)
-        t0 = time.perf_counter()
-        ts.Snapshot(sync_path).restore({"emb": dest_state})
-        restore_s = time.perf_counter() - t0
-        print(
-            f"restore:    {restore_s:.2f}s ({nbytes / (1 << 30) / restore_s:.2f} GB/s)"
-        )
-        np.testing.assert_array_equal(
-            np.asarray(dest_state.tree["table_0"]["weight"]),
-            np.asarray(tables["table_0"]["weight"]),
-        )
-        print("restore verified bitwise on table_0")
+            # Async take: the blocked time is what training actually pays
+            async_path = os.path.join(work_dir, "async")
+            rss = RSSDeltas()
+            t0 = time.perf_counter()
+            with measure_rss_deltas(rss):
+                pending = ts.Snapshot.async_take(
+                    async_path, {"emb": ts.PyTreeState(tables)}
+                )
+                blocked_s = time.perf_counter() - t0
+                pending.wait()
+            total_s = time.perf_counter() - t0
+            print(
+                f"async take: blocked {blocked_s:.2f}s of {total_s:.2f}s total "
+                f"({100 * blocked_s / total_s:.0f}% stall), "
+                f"peak RSS delta {rss.peak_bytes / (1 << 20):.0f} MB"
+            )
+
+            # Restore into differently-seeded tables; verify a couple of leaves.
+            dest = make_tables(mesh, args.tables, args.rows, args.dim, seed=1)
+            dest_state = ts.PyTreeState(dest)
+            t0 = time.perf_counter()
+            ts.Snapshot(sync_path).restore({"emb": dest_state})
+            restore_s = time.perf_counter() - t0
+            print(
+                f"restore:    {restore_s:.2f}s ({nbytes / (1 << 30) / restore_s:.2f} GB/s)"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(dest_state.tree["table_0"]["weight"]),
+                np.asarray(tables["table_0"]["weight"]),
+            )
+            print("restore verified bitwise on table_0")
     finally:
-        if budget_ctx:
-            budget_ctx.__exit__(None, None, None)
         shutil.rmtree(work_dir, ignore_errors=True)
 
 

@@ -1,0 +1,150 @@
+"""Replication auto-inference from fully-replicated GSPMD shardings.
+
+Reference parity: tests/test_ddp_infer_replication.py — the reference
+auto-marks DDP module state as replicated (snapshot.py:828-844). The
+TPU-native signal is the sharding itself: a jax.Array fully replicated
+over more than one device is replicated by construction. Single-device
+arrays must never be inferred (per-rank state stays per-rank).
+"""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.manifest import ArrayEntry, ShardedArrayEntry
+from torchsnapshot_tpu.snapshot import _infer_replicated_paths
+
+
+def _mesh() -> Mesh:
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    return Mesh(np.array(devs), ("x",))
+
+
+def test_infer_replicated_paths_unit() -> None:
+    mesh = _mesh()
+    replicated = jax.device_put(
+        jnp.arange(16.0).reshape(4, 4), NamedSharding(mesh, P())
+    )
+    sharded = jax.device_put(
+        jnp.arange(float(8 * len(mesh.devices))).reshape(-1, 8),
+        NamedSharding(mesh, P("x", None)),
+    )
+    single = jnp.ones((4,))  # committed to one device only
+    flattened = {
+        "model/w": replicated,
+        "model/emb": sharded,
+        "local/buf": single,
+        "step": 7,
+        "np": np.ones(3),
+    }
+    assert _infer_replicated_paths(flattened, world_size=1) == {"model/w"}
+    # world > 1 but every device lives in this process: local replication
+    # carries no cross-rank guarantee, nothing is inferred.
+    assert _infer_replicated_paths(flattened, world_size=2) == set()
+
+
+def test_take_marks_inferred_entries_replicated(tmp_path) -> None:
+    mesh = _mesh()
+    replicated = jax.device_put(
+        jnp.arange(16.0).reshape(4, 4), NamedSharding(mesh, P())
+    )
+    sharded = jax.device_put(
+        jnp.arange(float(8 * len(mesh.devices))).reshape(-1, 8),
+        NamedSharding(mesh, P("x", None)),
+    )
+    single = jnp.full((4,), 3.0)
+    app_state = {
+        "state": ts.PyTreeState(
+            {"w": replicated, "emb": sharded, "buf": single}
+        )
+    }
+    ts.Snapshot.take(str(tmp_path), app_state)
+
+    manifest = ts.Snapshot(str(tmp_path)).get_manifest()
+    w = manifest["0/state/w"]
+    assert isinstance(w, ArrayEntry)
+    assert w.replicated
+    assert w.location.startswith("replicated/")
+
+    buf = manifest["0/state/buf"]
+    assert isinstance(buf, ArrayEntry)
+    assert not buf.replicated
+    assert buf.location.startswith("0/")
+
+    assert isinstance(manifest["0/state/emb"], ShardedArrayEntry)
+
+    # Round-trip: restored values match regardless of replication marking.
+    fresh = {
+        "state": ts.PyTreeState(
+            {
+                "w": jax.device_put(jnp.zeros((4, 4)), NamedSharding(mesh, P())),
+                "emb": jax.device_put(
+                    jnp.zeros_like(sharded), NamedSharding(mesh, P("x", None))
+                ),
+                "buf": jnp.zeros((4,)),
+            }
+        )
+    }
+    ts.Snapshot(str(tmp_path)).restore(fresh)
+    chex.assert_trees_all_equal(fresh["state"].tree["w"], replicated)
+    chex.assert_trees_all_equal(fresh["state"].tree["emb"], sharded)
+    chex.assert_trees_all_equal(fresh["state"].tree["buf"], single)
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_local_replication_not_inferred_multiprocess(nproc, tmp_path) -> None:
+    """World size > 1 with device_sets that never leave the rank's own
+    process: replication must NOT be inferred — each rank's value may
+    differ (the review scenario: per-host statistics replicated over
+    local devices only)."""
+    import os
+    import tempfile
+
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    path = os.path.join(tempfile.gettempdir(), "infer-local-rep-test")
+    results = run_multiprocess(_local_replication_worker, nproc=nproc, args=(path,))
+    assert all(results)
+
+
+def _local_replication_worker(pg, path: str):
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import torchsnapshot_tpu as ts
+
+    if pg.rank == 0:
+        shutil.rmtree(path, ignore_errors=True)
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    # Replicated over this rank's local devices only; value differs per rank.
+    local_rep = jax.device_put(
+        jnp.full((4,), float(pg.rank)), NamedSharding(mesh, P())
+    )
+    snap = ts.Snapshot.take(path, {"s": ts.PyTreeState({"v": local_rep})}, pg=pg)
+    md = snap.metadata
+    # Per-rank entries for both ranks, nothing marked replicated.
+    return (
+        not md.manifest["0/s/v"].replicated
+        and "1/s/v" in md.manifest
+        and not md.manifest["1/s/v"].replicated
+    )
+
+
+def test_explicit_glob_still_wins_for_single_device(tmp_path) -> None:
+    # Users can still force replication of single-device state via globs;
+    # inference only ever widens the set.
+    app_state = {"s": ts.PyTreeState({"a": jnp.ones((3,)), "b": jnp.zeros((2,))})}
+    ts.Snapshot.take(str(tmp_path), app_state, replicated=["s/a"])
+    manifest = ts.Snapshot(str(tmp_path)).get_manifest()
+    assert manifest["0/s/a"].replicated
+    assert not manifest["0/s/b"].replicated

@@ -159,3 +159,39 @@ def test_fs_write_falls_back_when_native_vanishes_mid_process(
             await plugin.close()
 
         run_in_fresh_event_loop(go())
+
+
+def test_user_owned_destination_never_direct_read(tmp_path) -> None:
+    """A failed read must not tear a user-owned in-place destination:
+    direct (zero-copy) reads are gated to framework-allocated buffers, so
+    an in-place numpy restore keeps copy-on-success semantics."""
+    import os
+
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.io_preparer import prepare_read
+
+    path = str(tmp_path)
+    arr = np.arange(16.0).reshape(4, 4)
+    ts.Snapshot.take(path, {"s": ts.PyTreeState({"w": arr})})
+    entry = ts.Snapshot(path).get_manifest()["0/s/w"]
+
+    [user_req] = prepare_read(entry, obj_out=np.zeros((4, 4)), dest_owned=False)
+    assert user_req.buffer_consumer.direct_destination() is None
+
+    [owned_req] = prepare_read(entry, obj_out=np.zeros((4, 4)), dest_owned=True)
+    assert owned_req.buffer_consumer.direct_destination() is not None
+
+    # End-to-end: truncate the blob; the in-place restore fails but the
+    # user's array is untouched (no half-old/half-new bytes).
+    blob = os.path.join(path, "0", "s", "w")
+    data = open(blob, "rb").read()
+    with open(blob, "wb") as f:
+        f.write(data[: len(data) // 2])
+    dst = {"s": ts.PyTreeState({"w": np.full((4, 4), 7.0)})}
+    with pytest.raises(Exception):
+        ts.Snapshot(path).restore(dst)
+    np.testing.assert_array_equal(
+        np.asarray(dst["s"].tree["w"]), np.full((4, 4), 7.0)
+    )

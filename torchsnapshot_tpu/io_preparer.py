@@ -170,10 +170,21 @@ class ArrayBufferConsumer(BufferConsumer):
     pure-numpy and GIL-releasing for large blocks.
     """
 
-    def __init__(self, dst: np.ndarray, dtype: str, shape: Tuple[int, ...]) -> None:
+    def __init__(
+        self,
+        dst: np.ndarray,
+        dtype: str,
+        shape: Tuple[int, ...],
+        dest_owned: bool = False,
+    ) -> None:
         self.dst = dst
         self.dtype = dtype
         self.shape = tuple(shape)
+        # Only framework-allocated destinations may be read into directly:
+        # a failed direct read leaves partial bytes, which is harmless in a
+        # fresh buffer but would tear a user-owned in-place array that the
+        # caller might keep using after catching the restore error.
+        self.dest_owned = dest_owned
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -191,6 +202,8 @@ class ArrayBufferConsumer(BufferConsumer):
     def direct_destination(self) -> Optional[memoryview]:
         from .serialization import try_writable_byte_view
 
+        if not self.dest_owned:
+            return None
         if dtype_to_string(self.dst.dtype) != self.dtype or tuple(
             self.dst.shape
         ) != self.shape:
@@ -249,6 +262,7 @@ class ArrayIOPreparer:
         entry: ArrayEntry,
         arr_out: np.ndarray,
         buffer_size_limit_bytes: Optional[int] = None,
+        dest_owned: bool = False,
     ) -> List[ReadReq]:
         """Build read request(s) for a dense entry into ``arr_out``.
 
@@ -282,7 +296,10 @@ class ArrayIOPreparer:
                 ReadReq(
                     path=entry.location,
                     buffer_consumer=ArrayBufferConsumer(
-                        dst=arr_out, dtype=entry.dtype, shape=tuple(entry.shape)
+                        dst=arr_out,
+                        dtype=entry.dtype,
+                        shape=tuple(entry.shape),
+                        dest_owned=dest_owned,
                     ),
                     byte_range=byte_range,
                 )
@@ -300,6 +317,7 @@ class ArrayIOPreparer:
                         dst=flat[begin:end],
                         dtype=entry.dtype,
                         shape=(end - begin,),
+                        dest_owned=dest_owned,
                     ),
                     byte_range=(base + begin * itemsize, base + end * itemsize),
                 )
@@ -400,6 +418,7 @@ class ChunkedArrayIOPreparer:
         entry: ChunkedArrayEntry,
         arr_out: np.ndarray,
         buffer_size_limit_bytes: Optional[int] = None,
+        dest_owned: bool = False,
     ) -> List[ReadReq]:
         reqs: List[ReadReq] = []
         for chunk in entry.chunks:
@@ -410,7 +429,7 @@ class ChunkedArrayIOPreparer:
             ]
             reqs.extend(
                 ArrayIOPreparer.prepare_read(
-                    chunk.array, view, buffer_size_limit_bytes
+                    chunk.array, view, buffer_size_limit_bytes, dest_owned
                 )
             )
         return reqs
@@ -548,12 +567,16 @@ def prepare_read(
     obj_out: Optional[Any] = None,
     buffer_size_limit_bytes: Optional[int] = None,
     callback: Optional[Callable[[Any], None]] = None,
+    dest_owned: bool = False,
 ) -> List[ReadReq]:
     """Reference parity: io_preparer.py:930-966.
 
     Dense/chunked entries require an ``np.ndarray`` destination (callers
     allocate via :meth:`ArrayIOPreparer.empty_array_from_entry`); object
     entries require a ``callback``; primitives produce no reads.
+    ``dest_owned`` declares the destination framework-allocated, enabling
+    direct (zero-copy) storage reads into it; destinations owned by the
+    application must keep copy-on-success semantics.
     """
     if isinstance(entry, PrimitiveEntry):
         return []
@@ -563,7 +586,9 @@ def prepare_read(
                 f"Reading {entry.location} requires an np.ndarray destination "
                 f"(got {type(obj_out)})"
             )
-        return ArrayIOPreparer.prepare_read(entry, obj_out, buffer_size_limit_bytes)
+        return ArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes, dest_owned
+        )
     if isinstance(entry, ChunkedArrayEntry):
         if not isinstance(obj_out, np.ndarray):
             raise ValueError(
@@ -571,7 +596,7 @@ def prepare_read(
                 f"(got {type(obj_out)})"
             )
         return ChunkedArrayIOPreparer.prepare_read(
-            entry, obj_out, buffer_size_limit_bytes
+            entry, obj_out, buffer_size_limit_bytes, dest_owned
         )
     if isinstance(entry, ObjectEntry):
         if callback is None:
@@ -583,6 +608,6 @@ def prepare_read(
         from .sharded_io_preparer import ShardedArrayIOPreparer
 
         return ShardedArrayIOPreparer.prepare_read(
-            entry, obj_out, buffer_size_limit_bytes
+            entry, obj_out, buffer_size_limit_bytes, dest_owned
         )
     raise TypeError(f"prepare_read does not handle entry type {type(entry)}")

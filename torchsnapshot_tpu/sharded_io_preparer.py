@@ -69,10 +69,12 @@ class _OverlapConsumer(BufferConsumer):
         dtype: str,
         buf_shape: Tuple[int, ...],
         copies: List[Tuple[np.ndarray, Tuple[slice, ...]]],
+        dest_owned: bool = False,
     ) -> None:
         self.dtype = dtype
         self.buf_shape = buf_shape
         self.copies = copies  # (dst_view, src_slices into the read buffer)
+        self.dest_owned = dest_owned
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -90,7 +92,10 @@ class _OverlapConsumer(BufferConsumer):
 
     def direct_destination(self) -> Optional[memoryview]:
         # Direct read only when this is a straight whole-buffer copy into
-        # one destination view (the no-resharding fast path).
+        # one framework-owned destination view (the no-resharding fast
+        # path); user-owned in-place arrays keep copy-on-success semantics.
+        if not self.dest_owned:
+            return None
         if len(self.copies) != 1:
             return None
         dst_view, src_slices = self.copies[0]
@@ -179,9 +184,16 @@ class ShardedArrayIOPreparer:
     @staticmethod
     def _destination_boxes(
         entry: ShardedArrayEntry, current_leaf: Any
-    ) -> Tuple[Dict[Box, np.ndarray], Optional[Callable[[Dict[Box, np.ndarray]], Any]]]:
+    ) -> Tuple[
+        Dict[Box, np.ndarray],
+        Optional[Callable[[Dict[Box, np.ndarray]], Any]],
+        bool,
+    ]:
         """Host buffers to read into, keyed by destination box, plus an
-        assembler back to the application's leaf flavor."""
+        assembler back to the application's leaf flavor, plus whether the
+        buffers are framework-allocated (owned) — only owned buffers may be
+        direct-read targets; a user's in-place array must keep
+        copy-on-success semantics so a failed restore never tears it."""
         from .serialization import string_to_dtype
 
         np_dtype = string_to_dtype(entry.dtype)
@@ -219,7 +231,7 @@ class ShardedArrayIOPreparer:
                     shape, sharding, arrays
                 )
 
-            return boxes, assemble
+            return boxes, assemble, True
 
         # Host destination (np.ndarray in-place, or fresh allocation).
         if isinstance(current_leaf, np.ndarray):
@@ -230,10 +242,12 @@ class ShardedArrayIOPreparer:
                     f"array (shape {list(shape)}, dtype {entry.dtype})"
                 )
             full = current_leaf
+            owned = False
         else:
             full = np.empty(shape, dtype=np_dtype)
+            owned = True
         full_box = Box(tuple(0 for _ in shape), shape)
-        return {full_box: full}, (lambda filled: filled[full_box])
+        return {full_box: full}, (lambda filled: filled[full_box]), owned
 
     @staticmethod
     def prepare_read_into(
@@ -242,12 +256,18 @@ class ShardedArrayIOPreparer:
         restored: Dict[str, Any],
         path: str,
         buffer_size_limit_bytes: Optional[int] = None,
+        dest_owned: Optional[bool] = None,
     ) -> Tuple[List[ReadReq], Optional[Callable[[], None]]]:
         """Build resharding reads into ``restored[path]``; the returned
-        finalize callback must run after the reads complete."""
-        boxes, assemble = ShardedArrayIOPreparer._destination_boxes(
+        finalize callback must run after the reads complete. ``dest_owned``
+        overrides the derived ownership (a caller reading into a buffer it
+        allocated itself may declare it framework-owned to keep direct
+        reads)."""
+        boxes, assemble, derived_owned = ShardedArrayIOPreparer._destination_boxes(
             entry, current_leaf
         )
+        if dest_owned is None:
+            dest_owned = derived_owned
         read_reqs: List[ReadReq] = []
 
         for saved in entry.shards:
@@ -261,7 +281,8 @@ class ShardedArrayIOPreparer:
                 continue
             read_reqs.extend(
                 ShardedArrayIOPreparer._reqs_for_saved_shard(
-                    saved, saved_box, overlaps, buffer_size_limit_bytes
+                    saved, saved_box, overlaps, buffer_size_limit_bytes,
+                    dest_owned=dest_owned,
                 )
             )
 
@@ -276,6 +297,7 @@ class ShardedArrayIOPreparer:
         saved_box: Box,
         overlaps: List[Tuple[np.ndarray, Overlap]],
         buffer_size_limit_bytes: Optional[int] = None,
+        dest_owned: bool = False,
     ) -> List[ReadReq]:
         """Reads for one saved shard feeding all its overlap regions.
 
@@ -327,6 +349,7 @@ class ShardedArrayIOPreparer:
                                 entry.dtype,
                                 (p1 - p0,) + shard_shape[1:],
                                 copies,
+                                dest_owned=dest_owned,
                             ),
                             byte_range=(
                                 base + p0 * row_bytes,
@@ -340,7 +363,9 @@ class ShardedArrayIOPreparer:
         return [
             ReadReq(
                 path=entry.location,
-                buffer_consumer=_OverlapConsumer(entry.dtype, shard_shape, copies),
+                buffer_consumer=_OverlapConsumer(
+                    entry.dtype, shard_shape, copies, dest_owned=dest_owned
+                ),
                 byte_range=entry.byte_range_tuple,
             )
         ]
@@ -350,6 +375,7 @@ class ShardedArrayIOPreparer:
         entry: ShardedArrayEntry,
         obj_out: Optional[Any],
         buffer_size_limit_bytes: Optional[int] = None,
+        dest_owned: bool = False,
     ) -> List[ReadReq]:
         """Reference-shaped API: reads in place into an ``np.ndarray``.
         Callers needing jax assembly must use :meth:`prepare_read_into`
@@ -362,6 +388,11 @@ class ShardedArrayIOPreparer:
             )
         restored: Dict[str, Any] = {}
         reqs, _ = ShardedArrayIOPreparer.prepare_read_into(
-            entry, obj_out, restored, "__out__", buffer_size_limit_bytes
+            entry,
+            obj_out,
+            restored,
+            "__out__",
+            buffer_size_limit_bytes,
+            dest_owned=dest_owned or None,
         )
         return reqs

@@ -212,7 +212,10 @@ class Snapshot:
             flattened_global.update(flattened)
 
         replicated_paths = _calculate_replicated_entries(
-            flattened_global, replicated_patterns, pg_wrapper
+            flattened_global,
+            replicated_patterns,
+            pg_wrapper,
+            inferred=_infer_replicated_paths(flattened_global, world_size),
         )
 
         write_reqs: List[WriteReq] = []
@@ -417,8 +420,8 @@ class Snapshot:
                     postprocess.append(finalize)
                 continue
             assert isinstance(entry, (ArrayEntry, ChunkedArrayEntry))
-            dst, convert = _restore_destination(entry, current_leaf)
-            read_reqs.extend(prepare_read(entry, obj_out=dst))
+            dst, convert, owned = _restore_destination(entry, current_leaf)
+            read_reqs.extend(prepare_read(entry, obj_out=dst, dest_owned=owned))
             if convert is None:
                 restored[path] = dst
             else:
@@ -504,9 +507,12 @@ class Snapshot:
                 )
             else:
                 assert isinstance(entry, (ArrayEntry, ChunkedArrayEntry))
-                dst, convert = _restore_destination(entry, obj_out)
+                dst, convert, owned = _restore_destination(entry, obj_out)
                 read_reqs = prepare_read(
-                    entry, obj_out=dst, buffer_size_limit_bytes=memory_budget_bytes
+                    entry,
+                    obj_out=dst,
+                    buffer_size_limit_bytes=memory_budget_bytes,
+                    dest_owned=owned,
                 )
                 if convert is None:
                     restored[result_path] = dst
@@ -698,8 +704,54 @@ def _coalesce_replicated(
     return sorted(common)
 
 
+def _infer_replicated_paths(
+    flattened: Dict[str, Any], world_size: int
+) -> Set[str]:
+    """Auto-detect replicated leaves from their GSPMD sharding — the
+    TPU-native analog of the reference's DDP-module introspection
+    (reference snapshot.py:828-844).
+
+    A ``jax.Array`` fully replicated over more than one device is inferred
+    replicated only when that is a *global* declaration:
+
+    - world size 1: trivially global — the snapshot holds exactly one
+      value, so marking it replicated only widens restore-time
+      availability (any future world size reads it).
+    - world size > 1: only when the sharding's devices span more than one
+      process — under SPMD a multi-process ``jax.Array`` holds one
+      consistent global value, so every participating process has the
+      same bytes. An array replicated over a rank's *local* devices only
+      (e.g. per-host statistics) carries no cross-rank guarantee and is
+      never inferred; per-rank state must stay per-rank.
+
+    Single-device arrays carry no declaration at all and are never
+    inferred (the reference likewise only infers from the explicit DDP
+    wrapper, not from plain tensors).
+    """
+    inferred: Set[str] = set()
+    for path, leaf in flattened.items():
+        if not is_jax_array(leaf):
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if (
+            sharding is None
+            or not sharding.is_fully_replicated
+            or len(sharding.device_set) <= 1
+        ):
+            continue
+        if world_size > 1:
+            processes = {d.process_index for d in sharding.device_set}
+            if len(processes) <= 1:
+                continue
+        inferred.add(path)
+    return inferred
+
+
 def _calculate_replicated_entries(
-    flattened: Dict[str, Any], patterns: List[str], pg_wrapper: PGWrapper
+    flattened: Dict[str, Any],
+    patterns: List[str],
+    pg_wrapper: PGWrapper,
+    inferred: Optional[Set[str]] = None,
 ) -> Set[str]:
     """Glob-match replication patterns and verify matched paths exist on
     every rank; rank 0 decides, everyone follows (reference
@@ -709,6 +761,8 @@ def _calculate_replicated_entries(
         for path in flattened
         if any(fnmatch.fnmatch(path, p) for p in patterns)
     }
+    if inferred:
+        matched |= inferred & set(flattened)
     if pg_wrapper.get_world_size() == 1:
         return matched
     all_matched = pg_wrapper.all_gather_object(sorted(matched))
@@ -745,14 +799,18 @@ def _gather_manifest(rank_manifest: Manifest, pg_wrapper: PGWrapper) -> Manifest
 
 def _restore_destination(
     entry: "ArrayEntry | ChunkedArrayEntry", current_leaf: Any
-) -> Tuple[np.ndarray, Optional[Callable[[np.ndarray], Any]]]:
+) -> Tuple[np.ndarray, Optional[Callable[[np.ndarray], Any]], bool]:
     """Pick/allocate the host read destination for a dense entry and, when
     the application's current leaf is a device array, a converter that puts
-    the restored bytes back on its device/sharding."""
+    the restored bytes back on its device/sharding. The third element says
+    whether the destination is framework-allocated (owned): only owned
+    buffers may be direct-read targets — the application's own in-place
+    array keeps copy-on-success semantics so a failed restore can't tear
+    it."""
     if isinstance(current_leaf, np.ndarray) and ArrayIOPreparer.can_load_inplace(
         _as_array_entry(entry), current_leaf
     ):
-        return current_leaf, None
+        return current_leaf, None, False
     if (
         hasattr(current_leaf, "shape")
         and list(getattr(current_leaf, "shape")) != list(entry.shape)
@@ -783,8 +841,8 @@ def _restore_destination(
                 return jnp.asarray(host)
             return jax.device_put(host, sharding)
 
-        return dst, convert
-    return dst, None
+        return dst, convert, True
+    return dst, None, True
 
 
 def _as_array_entry(entry: "ArrayEntry | ChunkedArrayEntry") -> ArrayEntry:

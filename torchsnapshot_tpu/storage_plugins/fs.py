@@ -102,6 +102,11 @@ class FSStoragePlugin(StoragePlugin):
         if read_io.dest is not None and read_io.dest.nbytes == length:
             # Read straight into the consumer's destination memory: no
             # intermediate allocation, no copy in the consume stage.
+            # Failure semantics: if the read errors mid-way the destination
+            # holds partial bytes. A raised restore already leaves app state
+            # undefined at whole-tensor granularity (earlier consumers have
+            # completed); direct reads widen that to partial-tensor, which
+            # callers must treat the same way — retry or discard.
             if _native.pread_into(full_path, read_io.dest, offset=start):
                 return read_io.dest
             return None

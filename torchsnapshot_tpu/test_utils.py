@@ -172,8 +172,11 @@ def multiprocess_test(nproc: int):
         # No functools.wraps: pytest would follow __wrapped__ and treat the
         # inner function's ``pg`` parameter as a fixture. The inner function
         # is re-imported by workers via the _ts_inner_fn attribute instead.
-        def wrapper() -> Any:
-            return run_multiprocess(wrapper, nproc=nproc)
+        def wrapper() -> None:
+            # Per-rank return values are discarded: pytest warns on tests
+            # returning non-None. Use run_multiprocess directly when the
+            # rank results matter.
+            run_multiprocess(wrapper, nproc=nproc)
 
         wrapper.__name__ = fn.__name__
         wrapper.__qualname__ = fn.__qualname__

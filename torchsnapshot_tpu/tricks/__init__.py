@@ -2,6 +2,8 @@
 
 - :mod:`.flax` — ``TrainStateStateful`` for flax train states.
 - :mod:`.orbax` — checkpoint migration to/from orbax format.
+- :mod:`.torch` — ``TorchStateful`` bridge for torch modules/optimizers
+  (the migration path for users of the reference).
 
 Submodules are imported lazily by users (``from torchsnapshot_tpu.tricks
 import flax``) so optional dependencies stay optional.
