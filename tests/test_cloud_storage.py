@@ -211,3 +211,72 @@ def test_gcs_live_roundtrip() -> None:
         await plugin.close()
 
     run_in_fresh_event_loop(go())
+
+
+def test_s3_missing_key_normalized_to_file_not_found() -> None:
+    """Missing blobs surface as FileNotFoundError (the FS plugin contract)
+    so callers — e.g. checksum-table probing — can distinguish absent from
+    unreadable, and the retry layer never spins on a definitive 404."""
+    pytest.importorskip("botocore")
+    import botocore.exceptions as be
+
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin, _is_transient_s3
+    from torchsnapshot_tpu.io_types import ReadIO
+
+    assert not _is_transient_s3(FileNotFoundError("x"))
+
+    class FakeClient:
+        async def get_object(self, Bucket, Key, **kw):
+            raise be.ClientError(
+                {"Error": {"Code": "NoSuchKey"}, "ResponseMetadata": {}},
+                "GetObject",
+            )
+
+    plugin = S3StoragePlugin.__new__(S3StoragePlugin)
+    plugin.bucket = "b"
+    plugin.prefix = "p"
+
+    async def fake_get_client():
+        return FakeClient()
+
+    plugin._get_client = fake_get_client
+    from torchsnapshot_tpu.storage_plugins.retry import (
+        CollectiveProgressRetryStrategy,
+    )
+
+    plugin._retry = CollectiveProgressRetryStrategy(progress_window_seconds=1.0)
+
+    async def go():
+        with pytest.raises(FileNotFoundError):
+            await plugin.read(ReadIO(path="missing"))
+
+    run_in_fresh_event_loop(go())
+
+
+def test_gcs_missing_blob_normalized_to_file_not_found() -> None:
+    pytest.importorskip("google.resumable_media")
+    from google.resumable_media import common
+
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin, _is_transient
+
+    assert not _is_transient(FileNotFoundError("x"), common)
+
+    class FakeResp:
+        status_code = 404
+
+    class FakeDownload:
+        def __init__(self, *a, **kw):
+            self.finished = False
+
+        def consume_next_chunk(self, session):
+            raise common.InvalidResponse(FakeResp(), "not found")
+
+    plugin = GCSStoragePlugin.__new__(GCSStoragePlugin)
+    plugin._common = common
+    plugin._chunked_download_cls = FakeDownload
+    plugin._session = None
+    plugin.bucket = "b"
+    plugin.prefix = "p"
+
+    with pytest.raises(FileNotFoundError):
+        plugin._download_sync("missing", None)

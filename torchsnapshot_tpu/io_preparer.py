@@ -56,6 +56,8 @@ from .serialization import (
     pickle_save_as_bytes,
 )
 
+from .utils.tracing import trace_annotation
+
 ArrayPrepareFunc = Callable[[Any, bool], Any]
 
 
@@ -130,6 +132,10 @@ class ArrayBufferStager(BufferStager):
         return await loop.run_in_executor(executor, self._stage_sync)
 
     def _stage_sync(self) -> BufferType:
+        with trace_annotation("ts:stage"):
+            return self._stage_sync_impl()
+
+    def _stage_sync_impl(self) -> BufferType:
         arr = self.arr
         if self.array_prepare_func is not None:
             arr = self.array_prepare_func(arr, self.is_async_snapshot)
@@ -193,8 +199,9 @@ class ArrayBufferConsumer(BufferConsumer):
         await loop.run_in_executor(executor, self._consume_sync, buf)
 
     def _consume_sync(self, buf: BufferType) -> None:
-        src = array_from_memoryview(buf, self.dtype, self.shape)
-        np.copyto(self.dst, src, casting="no")
+        with trace_annotation("ts:consume"):
+            src = array_from_memoryview(buf, self.dtype, self.shape)
+            np.copyto(self.dst, src, casting="no")
 
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.shape, self.dtype)

@@ -21,6 +21,7 @@ _PER_RANK_MEMORY_BUDGET_BYTES_ENV = "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BY
 _DISABLE_PARTITIONER_ENV = "TORCHSNAPSHOT_TPU_DISABLE_PARTITIONER"
 _PER_RANK_IO_CONCURRENCY_ENV = "TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY"
 _STAGING_THREADS_ENV = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
+_DISABLE_CHECKSUMS_ENV = "TORCHSNAPSHOT_TPU_DISABLE_CHECKSUMS"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -77,6 +78,12 @@ def get_staging_threads() -> int:
     return _get_int_env(_STAGING_THREADS_ENV, 4)
 
 
+def is_checksums_disabled() -> bool:
+    """Blob CRC recording (take) and verification (restore) are on by
+    default; presence of the env var disables both."""
+    return _DISABLE_CHECKSUMS_ENV in os.environ
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
     prev = os.environ.get(name)
@@ -120,4 +127,10 @@ def enable_batching() -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_per_rank_memory_budget_bytes(nbytes: int) -> Generator[None, None, None]:
     with _override_env(_PER_RANK_MEMORY_BUDGET_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def disable_checksums() -> Generator[None, None, None]:
+    with _override_env(_DISABLE_CHECKSUMS_ENV, "1"):
         yield

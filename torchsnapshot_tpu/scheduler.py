@@ -32,6 +32,7 @@ from typing import Awaitable, Callable, List, Optional
 import psutil
 
 from . import knobs
+from .integrity import ChecksumTable, compute_checksum, verify_checksum
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -192,10 +193,13 @@ class PendingIOWork:
         io_tasks: List["asyncio.Task[None]"],
         reporter: _ProgressReporter,
         executor: ThreadPoolExecutor,
+        checksums: Optional[ChecksumTable] = None,
     ) -> None:
         self.io_tasks = io_tasks
         self.reporter = reporter
         self._executor = executor
+        # Filled in as writes complete; stable only after complete().
+        self.checksums: ChecksumTable = checksums if checksums is not None else {}
 
     async def complete(self) -> None:
         try:
@@ -228,14 +232,24 @@ async def execute_write_reqs(
     )
     io_slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
     io_tasks: List[asyncio.Task] = []
+    record_checksums = not knobs.is_checksums_disabled()
+    checksums: ChecksumTable = {}
 
     async def write_one(req: WriteReq, buf) -> None:
         buf_len = len(buf)
         try:
+            if record_checksums:
+                alg, crc = await asyncio.get_running_loop().run_in_executor(
+                    executor, compute_checksum, buf
+                )
+                checksums[req.path] = (alg, crc, buf_len)
             async with io_slots:
                 stats.waiting_io -= 1
                 stats.io += 1
                 try:
+                    # I/O spans are emitted inside the storage plugin's
+                    # executor work (fs.py): wrapping the await here would
+                    # record suspension time of interleaved tasks, not I/O.
                     await storage.write(WriteIO(path=req.path, buf=buf))
                 finally:
                     stats.io -= 1
@@ -279,7 +293,12 @@ async def execute_write_reqs(
         raise
 
     reporter.report_phase_done("staging")
-    return PendingIOWork(io_tasks=io_tasks, reporter=reporter, executor=executor)
+    return PendingIOWork(
+        io_tasks=io_tasks,
+        reporter=reporter,
+        executor=executor,
+        checksums=checksums,
+    )
 
 
 def sync_execute_write_reqs(
@@ -304,6 +323,7 @@ async def execute_read_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    checksum_table: Optional[ChecksumTable] = None,
 ) -> None:
     """Read pipeline: storage read -> deserialize/copy, budgeted by each
     request's consuming cost (reference scheduler.py:357-444)."""
@@ -316,6 +336,7 @@ async def execute_read_reqs(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="ts-consume"
     )
     io_slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
+    verify_skipped = [0]
 
     async def read_one(req: ReadReq) -> None:
         cost = req.buffer_consumer.get_consuming_cost_bytes()
@@ -338,6 +359,22 @@ async def execute_read_reqs(
                 raise AssertionError(
                     f"Storage plugin did not populate buffer for {req.path}"
                 )
+            # Whole-blob reads are verified against the digest recorded at
+            # write time; ranged reads can't be (partial bytes — counted and
+            # reported below so 'checksums on' is never silently hollow).
+            # Runs before the value is handed to the application either way
+            # (direct reads land in framework-owned buffers only).
+            if checksum_table is not None and req.path in checksum_table:
+                if req.byte_range is None:
+                    await asyncio.get_running_loop().run_in_executor(
+                        executor,
+                        verify_checksum,
+                        buf,
+                        checksum_table[req.path],
+                        req.path,
+                    )
+                else:
+                    verify_skipped[0] += 1
             if read_io.dest is not None and buf is read_io.dest:
                 # The plugin read straight into the destination; nothing
                 # left to deserialize or copy.
@@ -365,6 +402,13 @@ async def execute_read_reqs(
         raise
     finally:
         executor.shutdown(wait=False)
+    if verify_skipped[0]:
+        logger.info(
+            "%d of %d reads were ranged (chunked/batched) and skipped "
+            "checksum verification",
+            verify_skipped[0],
+            len(read_reqs),
+        )
     reporter.report_phase_done("loading")
 
 
@@ -374,6 +418,7 @@ def sync_execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
+    checksum_table: Optional[ChecksumTable] = None,
 ) -> None:
     event_loop.run_until_complete(
         execute_read_reqs(
@@ -381,5 +426,6 @@ def sync_execute_read_reqs(
             storage=storage,
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
+            checksum_table=checksum_table,
         )
     )

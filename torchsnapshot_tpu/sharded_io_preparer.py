@@ -50,6 +50,7 @@ from .serialization import (
     array_size_bytes,
     dtype_to_string,
 )
+from .utils.tracing import trace_annotation
 
 
 def _shard_location(logical_path: str, box: Box) -> str:
@@ -83,9 +84,10 @@ class _OverlapConsumer(BufferConsumer):
         await loop.run_in_executor(executor, self._consume_sync, buf)
 
     def _consume_sync(self, buf: BufferType) -> None:
-        src = array_from_memoryview(buf, self.dtype, self.buf_shape)
-        for dst_view, src_slices in self.copies:
-            np.copyto(dst_view, src[src_slices], casting="no")
+        with trace_annotation("ts:consume"):
+            src = array_from_memoryview(buf, self.dtype, self.buf_shape)
+            for dst_view, src_slices in self.copies:
+                np.copyto(dst_view, src[src_slices], casting="no")
 
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.buf_shape, self.dtype)

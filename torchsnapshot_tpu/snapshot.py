@@ -83,6 +83,9 @@ class Snapshot:
         self.path = path
         self._pg_arg = pg
         self._metadata: Optional[SnapshotMetadata] = None
+        # Merged checksum tables, loaded at most once per Snapshot instance
+        # (False = not loaded yet; None = no tables / verification disabled).
+        self._checksum_table_cache: Any = False
 
     # ------------------------------------------------------------------
     # take
@@ -114,6 +117,9 @@ class Snapshot:
                 _custom_array_prepare_func=_custom_array_prepare_func,
             )
             pending_io_work.sync_complete(event_loop)
+            _maybe_write_checksum_table(
+                pending_io_work, pg_wrapper.get_rank(), storage, event_loop
+            )
 
             # All writes are durable on every rank before the commit marker
             # exists anywhere (commit-after-barrier invariant).
@@ -302,6 +308,17 @@ class Snapshot:
 
         return copy.deepcopy(self.metadata.manifest)
 
+    def _get_checksum_table(
+        self, storage: StoragePlugin, event_loop: asyncio.AbstractEventLoop
+    ):
+        """Merged blob digests, fetched at most once per Snapshot instance
+        (repeated read_object calls must not re-read every rank's table)."""
+        if self._checksum_table_cache is False:
+            self._checksum_table_cache = _get_checksum_table_impl(
+                self.metadata, storage, event_loop
+            )
+        return self._checksum_table_cache
+
     # ------------------------------------------------------------------
     # restore
     # ------------------------------------------------------------------
@@ -316,6 +333,7 @@ class Snapshot:
             storage = url_to_storage_plugin(self.path)
             available = get_manifest_for_rank(self.metadata, rank)
             memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
+            checksum_table = self._get_checksum_table(storage, event_loop)
 
             rng_key_and_state = _pop_rng_state(app_state)
             rng_key = rng_key_and_state[0] if rng_key_and_state else None
@@ -333,6 +351,7 @@ class Snapshot:
                         memory_budget_bytes=memory_budget_bytes,
                         event_loop=event_loop,
                         rank=rank,
+                        checksum_table=checksum_table,
                     )
                 pg_wrapper.barrier()
             # RNG state is restored last so that load_state_dict side
@@ -348,6 +367,7 @@ class Snapshot:
                     memory_budget_bytes=memory_budget_bytes,
                     event_loop=event_loop,
                     rank=rank,
+                    checksum_table=checksum_table,
                 )
             event_loop.run_until_complete(storage.close())
         finally:
@@ -362,6 +382,7 @@ class Snapshot:
         memory_budget_bytes: int,
         event_loop: asyncio.AbstractEventLoop,
         rank: int,
+        checksum_table=None,
     ) -> None:
         """Memory-frugal restore of one stateful: reuse the leaves already
         allocated in its current state dict as read destinations so peak
@@ -442,6 +463,7 @@ class Snapshot:
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
             event_loop=event_loop,
+            checksum_table=checksum_table,
         )
         for fn in postprocess:
             fn()
@@ -533,6 +555,7 @@ class Snapshot:
                 or get_process_memory_budget_bytes(None),
                 rank=rank,
                 event_loop=event_loop,
+                checksum_table=self._get_checksum_table(storage, event_loop),
             )
             if finalize is not None:
                 finalize()
@@ -595,6 +618,12 @@ class PendingSnapshot:
                     world_size=self.pg.get_world_size(),
                 )
             self._pending_io_work.sync_complete(self._event_loop)
+            _maybe_write_checksum_table(
+                self._pending_io_work,
+                self.pg.get_rank(),
+                self._storage,
+                self._event_loop,
+            )
             if barrier is not None:
                 barrier.arrive()
             if self.pg.get_rank() == 0:
@@ -795,6 +824,39 @@ def _gather_manifest(rank_manifest: Manifest, pg_wrapper: PGWrapper) -> Manifest
                 entry = merged_replicated.get(logical_path, entry)
             global_manifest[f"{rnk}/{logical_path}"] = entry
     return global_manifest
+
+
+def _get_checksum_table_impl(
+    metadata: SnapshotMetadata,
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+):
+    """Merged digests of every writer rank, or None (no tables written,
+    or verification disabled)."""
+    if knobs.is_checksums_disabled():
+        return None
+    from .integrity import load_checksum_tables
+
+    return load_checksum_tables(metadata.world_size, storage, event_loop)
+
+
+def _maybe_write_checksum_table(
+    pending_io_work: PendingIOWork,
+    rank: int,
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+) -> None:
+    """Persist this rank's blob digests (recorded during the write
+    pipeline) before the commit barrier: a committed snapshot always has
+    complete tables. No-ops when checksums are disabled (the pipeline
+    recorded nothing)."""
+    if not pending_io_work.checksums:
+        return
+    from .integrity import sync_write_checksum_table
+
+    sync_write_checksum_table(
+        pending_io_work.checksums, rank, storage, event_loop
+    )
 
 
 def _restore_destination(

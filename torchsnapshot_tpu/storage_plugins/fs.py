@@ -24,6 +24,7 @@ import aiofiles.os
 
 from .. import _native
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..utils.tracing import trace_annotation
 
 
 class FSStoragePlugin(StoragePlugin):
@@ -49,9 +50,11 @@ class FSStoragePlugin(StoragePlugin):
             # buf stays referenced by write_io for the call's duration.
             # write_file returns False (wrote nothing) if the native lib
             # became unavailable after construction — fall through then.
-            if await loop.run_in_executor(
-                None, _native.write_file, full_path, write_io.buf
-            ):
+            def _write_native() -> bool:
+                with trace_annotation("ts:write"):
+                    return _native.write_file(full_path, write_io.buf)
+
+            if await loop.run_in_executor(None, _write_native):
                 return
         async with aiofiles.open(full_path, "wb") as f:
             await f.write(write_io.buf)
@@ -91,6 +94,10 @@ class FSStoragePlugin(StoragePlugin):
 
     def _native_read(self, full_path: str, read_io: ReadIO):
         """Read via the native lib; None if it became unavailable."""
+        with trace_annotation("ts:read"):
+            return self._native_read_impl(full_path, read_io)
+
+    def _native_read_impl(self, full_path: str, read_io: ReadIO):
         if read_io.byte_range is None:
             start = 0
             length = _native.file_size(full_path)

@@ -165,8 +165,16 @@ class GCSStoragePlugin(StoragePlugin):
             download = self._chunked_download_cls(
                 url, _DOWNLOAD_CHUNK_SIZE, stream
             )
-        while not download.finished:
-            download.consume_next_chunk(self._session)
+        try:
+            while not download.finished:
+                download.consume_next_chunk(self._session)
+        except self._common.InvalidResponse as e:
+            if getattr(e.response, "status_code", None) == 404:
+                # Normalize to the FS plugin's missing-blob contract so
+                # callers (e.g. checksum-table probing) can distinguish
+                # absent from unreadable. Definitive: never retried.
+                raise FileNotFoundError(path) from e
+            raise
         return stream.getvalue()
 
     def _delete_sync(self, path: str) -> None:

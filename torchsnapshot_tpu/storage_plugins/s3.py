@@ -50,6 +50,8 @@ def _is_transient_s3(exc: BaseException) -> bool:
                         be.HTTPClientError, be.ReadTimeoutError,
                         be.ConnectTimeoutError)):
         return True
+    if isinstance(exc, FileNotFoundError):
+        return False  # normalized missing-key: definitive, never retried
     return isinstance(exc, (OSError, asyncio.TimeoutError))
 
 
@@ -120,9 +122,20 @@ class S3StoragePlugin(StoragePlugin):
             kwargs["Range"] = f"bytes={start}-{end - 1}"
 
         async def op() -> bytes:
-            resp = await client.get_object(
-                Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
-            )
+            import botocore.exceptions as be
+
+            try:
+                resp = await client.get_object(
+                    Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
+                )
+            except be.ClientError as e:
+                code = e.response.get("Error", {}).get("Code")
+                if code in ("NoSuchKey", "404"):
+                    # Normalize to the FS plugin's missing-blob contract so
+                    # callers (e.g. checksum-table probing) can distinguish
+                    # absent from unreadable.
+                    raise FileNotFoundError(read_io.path) from e
+                raise
             async with resp["Body"] as stream:
                 return await stream.read()
 

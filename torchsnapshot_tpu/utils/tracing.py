@@ -1,0 +1,31 @@
+"""Profiler trace annotations for the checkpoint pipeline.
+
+Reference parity: the reference emits progress/throughput lines
+(scheduler.py:96-175) but no timeline tracing; the TPU-native equivalent
+of choice is ``jax.profiler`` — when a profiler session is active
+(``jax.profiler.start_trace`` or the TensorBoard plugin), these
+annotations place the checkpointer's stage/write/read/consume spans on
+the same XPlane timeline as device compute, making D2H/compute/I-O
+overlap directly visible. With no session active, TraceAnnotation is a
+couple of cheap TraceMe calls; without jax importable at all it degrades
+to a nullcontext. jax availability is resolved once at import time —
+these annotations sit on the per-buffer hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import ContextManager
+
+try:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover - jax always present in this repo
+    _TraceAnnotation = None
+
+
+def trace_annotation(name: str) -> ContextManager[None]:
+    """A context manager placing ``name`` on the active jax profiler
+    timeline (thread-local, safe on executor threads)."""
+    if _TraceAnnotation is None:
+        return contextlib.nullcontext()
+    return _TraceAnnotation(name)
