@@ -1,0 +1,212 @@
+"""CheckpointManager: retention, latest-step resume, async saves, and the
+uncommitted-step invisibility invariant.
+
+The reference ships only the single-snapshot primitives and its examples
+hand-roll this loop (examples/simple_example.py:59-76); the manager is
+the packaged version, so the tests assert the loop's guarantees rather
+than reference parity.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.manager import INDEX_BLOB, _step_dirname
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+
+
+def _state(value: float):
+    return {"s": ts.PyTreeState({"w": np.full((8,), value)})}
+
+
+def test_save_restore_latest_roundtrip(tmp_path) -> None:
+    mgr = ts.CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() is None
+    assert mgr.restore_latest(_state(0.0)) is None  # fresh run
+
+    mgr.save(10, _state(10.0))
+    mgr.save(20, _state(20.0))
+    assert mgr.all_steps() == [10, 20]
+
+    dst = _state(0.0)
+    assert mgr.restore_latest(dst) == 20
+    np.testing.assert_array_equal(dst["s"].tree["w"], np.full((8,), 20.0))
+
+    dst = _state(0.0)
+    mgr.restore(10, dst)
+    np.testing.assert_array_equal(dst["s"].tree["w"], np.full((8,), 10.0))
+
+
+def test_retention_deletes_old_steps(tmp_path) -> None:
+    mgr = ts.CheckpointManager(str(tmp_path), keep_last_n=2)
+    for step in (1, 2, 3, 4):
+        mgr.save(step, _state(float(step)))
+    assert mgr.all_steps() == [3, 4]
+
+    # Dropped steps lose their commit marker AND their blobs.
+    for dropped in (1, 2):
+        step_dir = tmp_path / _step_dirname(dropped)
+        assert not (step_dir / SNAPSHOT_METADATA_FNAME).exists()
+        assert not (step_dir / "0" / "s" / "w").exists()
+    # Retained steps restore.
+    dst = _state(0.0)
+    mgr.restore(3, dst)
+    np.testing.assert_array_equal(dst["s"].tree["w"], np.full((8,), 3.0))
+
+
+def test_async_save_commits_on_wait(tmp_path) -> None:
+    mgr = ts.CheckpointManager(str(tmp_path), keep_last_n=1)
+    pending = mgr.async_save(5, _state(5.0))
+    pending.wait()
+    pending2 = mgr.async_save(6, _state(6.0))
+    pending2.wait()
+    assert mgr.all_steps() == [6]
+    dst = _state(0.0)
+    assert mgr.restore_latest(dst) == 6
+
+
+def test_uncommitted_step_invisible(tmp_path) -> None:
+    """A step directory without a commit marker (crashed take) must never
+    appear in the index or be restored."""
+    mgr = ts.CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    # Simulate a crash mid-take of step 2: files exist, no marker, no index
+    # update (the index is only written after Snapshot.take returns).
+    fake = tmp_path / _step_dirname(2) / "0" / "s"
+    fake.mkdir(parents=True)
+    (fake / "w").write_bytes(b"\x00" * 64)
+    assert mgr.all_steps() == [1]
+    dst = _state(0.0)
+    assert mgr.restore_latest(dst) == 1
+
+
+def test_sharded_and_checksums_gced(tmp_path) -> None:
+    """Retention walks every manifest entry kind: sharded shard blobs and
+    checksum tables go too."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(np.array(devs), ("x",))
+
+    def sharded_state(v: float):
+        arr = jax.device_put(
+            jnp.full((8 * len(devs), 4), v), NamedSharding(mesh, P("x", None))
+        )
+        return {"s": ts.PyTreeState({"emb": arr})}
+
+    mgr = ts.CheckpointManager(str(tmp_path), keep_last_n=1)
+    mgr.save(1, sharded_state(1.0))
+    step1 = tmp_path / _step_dirname(1)
+    assert (step1 / "checksums" / "0").exists()
+    shard_blobs = list((step1 / "sharded").rglob("*")) if (step1 / "sharded").exists() else []
+    assert shard_blobs
+
+    mgr.save(2, sharded_state(2.0))
+    assert mgr.all_steps() == [2]
+    assert not (step1 / SNAPSHOT_METADATA_FNAME).exists()
+    assert not (step1 / "checksums" / "0").exists()
+    remaining = [
+        p for p in (step1 / "sharded").rglob("*") if p.is_file()
+    ] if (step1 / "sharded").exists() else []
+    assert remaining == []
+
+
+def test_index_blob_location(tmp_path) -> None:
+    mgr = ts.CheckpointManager(str(tmp_path))
+    mgr.save(7, _state(7.0))
+    assert (tmp_path / INDEX_BLOB).exists()
+
+
+def test_memory_backend(tmp_path) -> None:
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    try:
+        mgr = ts.CheckpointManager("memory://mgrtest", keep_last_n=1)
+        mgr.save(1, _state(1.0))
+        mgr.save(2, _state(2.0))
+        assert mgr.all_steps() == [2]
+        dst = _state(0.0)
+        assert mgr.restore_latest(dst) == 2
+        np.testing.assert_array_equal(dst["s"].tree["w"], np.full((8,), 2.0))
+    finally:
+        for name in list(
+            n for n in __import__(
+                "torchsnapshot_tpu.storage_plugins.memory",
+                fromlist=["_STORES"],
+            )._STORES
+            if n.startswith("mgrtest")
+        ):
+            MemoryStoragePlugin.drop_store(name)
+
+
+def test_corrupt_index_falls_back_to_backup(tmp_path) -> None:
+    """A crash mid-index-write must not brick the manager: the backup slot
+    (written after the primary) still lists the previous steps."""
+    from torchsnapshot_tpu.manager import INDEX_BACKUP_BLOB
+
+    mgr = ts.CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    assert (tmp_path / INDEX_BACKUP_BLOB).exists()
+    (tmp_path / INDEX_BLOB).write_text("{trunc")  # torn primary write
+    assert mgr.all_steps() == [1, 2]
+    dst = _state(0.0)
+    assert mgr.restore_latest(dst) == 2
+
+
+def test_saving_older_step_is_never_deleted(tmp_path) -> None:
+    """Retention keeps the newest N numerically, but the just-saved
+    checkpoint survives even when its number is older (step-counter
+    rollback) — save() must never return a dangling snapshot."""
+    mgr = ts.CheckpointManager(str(tmp_path), keep_last_n=2)
+    mgr.save(9, _state(9.0))
+    mgr.save(10, _state(10.0))
+    mgr.save(5, _state(5.0))
+    assert 5 in mgr.all_steps()
+    dst = _state(0.0)
+    mgr.restore(5, dst)
+    np.testing.assert_array_equal(dst["s"].tree["w"], np.full((8,), 5.0))
+
+
+def test_multiprocess_fresh_restore_then_save(tmp_path) -> None:
+    """The aliasing regression: restore_latest on a fresh run (broadcast,
+    early return, NO trailing barrier) immediately followed by save's
+    internal broadcasts — shared op sequencing must keep every store key
+    unique, or a slow rank reads the wrong object."""
+    import os
+    import tempfile
+
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    path = os.path.join(tempfile.gettempdir(), "mgr-mp-test")
+    results = run_multiprocess(_mgr_worker, nproc=2, args=(path,))
+    assert results == [3, 3]
+
+
+def _mgr_worker(pg, root: str):
+    import shutil
+
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()  # both ranks see the clean root
+    mgr = ts.CheckpointManager(root, keep_last_n=2, pg=pg)
+    state = {"s": ts.PyTreeState({"w": np.full((4,), float(pg.rank))})}
+    assert mgr.restore_latest(state) is None  # fresh: broadcast + early return
+    mgr.save(3, state)
+    PGWrapper(pg).barrier()  # rank 0's index write is durable
+    dst = {"s": ts.PyTreeState({"w": np.zeros(4)})}
+    resumed = mgr.restore_latest(dst)
+    assert float(dst["s"].tree["w"][0]) == float(pg.rank)  # per-rank state
+    return resumed

@@ -16,6 +16,7 @@ from .knobs import (
     override_per_rank_memory_budget_bytes,
     override_slab_size_threshold_bytes,
 )
+from .manager import CheckpointManager
 from .rng_state import RngState, RNGState
 from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import PyTreeState, StateDict
@@ -24,6 +25,7 @@ from .version import __version__
 
 __all__ = [
     "AppState",
+    "CheckpointManager",
     "PendingSnapshot",
     "PyTreeState",
     "Snapshot",

@@ -1,0 +1,280 @@
+"""CheckpointManager: step-numbered snapshots with retention and
+latest-step resume.
+
+The reference exposes only the single-snapshot primitives
+(snapshot.py:175-243) and its examples hand-roll the loop around them
+(examples/simple_example.py:59-76: restore-if-exists, then periodic
+takes). This module packages that loop the way TPU training jobs use it:
+
+    mgr = CheckpointManager(root, keep_last_n=3)
+    start = mgr.restore_latest(app_state)          # None on a fresh run
+    for step in range(start or 0, total_steps):
+        ...
+        if step % save_every == 0:
+            mgr.save(step, app_state)              # or async_save
+
+Storage-agnostic: steps live at ``{root}/step_{step:010d}`` and the
+committed-step list is a rank-0-maintained ``.manager_index`` JSON blob
+(storage plugins have no directory listing, so the index is the source
+of truth; a step whose take crashed before commit never enters it and is
+invisible to restore). Retention deletes every blob named by the dropped
+step's manifest — the commit marker first, so a half-deleted step can
+never be mistaken for a valid one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, List, Optional, Set
+
+from .io_types import ReadIO, StoragePlugin, WriteIO
+from .manifest import (
+    ChunkedArrayEntry,
+    Entry,
+    Manifest,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+)
+from .pg_wrapper import PGWrapper
+from .snapshot import SNAPSHOT_METADATA_FNAME, PendingSnapshot, Snapshot
+from .stateful import AppState
+from .storage_plugin import url_to_storage_plugin
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+INDEX_BLOB = ".manager_index"
+INDEX_BACKUP_BLOB = ".manager_index.backup"
+
+
+def _step_dirname(step: int) -> str:
+    return f"step_{step:010d}"
+
+
+def _entry_locations(entry: Entry) -> List[str]:
+    """Every storage location a manifest entry's bytes live at (batched
+    entries share slab locations; callers dedupe)."""
+    if isinstance(entry, ShardedArrayEntry):
+        return [shard.array.location for shard in entry.shards]
+    if isinstance(entry, ChunkedArrayEntry):
+        return [chunk.array.location for chunk in entry.chunks]
+    location = getattr(entry, "location", None)
+    return [location] if location else []
+
+
+class _PendingManagedSnapshot:
+    """Wraps a PendingSnapshot so index update + retention run once the
+    background commit succeeds."""
+
+    def __init__(self, manager: "CheckpointManager", step: int, pending: PendingSnapshot):
+        self._manager = manager
+        self._step = step
+        self._pending = pending
+
+    def wait(self) -> Snapshot:
+        snapshot = self._pending.wait()  # raises on failed take: no index entry
+        self._manager._commit_step(self._step)
+        return snapshot
+
+    def done(self) -> bool:
+        return self._pending.done()
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        root: str,
+        keep_last_n: Optional[int] = None,
+        pg: Optional[Any] = None,
+    ) -> None:
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+        self.root = root
+        self.keep_last_n = keep_last_n
+        # One wrapper for the manager's own collectives; Snapshot calls get
+        # the raw pg and build their own wrappers — safe because the op
+        # sequence is shared across wrappers of the same pg (pg_wrapper).
+        self._pg_arg = pg
+        self._pg = PGWrapper(pg)
+
+    # ------------------------------------------------------------------
+    # saving
+    # ------------------------------------------------------------------
+
+    def step_path(self, step: int) -> str:
+        return f"{self.root.rstrip('/')}/{_step_dirname(step)}"
+
+    def save(self, step: int, app_state: AppState, **take_kwargs: Any) -> Snapshot:
+        """Synchronous checkpoint of ``step``; updates the index and
+        applies retention after the commit."""
+        snapshot = Snapshot.take(
+            self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
+        )
+        self._commit_step(step)
+        return snapshot
+
+    def async_save(
+        self, step: int, app_state: AppState, **take_kwargs: Any
+    ) -> _PendingManagedSnapshot:
+        """Pipelined checkpoint; the index entry and retention pass happen
+        in ``wait()`` after the background commit succeeds."""
+        pending = Snapshot.async_take(
+            self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
+        )
+        return _PendingManagedSnapshot(self, step, pending)
+
+    # ------------------------------------------------------------------
+    # resuming
+    # ------------------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        """Committed steps, ascending. Every rank may call this; the index
+        blob is tiny."""
+        return self._read_index()
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int, app_state: AppState) -> None:
+        Snapshot(self.step_path(step), pg=self._pg_arg).restore(app_state)
+
+    def restore_latest(self, app_state: AppState) -> Optional[int]:
+        """Restore the newest committed step into ``app_state``; returns
+        its step number, or None when no checkpoint exists (fresh run).
+        Rank 0 resolves the step and everyone follows — ranks must never
+        resume from different steps."""
+        step = self.latest_step() if self._pg.get_rank() == 0 else None
+        step = self._pg.broadcast_object(step)
+        if step is None:
+            return None
+        self.restore(step, app_state)
+        return step
+
+    # ------------------------------------------------------------------
+    # index + retention (rank 0 only; peers observe via the index blob)
+    # ------------------------------------------------------------------
+
+    def _commit_step(self, step: int) -> None:
+        if self._pg.get_rank() != 0:
+            return
+        loop = asyncio.new_event_loop()
+        try:
+            storage = url_to_storage_plugin(self.root)
+            try:
+                loop.run_until_complete(self._commit_step_async(step, storage))
+            finally:
+                loop.run_until_complete(storage.close())
+        finally:
+            loop.close()
+
+    async def _commit_step_async(self, step: int, storage: StoragePlugin) -> None:
+        steps = [s for s in await self._read_index_async(storage) if s != step]
+        steps.append(step)
+        steps.sort()
+        dropped: List[int] = []
+        if self.keep_last_n is not None and len(steps) > self.keep_last_n:
+            dropped = steps[: -self.keep_last_n]
+            steps = steps[-self.keep_last_n :]
+            if step in dropped:
+                # Never GC the checkpoint that was just written (a step
+                # counter reset / rollback produced a numerically-old step):
+                # keep it alongside the newest N and let the user sort out
+                # the numbering.
+                logger.warning(
+                    "Step %d is older than the %d retained steps %s; "
+                    "keeping it anyway (the just-saved checkpoint is never "
+                    "deleted)",
+                    step,
+                    self.keep_last_n,
+                    steps,
+                )
+                dropped.remove(step)
+                steps = sorted(steps + [step])
+        await self._write_index_async(steps, storage)
+        for old in dropped:
+            try:
+                await self._delete_step_async(old)
+            except Exception as e:  # noqa: BLE001 - GC must not fail a save
+                logger.warning("Failed to GC step %d: %r", old, e)
+
+    async def _read_index_async(self, storage: StoragePlugin) -> List[int]:
+        """Primary slot, falling back to the backup slot: the index is
+        rewritten on every save, so a crash mid-write must not brick the
+        manager (the backup holds at worst the previous step list)."""
+        for slot in (INDEX_BLOB, INDEX_BACKUP_BLOB):
+            read_io = ReadIO(path=slot)
+            try:
+                await storage.read(read_io)
+            except FileNotFoundError:
+                continue
+            except Exception as e:  # noqa: BLE001
+                logger.warning("Could not read index slot %s: %r", slot, e)
+                continue
+            if read_io.buf is None:
+                continue
+            try:
+                return sorted(
+                    int(s) for s in json.loads(bytes(read_io.buf))["steps"]
+                )
+            except (ValueError, KeyError, TypeError) as e:
+                logger.warning(
+                    "Index slot %s is corrupt (%r); trying %s",
+                    slot,
+                    e,
+                    INDEX_BACKUP_BLOB,
+                )
+        return []
+
+    async def _write_index_async(
+        self, steps: List[int], storage: StoragePlugin
+    ) -> None:
+        payload = json.dumps({"steps": steps}).encode()
+        # Primary first, backup second: a crash between the writes leaves a
+        # valid (possibly one-save-stale) slot either way.
+        await storage.write(WriteIO(path=INDEX_BLOB, buf=payload))
+        await storage.write(WriteIO(path=INDEX_BACKUP_BLOB, buf=payload))
+
+    def _read_index(self) -> List[int]:
+        loop = asyncio.new_event_loop()
+        try:
+            storage = url_to_storage_plugin(self.root)
+            try:
+                return loop.run_until_complete(self._read_index_async(storage))
+            finally:
+                loop.run_until_complete(storage.close())
+        finally:
+            loop.close()
+
+    async def _delete_step_async(self, step: int) -> None:
+        """Delete a step's blobs, manifest-driven (plugins cannot list).
+        The commit marker goes first: once it is gone the step is simply
+        uncommitted, so a crash mid-deletion leaves garbage bytes but
+        never a corrupt-looking valid snapshot."""
+        from .integrity import table_path
+
+        storage = url_to_storage_plugin(self.step_path(step))
+        try:
+            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+            try:
+                await storage.read(read_io)
+            except FileNotFoundError:
+                return  # never committed; nothing authoritative to walk
+            metadata = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
+            await storage.delete(SNAPSHOT_METADATA_FNAME)
+
+            locations: Set[str] = set()
+            manifest: Manifest = metadata.manifest
+            for entry in manifest.values():
+                locations.update(_entry_locations(entry))
+            for rank in range(metadata.world_size):
+                locations.add(table_path(rank))
+            for location in sorted(locations):
+                try:
+                    await storage.delete(location)
+                except FileNotFoundError:
+                    pass  # checksum tables are optional; slabs dedupe
+        finally:
+            await storage.close()
+        logger.info("Retention dropped step %d", step)

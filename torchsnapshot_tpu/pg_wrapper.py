@@ -26,19 +26,35 @@ class PGWrapper:
     """
 
     def __init__(self, pg: Optional[Any] = None) -> None:
+        # The op sequence is SHARED across every wrapper of the same
+        # underlying pg (attached to the pg object itself): keyed store ops
+        # are only cleaned up by the *last* rank to finish one, so a fresh
+        # wrapper restarting at op 1 would overwrite a key a slow peer has
+        # not read yet (e.g. a manager broadcast followed by Snapshot.take,
+        # which builds its own wrapper). Call sequences are SPMD-identical
+        # across ranks, so the shared counter stays aligned everywhere.
         if pg is None:
             self.store: Optional[Store] = None
             self.rank = 0
             self.world_size = 1
+            self._op_seq_ref = [0]
         elif isinstance(pg, PGWrapper):
             self.store = pg.store
             self.rank = pg.rank
             self.world_size = pg.world_size
+            self._op_seq_ref = pg._op_seq_ref
         else:
             self.store = pg.store
             self.rank = int(pg.rank)
             self.world_size = int(pg.world_size)
-        self._op_seq = 0
+            ref = getattr(pg, "_ts_op_seq_ref", None)
+            if ref is None:
+                ref = [0]
+                try:
+                    pg._ts_op_seq_ref = ref
+                except Exception:  # frozen/slots pg: degrade to per-wrapper
+                    pass
+            self._op_seq_ref = ref
 
     def get_rank(self) -> int:
         return self.rank
@@ -47,8 +63,8 @@ class PGWrapper:
         return self.world_size
 
     def _next_prefix(self, op: str) -> str:
-        self._op_seq += 1
-        return f"__pg/{op}/{self._op_seq}"
+        self._op_seq_ref[0] += 1
+        return f"__pg/{op}/{self._op_seq_ref[0]}"
 
     def barrier(self) -> None:
         if self.world_size == 1:

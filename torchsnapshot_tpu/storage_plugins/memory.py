@@ -26,6 +26,8 @@ class MemoryStoragePlugin(StoragePlugin):
         await asyncio.sleep(0)  # keep scheduling behavior async-plugin-like
 
     async def read(self, read_io: ReadIO) -> None:
+        if read_io.path not in self._blobs:
+            raise FileNotFoundError(read_io.path)  # the FS plugin contract
         data = self._blobs[read_io.path]
         if read_io.byte_range is not None:
             start, end = read_io.byte_range
@@ -34,6 +36,8 @@ class MemoryStoragePlugin(StoragePlugin):
         await asyncio.sleep(0)
 
     async def delete(self, path: str) -> None:
+        if path not in self._blobs:
+            raise FileNotFoundError(path)
         del self._blobs[path]
 
     async def close(self) -> None:
