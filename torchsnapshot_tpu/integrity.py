@@ -10,11 +10,14 @@ costs a small fraction of storage bandwidth.
 Layout: each rank writes a ``checksums/{rank}`` JSON table after all its
 storage writes are durable and *before* the commit barrier — a committed
 snapshot therefore always has complete tables. Keys are storage paths
-(globally unique per blob); values are ``[alg, crc, nbytes]``. Readers
-merge every rank's table (shards/replicated blobs may be read by any
-rank, see manifest.get_manifest_for_rank) and verify whole-blob reads;
-ranged reads (chunked/batched restores) cannot be checked against a
-whole-blob digest and are skipped.
+(globally unique per blob); values are ``[alg, crc, nbytes]`` or, for
+blobs larger than one page, ``[alg, crc, nbytes, page_size, [page
+crcs...]]``. Readers merge every rank's table (shards/replicated blobs
+may be read by any rank, see manifest.get_manifest_for_rank). Whole-blob
+reads verify against the blob digest; *ranged* reads (memory-budgeted
+chunked restores, batched slabs) verify every page their byte range
+fully covers — a range only loses coverage of its up-to-two partial edge
+pages, so "checksums on" is never hollow for large-blob restores.
 
 Algorithms: ``crc32c`` via the native lib; if it is unavailable the
 writer falls back to zlib's ``crc32`` and tags the table accordingly, so
@@ -38,12 +41,32 @@ logger: logging.Logger = logging.getLogger(__name__)
 
 CHECKSUM_DIR = "checksums"
 
-# path -> (alg, crc, nbytes)
-ChecksumTable = Dict[str, Tuple[str, int, int]]
+# Page granularity for ranged-read verification. 4 MiB: small enough that
+# memory-budgeted chunk reads (typically >= tens of MiB) cover many full
+# pages, large enough that per-page crc call overhead is noise.
+PAGE_SIZE = 4 * 1024 * 1024
+
+# path -> (alg, crc, nbytes) | (alg, crc, nbytes, page_size, [page crcs])
+ChecksumTable = Dict[str, Tuple]
 
 
 def table_path(rank: int) -> str:
     return f"{CHECKSUM_DIR}/{rank}"
+
+
+def _as_bytes_view(buf: BufferType) -> memoryview:
+    mv = memoryview(buf)
+    if mv.format != "B":
+        mv = mv.cast("B")
+    return mv
+
+
+def _crc_of(mv: memoryview, alg: str) -> int:
+    if alg == "crc32c":
+        crc = _native.crc32c(mv)
+        assert crc is not None  # caller picked the alg from availability
+        return crc
+    return zlib.crc32(mv) & 0xFFFFFFFF
 
 
 def compute_checksum(buf: BufferType) -> Tuple[str, int]:
@@ -52,37 +75,95 @@ def compute_checksum(buf: BufferType) -> Tuple[str, int]:
     crc = _native.crc32c(buf)
     if crc is not None:
         return ("crc32c", crc)
-    mv = memoryview(buf)
-    if mv.format != "B":
-        mv = mv.cast("B")
-    return ("crc32", zlib.crc32(mv) & 0xFFFFFFFF)
+    return ("crc32", zlib.crc32(_as_bytes_view(buf)) & 0xFFFFFFFF)
 
 
-def verify_checksum(buf: BufferType, expected: Tuple[str, int, int], path: str) -> None:
+def compute_checksum_entry(buf: BufferType) -> Tuple:
+    """Full table entry for one staged blob. Single-page blobs get a
+    whole-blob digest; larger blobs get per-page digests ONLY (one pass
+    over the bytes — the whole-blob field is None, and whole-blob reads
+    verify page-by-page, which covers every byte plus the size check)."""
+    mv = _as_bytes_view(buf)
+    nbytes = mv.nbytes
+    alg = "crc32c" if _native.crc32c(b"") is not None else "crc32"
+    if nbytes <= PAGE_SIZE:
+        return (alg, _crc_of(mv, alg), nbytes)
+    pages = [
+        _crc_of(mv[off : off + PAGE_SIZE], alg)
+        for off in range(0, nbytes, PAGE_SIZE)
+    ]
+    return (alg, None, nbytes, PAGE_SIZE, pages)
+
+
+def _alg_available(alg: str) -> bool:
+    if alg == "crc32c":
+        return _native.crc32c(b"") is not None
+    return alg == "crc32"
+
+
+def verify_checksum(buf: BufferType, expected: Tuple, path: str) -> None:
     """Raise :class:`ChecksumError` when ``buf`` does not match the
-    recorded digest. Algorithm mismatches (table written with crc32c but
-    the native lib is unavailable here, or vice versa) are skipped — a
-    missing implementation must not fail restores."""
-    alg, crc, nbytes = expected
-    mv = memoryview(buf)
+    recorded digest(s) — the whole-blob digest, or page digests for paged
+    entries (whose whole-blob field is None; pages cover every byte).
+    Algorithm mismatches (table written with crc32c but the native lib is
+    unavailable here, or vice versa) are skipped — a missing
+    implementation must not fail restores."""
+    alg, crc, nbytes = expected[0], expected[1], expected[2]
+    mv = _as_bytes_view(buf)
     if mv.nbytes != nbytes:
         raise ChecksumError(
             f"{path}: size mismatch (expected {nbytes} bytes, read {mv.nbytes})"
         )
-    if alg == "crc32c":
-        actual: Optional[int] = _native.crc32c(buf)
-        if actual is None:
-            return  # native lib unavailable on the reading host
-    elif alg == "crc32":
-        if mv.format != "B":
-            mv = mv.cast("B")
-        actual = zlib.crc32(mv) & 0xFFFFFFFF
-    else:
-        return  # unknown algorithm from a future version
+    if not _alg_available(alg):
+        return  # unknown algorithm / native lib unavailable on this host
+    if crc is None and len(expected) >= 5:
+        verify_range_checksum(mv, expected, (0, nbytes), path)
+        return
+    actual = _crc_of(mv, alg)
     if actual != crc:
         raise ChecksumError(
             f"{path}: {alg} mismatch (expected {crc:#010x}, got {actual:#010x})"
         )
+
+
+def verify_range_checksum(
+    buf: BufferType, expected: Tuple, byte_range: Tuple[int, int], path: str
+) -> bool:
+    """Verify a ranged read of ``path`` covering blob bytes
+    ``[byte_range[0], byte_range[1])`` against the entry's per-page
+    digests: a short read raises (a truncated blob must fail loudly here,
+    not as an opaque consumer error), every fully-covered page is
+    checked, and up-to-two partial edge pages are skipped. Returns True
+    when at least one page was verified (False = entry has no pages or
+    the range covers none fully)."""
+    if len(expected) < 5:
+        return False
+    alg, _, nbytes, page_size, pages = expected[:5]
+    start, end = byte_range
+    mv = _as_bytes_view(buf)
+    if mv.nbytes != end - start:
+        raise ChecksumError(
+            f"{path}: ranged read [{start}, {end}) returned {mv.nbytes} "
+            f"bytes (expected {end - start})"
+        )
+    if not _alg_available(alg):
+        return False
+    first_page = (start + page_size - 1) // page_size  # first fully-covered
+    verified = False
+    for page in range(first_page, len(pages)):
+        p0 = page * page_size
+        p1 = min(p0 + page_size, nbytes)
+        if p1 > end:
+            break
+        actual = _crc_of(mv[p0 - start : p1 - start], alg)
+        if actual != pages[page]:
+            raise ChecksumError(
+                f"{path}: {alg} mismatch in page {page} "
+                f"(blob bytes [{p0}, {p1})): expected "
+                f"{pages[page]:#010x}, got {actual:#010x}"
+            )
+        verified = True
+    return verified
 
 
 class ChecksumError(RuntimeError):
@@ -143,7 +224,7 @@ def load_checksum_tables(
                 e,
             )
             return None
-        return {path: (str(e[0]), int(e[1]), int(e[2])) for path, e in raw.items()}
+        return {path: tuple(e) for path, e in raw.items()}
 
     async def _load_all() -> Optional[ChecksumTable]:
         # Bounded like every other storage op: world_size unbounded GETs per

@@ -29,6 +29,8 @@ import json
 import logging
 from typing import Any, List, Optional, Set
 
+from . import knobs
+from .event_loop import run_in_fresh_event_loop
 from .io_types import ReadIO, StoragePlugin, WriteIO
 from .manifest import (
     ChunkedArrayEntry,
@@ -156,18 +158,25 @@ class CheckpointManager:
     # index + retention (rank 0 only; peers observe via the index blob)
     # ------------------------------------------------------------------
 
+    def _with_root_storage(self, coro_fn):
+        """Run ``coro_fn(storage)`` against the manager root in a fresh
+        event loop, closing the plugin on every path."""
+
+        async def body():
+            storage = url_to_storage_plugin(self.root)
+            try:
+                return await coro_fn(storage)
+            finally:
+                await storage.close()
+
+        return run_in_fresh_event_loop(body())
+
     def _commit_step(self, step: int) -> None:
         if self._pg.get_rank() != 0:
             return
-        loop = asyncio.new_event_loop()
-        try:
-            storage = url_to_storage_plugin(self.root)
-            try:
-                loop.run_until_complete(self._commit_step_async(step, storage))
-            finally:
-                loop.run_until_complete(storage.close())
-        finally:
-            loop.close()
+        self._with_root_storage(
+            lambda storage: self._commit_step_async(step, storage)
+        )
 
     async def _commit_step_async(self, step: int, storage: StoragePlugin) -> None:
         steps = [s for s in await self._read_index_async(storage) if s != step]
@@ -237,15 +246,7 @@ class CheckpointManager:
         await storage.write(WriteIO(path=INDEX_BACKUP_BLOB, buf=payload))
 
     def _read_index(self) -> List[int]:
-        loop = asyncio.new_event_loop()
-        try:
-            storage = url_to_storage_plugin(self.root)
-            try:
-                return loop.run_until_complete(self._read_index_async(storage))
-            finally:
-                loop.run_until_complete(storage.close())
-        finally:
-            loop.close()
+        return self._with_root_storage(self._read_index_async)
 
     async def _delete_step_async(self, step: int) -> None:
         """Delete a step's blobs, manifest-driven (plugins cannot list).
@@ -270,11 +271,19 @@ class CheckpointManager:
                 locations.update(_entry_locations(entry))
             for rank in range(metadata.world_size):
                 locations.add(table_path(rank))
-            for location in sorted(locations):
-                try:
-                    await storage.delete(location)
-                except FileNotFoundError:
-                    pass  # checksum tables are optional; slabs dedupe
+            # Bounded-concurrent deletes: a dropped step of a large sharded
+            # model has thousands of blobs, and serial object-store
+            # round-trips would stall rank 0's save() for minutes.
+            slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
+
+            async def _delete_one(location: str) -> None:
+                async with slots:
+                    try:
+                        await storage.delete(location)
+                    except FileNotFoundError:
+                        pass  # checksum tables are optional; slabs dedupe
+
+            await asyncio.gather(*(_delete_one(l) for l in sorted(locations)))
         finally:
             await storage.close()
         logger.info("Retention dropped step %d", step)

@@ -12,9 +12,19 @@ reference's uninitialized-process-group behavior.
 
 from __future__ import annotations
 
+import logging
+import weakref
 from typing import Any, List, Optional, Sequence
 
 from .dist_store import Store
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+# Shared op-seq storage for pg objects that reject attribute assignment
+# (__slots__/frozen): falls back to identity-keyed weak references.
+_OP_SEQ_REFS: "weakref.WeakKeyDictionary[Any, List[int]]" = (
+    weakref.WeakKeyDictionary()
+)
 
 
 class PGWrapper:
@@ -47,14 +57,7 @@ class PGWrapper:
             self.store = pg.store
             self.rank = int(pg.rank)
             self.world_size = int(pg.world_size)
-            ref = getattr(pg, "_ts_op_seq_ref", None)
-            if ref is None:
-                ref = [0]
-                try:
-                    pg._ts_op_seq_ref = ref
-                except Exception:  # frozen/slots pg: degrade to per-wrapper
-                    pass
-            self._op_seq_ref = ref
+            self._op_seq_ref = _shared_op_seq_ref(pg)
 
     def get_rank(self) -> int:
         return self.rank
@@ -103,3 +106,33 @@ class PGWrapper:
         return self.store.scatter(
             self._next_prefix("sc"), self.rank, self.world_size, objs, src
         )
+
+
+def _shared_op_seq_ref(pg: Any) -> List[int]:
+    """One op-seq counter per underlying pg object, surviving wrapper
+    churn. Attribute attachment first; weak-ref registry for frozen/slots
+    pgs; only truly un-referenceable pgs degrade to per-wrapper sequences
+    (loudly — aliasing re-appears then)."""
+    ref = getattr(pg, "_ts_op_seq_ref", None)
+    if ref is not None:
+        return ref
+    ref = [0]
+    try:
+        pg._ts_op_seq_ref = ref
+        return ref
+    except Exception:
+        pass
+    try:
+        existing = _OP_SEQ_REFS.get(pg)
+        if existing is not None:
+            return existing
+        _OP_SEQ_REFS[pg] = ref
+        return ref
+    except TypeError:
+        logger.warning(
+            "Process group %r accepts neither attributes nor weak "
+            "references; store-key sequences degrade to per-wrapper and "
+            "may alias across wrappers",
+            type(pg).__name__,
+        )
+        return ref

@@ -32,7 +32,12 @@ from typing import Awaitable, Callable, List, Optional
 import psutil
 
 from . import knobs
-from .integrity import ChecksumTable, compute_checksum, verify_checksum
+from .integrity import (
+    ChecksumTable,
+    compute_checksum_entry,
+    verify_checksum,
+    verify_range_checksum,
+)
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -239,10 +244,8 @@ async def execute_write_reqs(
         buf_len = len(buf)
         try:
             if record_checksums:
-                alg, crc = await asyncio.get_running_loop().run_in_executor(
-                    executor, compute_checksum, buf
-                )
-                checksums[req.path] = (alg, crc, buf_len)
+                checksums[req.path] = await asyncio.get_running_loop(
+                ).run_in_executor(executor, compute_checksum_entry, buf)
             async with io_slots:
                 stats.waiting_io -= 1
                 stats.io += 1
@@ -359,14 +362,17 @@ async def execute_read_reqs(
                 raise AssertionError(
                     f"Storage plugin did not populate buffer for {req.path}"
                 )
-            # Whole-blob reads are verified against the digest recorded at
-            # write time; ranged reads can't be (partial bytes — counted and
-            # reported below so 'checksums on' is never silently hollow).
-            # Runs before the value is handed to the application either way
-            # (direct reads land in framework-owned buffers only).
+            # Whole-blob reads verify against the blob digest; ranged reads
+            # verify every page their range fully covers (recorded for
+            # blobs larger than one page). Reads that end up with no
+            # verification at all are counted and reported below so
+            # 'checksums on' is never silently hollow. Runs before the
+            # value is handed to the application either way (direct reads
+            # land in framework-owned buffers only).
             if checksum_table is not None and req.path in checksum_table:
+                loop_ = asyncio.get_running_loop()
                 if req.byte_range is None:
-                    await asyncio.get_running_loop().run_in_executor(
+                    await loop_.run_in_executor(
                         executor,
                         verify_checksum,
                         buf,
@@ -374,7 +380,16 @@ async def execute_read_reqs(
                         req.path,
                     )
                 else:
-                    verify_skipped[0] += 1
+                    page_verified = await loop_.run_in_executor(
+                        executor,
+                        verify_range_checksum,
+                        buf,
+                        checksum_table[req.path],
+                        req.byte_range,
+                        req.path,
+                    )
+                    if not page_verified:
+                        verify_skipped[0] += 1
             if read_io.dest is not None and buf is read_io.dest:
                 # The plugin read straight into the destination; nothing
                 # left to deserialize or copy.
@@ -404,8 +419,8 @@ async def execute_read_reqs(
         executor.shutdown(wait=False)
     if verify_skipped[0]:
         logger.info(
-            "%d of %d reads were ranged (chunked/batched) and skipped "
-            "checksum verification",
+            "%d of %d reads were ranged with no fully-covered pages and "
+            "skipped checksum verification",
             verify_skipped[0],
             len(read_reqs),
         )
