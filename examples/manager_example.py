@@ -1,0 +1,80 @@
+"""Checkpoint-managed training loop: resume-if-exists, periodic saves,
+retention.
+
+The packaged version of the reference's hand-rolled loop
+(examples/simple_example.py:59-76). Run it twice to see the resume:
+
+    python examples/manager_example.py --work-dir /tmp/mgr_example
+    python examples/manager_example.py --work-dir /tmp/mgr_example  # resumes
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import torchsnapshot_tpu as ts
+
+TOTAL_STEPS = 10
+SAVE_EVERY = 3
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--work-dir", default="/tmp/ts_manager_example")
+    args = parser.parse_args()
+
+    params = {"w": jnp.zeros((32, 32)), "b": jnp.zeros(32)}
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    app_state = {
+        "params": ts.PyTreeState(params),
+        "opt": ts.PyTreeState(opt_state),
+        "progress": ts.StateDict(step=0),
+        "rng": ts.RngState(jax.random.key(0)),
+    }
+
+    mgr = ts.CheckpointManager(args.work_dir, keep_last_n=2)
+    resumed = mgr.restore_latest(app_state)
+    start = app_state["progress"]["step"]
+    print(
+        f"resumed from step {resumed}" if resumed is not None else "fresh run",
+        f"(starting at step {start})",
+    )
+
+    @jax.jit
+    def train_step(params, opt_state, key):
+        x = jax.random.normal(key, (16, 32))
+
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] + p["b"]) ** 2)
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    params = app_state["params"].tree
+    opt_state = app_state["opt"].tree
+    key = app_state["rng"].keys
+    for step in range(start, TOTAL_STEPS):
+        key, sub = jax.random.split(key)
+        params, opt_state = train_step(params, opt_state, sub)
+        if (step + 1) % SAVE_EVERY == 0 or step + 1 == TOTAL_STEPS:
+            app_state["params"].tree = params
+            app_state["opt"].tree = opt_state
+            app_state["progress"]["step"] = step + 1
+            app_state["rng"].keys = key
+            pending = mgr.async_save(step + 1, app_state)
+            pending.wait()
+            print(f"step {step + 1}: saved (steps on disk: {mgr.all_steps()})")
+
+    print(f"done at step {TOTAL_STEPS}; retained steps: {mgr.all_steps()}")
+
+
+if __name__ == "__main__":
+    main()

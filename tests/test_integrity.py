@@ -268,7 +268,11 @@ def test_verify_range_checksum_unit() -> None:
     blob = bytes(bytearray((i * 7) % 256 for i in range(page * 2 + 100)))
     entry = compute_checksum_entry(blob)
     assert len(entry) == 5
-    assert entry[1] is None  # paged entries carry page digests only
+    # Paged entries still carry a real whole-blob digest (chained from
+    # the page walk) so older readers can verify whole reads.
+    from torchsnapshot_tpu.integrity import compute_checksum
+
+    assert entry[1] == compute_checksum(blob)[1]
 
     # Full-page-aligned range: the page verifies.
     assert verify_range_checksum(blob[:page], entry, (0, page), "p")
@@ -289,11 +293,17 @@ def test_verify_range_checksum_unit() -> None:
     with pytest.raises(ChecksumError, match="returned"):
         verify_range_checksum(blob[: page - 1], entry, (0, page), "p")
 
-    # Whole-blob verification of a paged entry goes page-by-page.
+    # Whole-blob verification of a paged entry uses the chained digest.
     from torchsnapshot_tpu.integrity import verify_checksum as _vc
 
     _vc(blob, entry, "p")  # no raise
     whole_bad = bytearray(blob)
     whole_bad[page + 5] ^= 0x01
-    with pytest.raises(ChecksumError, match="page 1"):
+    with pytest.raises(ChecksumError, match="mismatch"):
         _vc(bytes(whole_bad), entry, "p")
+
+    # Interim paged format (whole digest None) verifies page-by-page.
+    interim = (entry[0], None, entry[2], entry[3], entry[4])
+    _vc(blob, interim, "p")  # no raise
+    with pytest.raises(ChecksumError, match="page 1"):
+        _vc(bytes(whole_bad), interim, "p")

@@ -283,7 +283,16 @@ class CheckpointManager:
                     except FileNotFoundError:
                         pass  # checksum tables are optional; slabs dedupe
 
-            await asyncio.gather(*(_delete_one(l) for l in sorted(locations)))
+            # return_exceptions: let every delete settle before the plugin
+            # closes (a bare gather would abandon in-flight siblings to die
+            # against a closing plugin), then surface the first failure.
+            results = await asyncio.gather(
+                *(_delete_one(l) for l in sorted(locations)),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
         finally:
             await storage.close()
         logger.info("Retention dropped step %d", step)
