@@ -1,0 +1,90 @@
+"""MemoryviewStream: zero-copy file-like reads.
+
+Reference parity: tests/test_memoryview_stream.py (reference
+memoryview_stream.py:12-81).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.memoryview_stream import MemoryviewStream
+
+
+def _stream(data: bytes = b"0123456789") -> MemoryviewStream:
+    return MemoryviewStream(memoryview(data))
+
+
+def test_sequential_reads() -> None:
+    s = _stream()
+    assert bytes(s.read(3)) == b"012"
+    assert s.tell() == 3
+    assert bytes(s.read(4)) == b"3456"
+    assert bytes(s.read(-1)) == b"789"
+    assert bytes(s.read(5)) == b""  # EOF
+    assert s.tell() == 10
+
+
+def test_reads_are_zero_copy_views() -> None:
+    data = bytearray(b"abcdef")
+    s = MemoryviewStream(memoryview(data))
+    chunk = s.read(3)
+    assert isinstance(chunk, memoryview)
+    data[0] = ord("z")  # same backing buffer
+    assert bytes(chunk) == b"zbc"
+
+
+def test_seek_whence() -> None:
+    s = _stream()
+    assert s.seek(4) == 4
+    assert bytes(s.read(2)) == b"45"
+    assert s.seek(-3, io.SEEK_CUR) == 3
+    assert bytes(s.read(1)) == b"3"
+    assert s.seek(-2, io.SEEK_END) == 8
+    assert bytes(s.read(-1)) == b"89"
+    with pytest.raises(ValueError):
+        s.seek(-1)
+    with pytest.raises(ValueError):
+        s.seek(0, 7)
+
+
+def test_seek_past_end_reads_empty() -> None:
+    s = _stream()
+    s.seek(100)
+    assert bytes(s.read(5)) == b""
+    assert s.tell() == 100  # position preserved, like BytesIO
+
+
+def test_readinto() -> None:
+    s = _stream()
+    buf = bytearray(4)
+    assert s.readinto(buf) == 4
+    assert bytes(buf) == b"0123"
+    s.seek(8)
+    buf = bytearray(4)
+    assert s.readinto(buf) == 2  # short read at EOF
+    assert bytes(buf[:2]) == b"89"
+
+
+def test_multidim_and_typed_views_are_flattened() -> None:
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    s = MemoryviewStream(memoryview(arr))
+    assert len(s) == arr.nbytes
+    assert bytes(s.read(-1)) == arr.tobytes()
+
+
+def test_io_flags_and_close() -> None:
+    s = _stream()
+    assert s.readable() and s.seekable() and not s.writable()
+    assert len(s) == 10
+    s.close()
+    with pytest.raises(ValueError):
+        s.read(1)
+
+
+def test_bufferedreader_compatible() -> None:
+    # Clients may wrap bodies in BufferedReader; RawIOBase contract must hold.
+    s = MemoryviewStream(memoryview(b"x" * 10000))
+    reader = io.BufferedReader(s)
+    assert reader.read() == b"x" * 10000

@@ -105,11 +105,26 @@ def test_take_restore_chunked(tmp_path) -> None:
 
 @pytest.mark.parametrize(
     "dtype",
-    ["float32", "bfloat16", "float16", "int8", "int32", "uint8", "bool", "complex64"],
+    [
+        "float32",
+        "bfloat16",
+        "float16",
+        "int8",
+        "int32",
+        "uint8",
+        "bool",
+        "complex64",
+        "float8_e4m3fn",
+        "float8_e5m2",
+    ],
 )
 def test_roundtrip_dtypes(tmp_path, dtype) -> None:
     rng = np.random.default_rng(0)
-    if dtype == "bool":
+    if dtype.startswith("float8"):
+        import ml_dtypes
+
+        arr = rng.standard_normal((16, 4)).astype(getattr(ml_dtypes, dtype))
+    elif dtype == "bool":
         arr = rng.integers(0, 2, (16, 4)).astype(bool)
     elif dtype == "complex64":
         arr = (rng.standard_normal((16, 4)) + 1j * rng.standard_normal((16, 4))).astype(

@@ -65,6 +65,56 @@ def _pick_alg() -> str:
     return "crc32c" if _native.crc32c(b"") is not None else "crc32"
 
 
+# Reflected polynomials for CRC combination (zlib crc32_combine algorithm).
+_POLY = {"crc32c": 0x82F63B78, "crc32": 0xEDB88320}
+
+
+def _gf2_times(mat, vec: int) -> int:
+    out = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            out ^= mat[i]
+        vec >>= 1
+        i += 1
+    return out
+
+
+def _gf2_square(mat):
+    return [_gf2_times(mat, mat[i]) for i in range(32)]
+
+
+def _crc_shift_operator(length: int, alg: str):
+    """GF(2) operator advancing a CRC over ``length`` zero bytes — the
+    zlib crc32_combine construction, parametrized by polynomial. Applying
+    it to crc(a) and XORing crc(b) yields crc(a ‖ b) for len(b)=length."""
+    poly = _POLY[alg]
+    # operator for one zero BIT
+    odd = [poly] + [1 << (i - 1) for i in range(1, 32)]
+    even = _gf2_square(odd)   # two bits
+    odd = _gf2_square(even)   # four bits
+    # Walk ``length`` in BYTES (zlib crc32_combine): the first squaring
+    # below yields the 8-bit (one-byte) operator, matching bit 0 of the
+    # byte count; each further squaring doubles the byte weight.
+    op = None
+    mat = odd
+    n = length
+    while n:
+        mat = _gf2_square(mat)
+        if n & 1:
+            op = mat if op is None else [_gf2_times(mat, op[i]) for i in range(32)]
+        n >>= 1
+    if op is None:  # length 0
+        op = [1 << i for i in range(32)]
+    return op
+
+
+def crc_combine(crc1: int, crc2: int, len2: int, alg: str, _op=None) -> int:
+    """crc(a ‖ b) from crc(a)=crc1, crc(b)=crc2, len(b)=len2."""
+    op = _op if _op is not None else _crc_shift_operator(len2, alg)
+    return _gf2_times(op, crc1) ^ crc2
+
+
 def _crc_of(mv: memoryview, alg: str, seed: int = 0) -> int:
     """Running digest: ``seed`` is the digest of the preceding bytes, so
     page digests chain into the whole-blob digest (both the native
@@ -94,12 +144,19 @@ def compute_checksum_entry(buf: BufferType) -> Tuple:
     alg = _pick_alg()
     if nbytes <= PAGE_SIZE:
         return (alg, _crc_of(mv, alg), nbytes)
-    pages: list = []
-    whole = 0
-    for off in range(0, nbytes, PAGE_SIZE):
-        chunk = mv[off : off + PAGE_SIZE]
-        pages.append(_crc_of(chunk, alg))
-        whole = _crc_of(chunk, alg, seed=whole)
+    pages: list = [
+        _crc_of(mv[off : off + PAGE_SIZE], alg)
+        for off in range(0, nbytes, PAGE_SIZE)
+    ]
+    # Whole-blob digest folded from the page digests in O(1) per page
+    # (GF(2) shift operators) — each byte is CRC'd exactly once.
+    full_op = _crc_shift_operator(PAGE_SIZE, alg)
+    tail = nbytes - (len(pages) - 1) * PAGE_SIZE
+    tail_op = full_op if tail == PAGE_SIZE else _crc_shift_operator(tail, alg)
+    whole = pages[0]
+    for i, page_crc in enumerate(pages[1:], start=1):
+        op = tail_op if i == len(pages) - 1 else full_op
+        whole = crc_combine(whole, page_crc, 0, alg, _op=op)
     return (alg, whole, nbytes, PAGE_SIZE, pages)
 
 
@@ -111,11 +168,12 @@ def _alg_available(alg: str) -> bool:
 
 def verify_checksum(buf: BufferType, expected: Tuple, path: str) -> None:
     """Raise :class:`ChecksumError` when ``buf`` does not match the
-    recorded digest(s) — the whole-blob digest, or page digests for paged
-    entries (whose whole-blob field is None; pages cover every byte).
-    Algorithm mismatches (table written with crc32c but the native lib is
-    unavailable here, or vice versa) are skipped — a missing
-    implementation must not fail restores."""
+    recorded digest. Paged entries carry a real whole-blob digest (folded
+    from the page digests) and verify through the normal whole-CRC path;
+    only interim-format tables whose whole-blob field is None fall back
+    to page-by-page verification. Algorithm mismatches (table written
+    with crc32c but the native lib is unavailable here, or vice versa)
+    are skipped — a missing implementation must not fail restores."""
     alg, crc, nbytes = expected[0], expected[1], expected[2]
     mv = _as_bytes_view(buf)
     if mv.nbytes != nbytes:

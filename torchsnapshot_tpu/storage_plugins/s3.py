@@ -105,10 +105,15 @@ class S3StoragePlugin(StoragePlugin):
         client = await self._get_client()
 
         async def op() -> None:
+            from ..memoryview_stream import MemoryviewStream
+
+            # File-like body: botocore streams it (seek/tell for length and
+            # retry rewind) instead of us copying the staged buffer into a
+            # bytes — reference memoryview_stream.py:12-81 rationale.
             await client.put_object(
                 Bucket=self.bucket,
                 Key=self._key(write_io.path),
-                Body=bytes(write_io.buf),
+                Body=MemoryviewStream(memoryview(write_io.buf)),
             )
 
         await self._run_retrying(op)
