@@ -29,6 +29,7 @@ older versions) restore without verification.
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
 import zlib
@@ -84,10 +85,13 @@ def _gf2_square(mat):
     return [_gf2_times(mat, mat[i]) for i in range(32)]
 
 
+@functools.lru_cache(maxsize=64)
 def _crc_shift_operator(length: int, alg: str):
     """GF(2) operator advancing a CRC over ``length`` zero bytes — the
     zlib crc32_combine construction, parametrized by polynomial. Applying
-    it to crc(a) and XORing crc(b) yields crc(a ‖ b) for len(b)=length."""
+    it to crc(a) and XORing crc(b) yields crc(a ‖ b) for len(b)=length.
+    Cached: the construction is ~22 pure-Python matrix squarings and the
+    write path needs it once per (length, alg), not once per blob."""
     poly = _POLY[alg]
     # operator for one zero BIT
     odd = [poly] + [1 << (i - 1) for i in range(1, 32)]
@@ -136,9 +140,9 @@ def compute_checksum(buf: BufferType) -> Tuple[str, int]:
 def compute_checksum_entry(buf: BufferType) -> Tuple:
     """Full table entry for one staged blob. Single-page blobs get the
     whole-blob digest; larger blobs additionally get per-page digests for
-    ranged-read verification. The whole-blob digest is chained from the
-    same page walk (CRC continuation), so each byte is visited while
-    cache-hot instead of in a second cold pass."""
+    ranged-read verification. The whole-blob digest is folded from the
+    page digests with GF(2) shift operators (the zlib crc32_combine
+    construction) — O(1) per page, so each byte is CRC'd exactly once."""
     mv = _as_bytes_view(buf)
     nbytes = mv.nbytes
     alg = _pick_alg()

@@ -234,3 +234,66 @@ def rand_array(shape: Sequence[int], dtype: Any = "float32", seed: int = 0):
     if dt.kind == "c":
         return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(dt)
     return rng.standard_normal(shape).astype(dt)
+
+
+@functools.lru_cache(maxsize=None)
+def backend_materializes_dtype(dtype_str: str) -> bool:
+    """True when the current jax backend can materialize + transfer arrays
+    of this dtype. Some dev backends (e.g. the tunneled axon TPU) raise
+    UNIMPLEMENTED for float16/fp8/complex programs; dtype-matrix tests
+    skip those cases there (they run fully on CPU and real TPU hosts).
+
+    Off CPU the probe runs in a SUBPROCESS: a failed program can wedge
+    the tunnel client for the rest of the parent process (even
+    ``jax.random.PRNGKey`` starts raising UNIMPLEMENTED, and
+    clear_backends does not recover), so the parent must never attempt
+    the materialization itself.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        import jax.numpy as jnp
+        import numpy as np
+
+        try:
+            np.asarray(jnp.zeros((1,), dtype_str))
+            return True
+        except Exception:
+            return False
+
+    import subprocess
+    import sys
+
+    parent_backend = jax.default_backend()
+    # Exit codes: 0 = materializable, 1 = dtype UNIMPLEMENTED, 3 = child
+    # could not reach the parent's backend (single-process accelerators):
+    # then we cannot know, and the useful default is True — real TPU
+    # hosts support the full matrix; skipping everything there would
+    # silently hollow out the dtype tests.
+    code = "\n".join(
+        [
+            "import sys",
+            "import jax",
+            f"if jax.default_backend() != {parent_backend!r}:",
+            "    sys.exit(3)",
+            "import jax.numpy as jnp",
+            "import numpy as np",
+            "try:",
+            f"    np.asarray(jnp.zeros((1,), {dtype_str!r}))",
+            "except Exception:",
+            "    sys.exit(1)",
+        ]
+    )
+    env = dict(os.environ, JAX_PLATFORMS=parent_backend)
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=180,
+            env=env,
+        ).returncode
+    except Exception:
+        return True  # probe infrastructure failure: assume supported
+    if rc == 1:
+        return False
+    return True
