@@ -1,0 +1,83 @@
+"""JaxCoordinationStore + jax_process_group over a real (single-process)
+jax.distributed runtime.
+
+Reference analog: tests/test_dist_store.py's TCPStore coverage — here the
+store rides the JAX coordination service instead, the path multi-host TPU
+pods use (SURVEY.md §2.11 TPU-equivalent). jax.distributed.initialize is
+process-global and irreversible, so the exercise runs in a spawned worker
+(the harness pins workers to the CPU backend).
+"""
+
+from torchsnapshot_tpu.test_utils import get_free_port, run_multiprocess
+
+
+def _jax_coordination_worker(pg, port: int):
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=1, process_id=0
+    )
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.dist_store import (
+        JaxCoordinationStore,
+        LinearBarrier,
+        jax_process_group,
+    )
+
+    store = JaxCoordinationStore()
+    # KV primitives.
+    store.set("k1", b"value-1")
+    assert store.try_get("k1") == b"value-1"
+    assert store.try_get("missing") is None
+    store.delete("k1")
+    assert store.try_get("k1") is None
+
+    counters_ok = True
+    try:
+        assert store.add("ctr", 2) == 2
+        assert store.add("ctr", 3) == 5
+    except NotImplementedError:
+        counters_ok = False  # older jaxlib: documented degradation
+
+    # Object collectives (world 1 semantics still run real KV traffic).
+    if counters_ok:
+        assert store.exchange("ex", 0, 1, {"x": 1}) == [{"x": 1}]
+        assert store.broadcast("bc", 0, 1, "hello") == "hello"
+        barrier = LinearBarrier("b", store, rank=0, world_size=1)
+        barrier.arrive()
+        barrier.depart()
+
+    # The convenience pg threads through the Snapshot API (world size 1
+    # short-circuits collectives, so KV coverage comes from the block
+    # above; this asserts construction + end-to-end compatibility).
+    jpg = jax_process_group()
+    assert jpg.rank == 0 and jpg.world_size == 1
+    import tempfile
+
+    path = tempfile.mkdtemp(prefix="ts_jaxcoord_")
+    arr = np.arange(16.0)
+    ts.Snapshot.take(path, {"s": ts.PyTreeState({"w": arr})}, pg=jpg)
+    dst = {"s": ts.PyTreeState({"w": np.zeros(16)})}
+    ts.Snapshot(path, pg=jpg).restore(dst)
+    np.testing.assert_array_equal(dst["s"].tree["w"], arr)
+    return counters_ok
+
+
+def test_jax_coordination_store() -> None:
+    # Allocate the coordinator port and the harness TCPStore port from two
+    # simultaneously-bound sockets: sequential get_free_port() calls can
+    # return the same just-released port.
+    import socket
+
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(("127.0.0.1", 0))
+        s2.bind(("127.0.0.1", 0))
+        coord_port = s1.getsockname()[1]
+        store_port = s2.getsockname()[1]
+
+    [counters_ok] = run_multiprocess(
+        _jax_coordination_worker, nproc=1, args=(coord_port,), port=store_port
+    )
+    assert isinstance(counters_ok, bool)

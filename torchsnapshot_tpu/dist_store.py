@@ -23,6 +23,7 @@ deletes its keys, so long-lived stores don't leak.
 from __future__ import annotations
 
 import abc
+from dataclasses import dataclass
 import pickle
 import socket
 import socketserver
@@ -33,6 +34,16 @@ from typing import Any, Dict, List, Optional, Sequence
 
 _DEFAULT_TIMEOUT_S = 300.0
 _POLL_INTERVAL_S = 0.005
+
+
+@dataclass
+class ProcessGroup:
+    """What :class:`~torchsnapshot_tpu.pg_wrapper.PGWrapper` consumes: a
+    store plus this process's coordinates."""
+
+    store: "Store"
+    rank: int
+    world_size: int
 
 
 class StoreTimeoutError(TimeoutError):
@@ -366,6 +377,28 @@ class JaxCoordinationStore(Store):
             self._client.key_value_delete(key)
         except Exception:
             pass
+
+
+def jax_process_group():
+    """The process group for a ``jax.distributed``-initialized job: rank
+    and world from the JAX runtime, coordination over its KV service —
+    no address side-channel to plumb. This is how multi-host TPU pods
+    hand ``pg=`` to ``Snapshot.take``/``CheckpointManager``::
+
+        jax.distributed.initialize()
+        pg = jax_process_group()
+        ts.Snapshot.take(path, app_state, pg=pg)
+
+    (Reference analog: get_or_create_store reusing the c10d default
+    TCPStore, dist_store.py:22-88.)
+    """
+    import jax
+
+    return ProcessGroup(
+        store=JaxCoordinationStore(),
+        rank=jax.process_index(),
+        world_size=jax.process_count(),
+    )
 
 
 # ---------------------------------------------------------------------------

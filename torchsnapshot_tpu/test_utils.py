@@ -21,21 +21,11 @@ import os
 import pickle
 import socket
 import traceback
-from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .dist_store import Store, TCPStore
-
-
-@dataclass
-class ProcessGroup:
-    """What ``PGWrapper`` consumes: a store plus this process's coordinates."""
-
-    store: Store
-    rank: int
-    world_size: int
+from .dist_store import ProcessGroup, Store, TCPStore  # noqa: F401 - re-export
 
 
 def get_free_port() -> int:
@@ -96,15 +86,19 @@ def run_multiprocess(
     args: Sequence[Any] = (),
     kwargs: Optional[Dict[str, Any]] = None,
     timeout: float = 180.0,
+    port: Optional[int] = None,
 ) -> List[Any]:
     """Run ``fn(pg, *args, **kwargs)`` in ``nproc`` spawned processes with a
     shared TCP store; returns per-rank results, raises on any rank failure.
 
     ``fn`` must be a module-level callable (spawned workers re-import it by
     qualified name, the same constraint as the reference's launch pad,
-    test_utils.py:221-224).
+    test_utils.py:221-224). Callers juggling additional listeners should
+    pass an explicit ``port`` allocated alongside theirs (two sequential
+    get_free_port calls can return the same just-released port).
     """
-    port = get_free_port()
+    if port is None:
+        port = get_free_port()
     ctx = mp.get_context("spawn")
     payload = pickle.dumps((tuple(args), kwargs or {}))
     import importlib
