@@ -210,3 +210,47 @@ def _mgr_worker(pg, root: str):
     resumed = mgr.restore_latest(dst)
     assert float(dst["s"].tree["w"][0]) == float(pg.rank)  # per-rank state
     return resumed
+
+
+def test_multiprocess_async_save_and_retention(tmp_path) -> None:
+    """async_save in a multiprocess world: the background commits of both
+    ranks coordinate through the store barrier, retention runs on rank 0
+    inside wait(), and the next resume sees exactly the retained steps."""
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    results = run_multiprocess(
+        _mgr_async_worker, nproc=2, args=(str(tmp_path / "root"),)
+    )
+    assert results == [[2, 3], [2, 3]]
+
+
+def _mgr_async_worker(pg, root: str):
+    import shutil
+
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    PGWrapper(pg).barrier()
+    mgr = ts.CheckpointManager(root, keep_last_n=2, pg=pg)
+    for step in (1, 2, 3):
+        state = {
+            "s": ts.PyTreeState({"w": np.full((4,), float(step))}),
+            "progress": ts.StateDict(rank=pg.rank),
+        }
+        pending = mgr.async_save(step, state)
+        pending.wait()
+    PGWrapper(pg).barrier()  # rank 0's index write is durable everywhere
+    steps = sorted(mgr.all_steps())
+    dst = {
+        "s": ts.PyTreeState({"w": np.zeros(4)}),
+        "progress": ts.StateDict(rank=-1),
+    }
+    resumed = mgr.restore_latest(dst)
+    assert resumed == 3
+    assert float(dst["s"].tree["w"][0]) == 3.0
+    assert dst["progress"]["rank"] == pg.rank  # per-rank state stayed per-rank
+    return steps
