@@ -1,0 +1,68 @@
+"""Pallas flash attention vs the dense einsum op.
+
+Same strategy as test_ring_attention.py: numerical equivalence of two
+implementations, no I/O. The kernel runs in Pallas interpreter mode
+(CPU-safe; pallas_guide.md's interpret flag) — on real TPUs the same
+kernel compiles natively.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.ops import causal_attention, flash_causal_attention
+
+
+def _qkv(seed, shape=(2, 256, 4, 32), dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+@pytest.mark.parametrize("block_q,block_k", [(64, 64), (128, 64), (64, 128)])
+def test_flash_matches_dense_f32(block_q, block_k) -> None:
+    q, k, v = _qkv(0)
+    dense = causal_attention(q, k, v)
+    flash = flash_causal_attention(
+        q, k, v, block_q=block_q, block_k=block_k, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_matches_dense_bf16() -> None:
+    q, k, v = _qkv(1, dtype=jnp.bfloat16)
+    dense = causal_attention(q, k, v)
+    flash = flash_causal_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(flash).astype(np.float32),
+        np.asarray(dense).astype(np.float32),
+        rtol=2e-2,
+        atol=2e-2,
+    )
+
+
+def test_flash_causality() -> None:
+    """Future tokens cannot influence outputs: perturbing position j only
+    changes outputs at positions >= j."""
+    q, k, v = _qkv(2, shape=(1, 128, 2, 16))
+    base = flash_causal_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    j = 100
+    k2 = k.at[:, j].set(k[:, j] + 10.0)
+    v2 = v.at[:, j].set(v[:, j] - 3.0)
+    pert = flash_causal_attention(q, k2, v2, block_q=64, block_k=64, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(pert[:, :j]), np.asarray(base[:, :j])
+    )
+    assert not np.allclose(np.asarray(pert[:, j:]), np.asarray(base[:, j:]))
+
+
+def test_flash_rejects_nondivisible_seq() -> None:
+    q, k, v = _qkv(3, shape=(1, 96, 2, 16))
+    with pytest.raises(ValueError, match="multiple"):
+        flash_causal_attention(q, k, v, block_q=64, block_k=64, interpret=True)

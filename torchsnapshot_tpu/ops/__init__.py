@@ -1,4 +1,9 @@
 from .attention import causal_attention
+from .flash_attention import flash_causal_attention
 from .ring_attention import ring_causal_attention
 
-__all__ = ["causal_attention", "ring_causal_attention"]
+__all__ = [
+    "causal_attention",
+    "flash_causal_attention",
+    "ring_causal_attention",
+]

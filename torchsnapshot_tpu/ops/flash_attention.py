@@ -1,0 +1,146 @@
+"""Fused blockwise causal attention (flash attention forward) in Pallas.
+
+The einsum formulation in ``attention.py`` materializes the full
+``(b, h, s, s)`` logits — O(s²) HBM traffic that XLA cannot fuse away.
+This kernel streams K/V through VMEM one ``(block_k, d)`` tile per grid
+step with an online softmax, so VMEM residency is O(block·d) regardless
+of sequence length and the two matmuls per tile run back-to-back on the
+MXU: the standard memory-bound → compute-bound transformation for long
+sequences (the hot op under the ring attention in ops/ring_attention.py,
+whose per-step local attention this can replace on real TPUs).
+
+Structure: grid ``(batch·heads, q_blocks, k_blocks)``; the innermost
+k dimension iterates sequentially on one core, carrying the running
+max / normalizer / accumulator in VMEM scratch (pallas_guide.md's
+accumulator-across-minor-grid-dim pattern); tiles beyond the causal
+frontier are skipped with ``pl.when``. The output block is written once,
+at the last k step.
+
+Numerics: logits/softmax in float32 regardless of input dtype; masked
+positions use a large-negative constant instead of -inf so fully-masked
+rows never produce NaN through the running-max rescale (at k-block 0
+every causal row has its diagonal element, and for later blocks the
+running max is already finite).
+
+Tests run the kernel in interpreter mode (``interpret=True``) against
+the dense einsum op — the CPU-safe way to validate Pallas kernels
+(pallas_guide.md: interpret flag); the same kernel compiles natively on
+TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    d = q_ref.shape[-1]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full((block_q,), _NEG_BIG, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q,), jnp.float32)
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    # Tiles fully beyond the causal frontier contribute nothing.
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _update():
+        scale = 1.0 / (d**0.5)
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+        kb = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        vb = v_ref[0].astype(jnp.float32)
+        logits = lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        logits = jnp.where(q_pos >= k_pos, logits, _NEG_BIG)
+
+        m = m_ref[:]
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:][:, None]).astype(o_ref.dtype)
+
+
+def flash_causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for :func:`~torchsnapshot_tpu.ops.causal_attention` on
+    shapes where ``seq`` divides by the block sizes.
+
+    Args:
+        q, k, v: ``(batch, seq, n_heads, head_dim)``.
+        block_q, block_k: VMEM tile sizes (128 aligns with the MXU).
+        interpret: run in the Pallas interpreter (CPU-safe; tests).
+    """
+    b, s, h, d = q.shape
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"seq {s} must be a multiple of block_q={block_q} and "
+            f"block_k={block_k}"
+        )
+    n_k = s // block_k
+    # (b*h, s, d): one grid row per batch-head.
+    to_rows = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qf, kf, vf = to_rows(q), to_rows(k), to_rows(v)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k
+        ),
+        grid=(b * h, s // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
