@@ -5,10 +5,17 @@ local FS, the analog of the reference's DDP benchmark
 
 Prints ONE JSON line:
     {"metric": "checkpoint_save_throughput", "value": N, "unit": "GB/s",
-     "vs_baseline": N}
+     "vs_baseline": N, "pipeline_efficiency": N,
+     "d2h_ceiling_gbps": N, "d2h_single_gbps": N, "size_gib": N}
 
 vs_baseline is the ratio against the reference's single-accelerator
-local-FS number (1.4 GB/s). Size configurable via TS_BENCH_GB (default 1).
+local-FS number (1.4 GB/s). ``pipeline_efficiency`` is the achieved save
+throughput divided by the *attainable* device→host bandwidth on this
+machine (the concurrent-stream D2H ceiling measured in-process), so the
+number is meaningful even when the device link itself is slow (tunneled
+dev TPUs): 1.0 means the checkpoint pipeline is perfectly hidden behind
+the D2H copy it cannot avoid. Size configurable via TS_BENCH_GB
+(default 4).
 """
 
 import json
@@ -20,6 +27,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 import torchsnapshot_tpu as ts
 
@@ -48,24 +56,62 @@ def make_state(total_bytes: int) -> dict:
     return arrays
 
 
+def probe_d2h(n_streams: int, chunk_mib: int = 32) -> float:
+    """Measured D2H GB/s with ``n_streams`` concurrent async copies.
+
+    ``copy_to_host_async`` on every array first, then materialize: the
+    transfers overlap inside the runtime, so this measures the *attainable*
+    device→host bandwidth — the checkpoint pipeline's physical ceiling —
+    rather than the single-stream latency-bound rate.
+    """
+    side = int((chunk_mib * (1 << 20) // 2) ** 0.5)  # bf16 square
+    keys = jax.random.split(jax.random.PRNGKey(1), n_streams)
+    arrs = [jax.random.normal(k, (side, side), jnp.bfloat16) for k in keys]
+    jax.block_until_ready(arrs)
+    total = sum(a.nbytes for a in arrs)
+    t0 = time.perf_counter()
+    for a in arrs:
+        a.copy_to_host_async()
+    hosts = [np.asarray(a) for a in arrs]
+    elapsed = time.perf_counter() - t0
+    del hosts
+    return total / (1 << 30) / elapsed
+
+
 def main() -> None:
-    gb = float(os.environ.get("TS_BENCH_GB", "1"))
+    # Attainable D2H bandwidth: single stream (latency-bound context line)
+    # and the best concurrent-stream rate (the pipeline's physical ceiling).
+    d2h_single = probe_d2h(1)
+    ceiling = d2h_single
+    if d2h_single > 0.5:
+        # Locally-attached device: cheap 32 MiB probes are accurate.
+        plan = [(2, 32), (4, 32), (8, 32)]
+    else:
+        # Tunneled dev device (~MB/s): per-transfer overhead dominates
+        # small probes, so match the pipeline's actual transfer size
+        # (256 MiB leaves) or the ceiling comes out *below* what the
+        # pipeline demonstrably achieves.
+        plan = [(1, 256), (4, 64)]
+    for n, mib in plan:
+        r = probe_d2h(n, chunk_mib=mib)
+        _log(f"bench: D2H x{n} streams of {mib} MiB = {r:.3f} GB/s")
+        ceiling = max(ceiling, r)
+    _log(
+        f"bench: raw D2H single-stream = {d2h_single:.3f} GB/s, "
+        f"concurrent ceiling = {ceiling:.3f} GB/s"
+    )
+
+    gb_env = os.environ.get("TS_BENCH_GB")
+    gb = float(gb_env) if gb_env is not None else 4.0
+    if gb_env is None and ceiling < 0.1:
+        # Tunnel-limited link: the save is pure D2H wall time, so extra
+        # gigabytes add minutes without changing any reported ratio.
+        gb = 1.0
+        _log("bench: tunneled D2H detected; defaulting to 1 GiB state")
     total_bytes = int(gb * (1 << 30))
     _log(f"bench: materializing ~{gb:.1f} GiB of bf16 state on {jax.devices()[0]}")
     state = make_state(total_bytes)
     nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
-
-    # Context line: raw single-stream D2H bandwidth. On tunneled devices
-    # (axon dev setup) this caps checkpoint throughput far below what the
-    # pipeline achieves on locally-attached TPU hosts.
-    probe = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), jnp.bfloat16)
-    jax.block_until_ready(probe)
-    t0 = time.perf_counter()
-    import numpy as np
-
-    np.asarray(probe)
-    d2h = probe.nbytes / (1 << 30) / (time.perf_counter() - t0)
-    _log(f"bench: raw single-stream D2H = {d2h:.3f} GB/s")
 
     workdir = tempfile.mkdtemp(prefix="ts_bench_", dir="/tmp")
     try:
@@ -82,9 +128,10 @@ def main() -> None:
         shutil.rmtree(workdir, ignore_errors=True)
 
     gbps = nbytes / (1 << 30) / elapsed
+    efficiency = gbps / ceiling if ceiling > 0 else 0.0
     _log(
         f"bench: wrote {nbytes / (1 << 30):.2f} GiB in {elapsed:.2f} s "
-        f"({gbps:.2f} GB/s)"
+        f"({gbps:.2f} GB/s, {efficiency:.2f}x of D2H ceiling)"
     )
     print(
         json.dumps(
@@ -93,6 +140,10 @@ def main() -> None:
                 "value": round(gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / REFERENCE_SINGLE_ACCEL_GBPS, 3),
+                "pipeline_efficiency": round(efficiency, 3),
+                "d2h_ceiling_gbps": round(ceiling, 3),
+                "d2h_single_gbps": round(d2h_single, 3),
+                "size_gib": round(nbytes / (1 << 30), 2),
             }
         )
     )

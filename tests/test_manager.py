@@ -254,3 +254,31 @@ def _mgr_async_worker(pg, root: str):
     assert float(dst["s"].tree["w"][0]) == 3.0
     assert dst["progress"]["rank"] == pg.rank  # per-rank state stayed per-rank
     return steps
+
+
+def test_unreadable_index_fails_save_instead_of_orphaning(tmp_path) -> None:
+    """Transiently unreadable index slots must not be treated as an empty
+    step list: a save in that state would rewrite the index as just the new
+    step, silently orphaning every previously committed step."""
+    import unittest.mock as mock
+
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    mgr = ts.CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+
+    real_read = FSStoragePlugin.read
+
+    async def flaky_read(self, read_io):
+        if read_io.path.endswith(".index") or "index" in read_io.path:
+            raise OSError("transient storage blip")
+        return await real_read(self, read_io)
+
+    with mock.patch.object(FSStoragePlugin, "read", flaky_read):
+        with pytest.raises(Exception, match="index unreadable|transient"):
+            mgr.save(3, _state(3.0))
+    # The blip healed: the earlier steps are still indexed and restorable.
+    assert mgr.all_steps() == [1, 2]
+    dst = _state(0.0)
+    assert mgr.restore_latest(dst) == 2

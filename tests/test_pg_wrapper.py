@@ -109,3 +109,19 @@ def test_barrier_releases_all_ranks() -> None:
         return pg.get_rank()
 
     assert sorted(run_ranks(4, fn)) == [0, 1, 2, 3]
+
+
+def test_counter_shared_across_pgs_on_same_store() -> None:
+    """Two distinct ProcessGroup objects over the same store must share one
+    op-seq counter: store-key collisions are scoped to the store, so
+    independent counters could alias ``__pg/*`` keys (e.g. one pg handed to
+    CheckpointManager and another to Snapshot)."""
+    store = InProcessStore()
+    pg_a = ProcessGroup(store=store, rank=0, world_size=2)
+    pg_b = ProcessGroup(store=store, rank=0, world_size=2)
+    wa = PGWrapper(pg_a)
+    wb = PGWrapper(pg_b)
+    assert wa._op_seq_ref is wb._op_seq_ref
+    p1 = wa._next_prefix("ag")
+    p2 = wb._next_prefix("ag")
+    assert p1 != p2

@@ -107,3 +107,30 @@ def test_save_from_torch_restore_into_jax(tmp_path) -> None:
     np.testing.assert_array_equal(
         np.asarray(fresh["params"].tree["w"]), w.numpy()
     )
+
+
+def test_plain_dict_restore_mutates_original_in_place(tmp_path) -> None:
+    """A caller holding the original plain dict must observe restored
+    non-tensor leaves (step counters, lr floats) after restore, not just
+    the in-place-copied tensors."""
+    src = {
+        "w": torch.arange(6, dtype=torch.float32),
+        "step": 41,
+        "lr": 0.25,
+        "sched": [1, 2, {"gamma": 0.9}],
+    }
+    ts.Snapshot.take(str(tmp_path), {"s": TorchStateful(src)})
+
+    dst = {
+        "w": torch.zeros(6, dtype=torch.float32),
+        "step": 0,
+        "lr": 0.0,
+        "sched": [0, 0, {"gamma": 0.0}],
+    }
+    held = dst  # what a training loop would keep a reference to
+    held_sched = dst["sched"]
+    ts.Snapshot(str(tmp_path)).restore({"s": TorchStateful(dst)})
+    assert held["step"] == 41
+    assert held["lr"] == 0.25
+    assert held_sched[0] == 1 and held_sched[2]["gamma"] == pytest.approx(0.9)
+    np.testing.assert_array_equal(held["w"].numpy(), np.arange(6, dtype=np.float32))

@@ -208,16 +208,18 @@ def verify_range_checksum(
     checked, and up-to-two partial edge pages are skipped. Returns True
     when at least one page was verified (False = entry has no pages or
     the range covers none fully)."""
-    if len(expected) < 5:
-        return False
-    alg, _, nbytes, page_size, pages = expected[:5]
     start, end = byte_range
     mv = _as_bytes_view(buf)
+    # Size check first: a truncated ranged read of a *non-paged* entry
+    # (len(expected) < 5) must still fail loudly here.
     if mv.nbytes != end - start:
         raise ChecksumError(
             f"{path}: ranged read [{start}, {end}) returned {mv.nbytes} "
             f"bytes (expected {end - start})"
         )
+    if len(expected) < 5:
+        return False
+    alg, _, nbytes, page_size, pages = expected[:5]
     if not _alg_available(alg):
         return False
     first_page = (start + page_size - 1) // page_size  # first fully-covered

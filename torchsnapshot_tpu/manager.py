@@ -212,6 +212,7 @@ class CheckpointManager:
         """Primary slot, falling back to the backup slot: the index is
         rewritten on every save, so a crash mid-write must not brick the
         manager (the backup holds at worst the previous step list)."""
+        unreadable: List[str] = []
         for slot in (INDEX_BLOB, INDEX_BACKUP_BLOB):
             read_io = ReadIO(path=slot)
             try:
@@ -220,6 +221,7 @@ class CheckpointManager:
                 continue
             except Exception as e:  # noqa: BLE001
                 logger.warning("Could not read index slot %s: %r", slot, e)
+                unreadable.append(slot)
                 continue
             if read_io.buf is None:
                 continue
@@ -234,6 +236,16 @@ class CheckpointManager:
                     e,
                     INDEX_BACKUP_BLOB,
                 )
+                unreadable.append(slot)
+        if unreadable:
+            # "Slots absent" (fresh directory) yields []; "slots unreadable"
+            # must NOT — a subsequent index rewrite would silently orphan
+            # every previously committed step.  Fail the operation loudly
+            # instead; a transient storage error heals on retry.
+            raise RuntimeError(
+                f"checkpoint index unreadable (slots {unreadable!r}); "
+                "refusing to treat the step list as empty"
+            )
         return []
 
     async def _write_index_async(

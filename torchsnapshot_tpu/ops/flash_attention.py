@@ -39,6 +39,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
+# Running max/normalizer live in lanes-identical (block_q, _LANES) VMEM
+# tiles: Mosaic wants vector scratch shaped to full (sublane, lane) tiles,
+# so the per-row scalars are replicated across the 128-lane minor dim and
+# recovered with keepdims reductions (any lanewise reduction of identical
+# lanes is the identity).
+_LANES = 128
 
 
 def _flash_kernel(
@@ -60,8 +66,8 @@ def _flash_kernel(
 
     @pl.when(ki == 0)
     def _init():
-        m_ref[:] = jnp.full((block_q,), _NEG_BIG, jnp.float32)
-        l_ref[:] = jnp.zeros((block_q,), jnp.float32)
+        m_ref[:] = jnp.full((block_q, _LANES), _NEG_BIG, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q, _LANES), jnp.float32)
         acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
     # Tiles fully beyond the causal frontier contribute nothing.
@@ -82,19 +88,23 @@ def _flash_kernel(
         )
         logits = jnp.where(q_pos >= k_pos, logits, _NEG_BIG)
 
-        m = m_ref[:]
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + lax.dot_general(
+        m_prev = m_ref[:]  # (block_q, _LANES), lanes identical
+        row_max = logits.max(axis=-1, keepdims=True)  # (block_q, 1)
+        m_next = jnp.maximum(m_prev, row_max)  # lanes stay identical
+        m1 = m_next.max(axis=-1, keepdims=True)  # (block_q, 1)
+        p = jnp.exp(logits - m1)
+        alpha = jnp.exp(m_prev - m_next)  # (block_q, _LANES), lanes identical
+        alpha1 = alpha.max(axis=-1, keepdims=True)  # (block_q, 1)
+        m_ref[:] = m_next
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha1 + lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] / l_ref[:][:, None]).astype(o_ref.dtype)
+        l1 = l_ref[:].max(axis=-1, keepdims=True)  # (block_q, 1)
+        o_ref[0] = (acc_ref[:] / l1).astype(o_ref.dtype)
 
 
 def flash_causal_attention(
@@ -137,8 +147,8 @@ def flash_causal_attention(
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,

@@ -13,6 +13,7 @@ reference's uninitialized-process-group behavior.
 from __future__ import annotations
 
 import logging
+import threading
 import weakref
 from typing import Any, List, Optional, Sequence
 
@@ -20,11 +21,16 @@ from .dist_store import Store
 
 logger: logging.Logger = logging.getLogger(__name__)
 
-# Shared op-seq storage for pg objects that reject attribute assignment
-# (__slots__/frozen): falls back to identity-keyed weak references.
-_OP_SEQ_REFS: "weakref.WeakKeyDictionary[Any, List[int]]" = (
+# Shared op-seq storage for store objects that reject attribute assignment
+# (__slots__/frozen): falls back to identity-keyed weak references. Values
+# are per-rank dicts: {rank: [seq]}.
+_OP_SEQ_REFS: "weakref.WeakKeyDictionary[Any, dict]" = (
     weakref.WeakKeyDictionary()
 )
+# Guards the check-then-set on the store's per-rank counter dict: wrappers
+# for different ranks may be constructed concurrently over one store
+# object (thread-based multi-rank harnesses).
+_OP_SEQ_LOCK = threading.Lock()
 
 
 class PGWrapper:
@@ -36,11 +42,12 @@ class PGWrapper:
     """
 
     def __init__(self, pg: Optional[Any] = None) -> None:
-        # The op sequence is SHARED across every wrapper of the same
-        # underlying pg (attached to the pg object itself): keyed store ops
-        # are only cleaned up by the *last* rank to finish one, so a fresh
-        # wrapper restarting at op 1 would overwrite a key a slow peer has
-        # not read yet (e.g. a manager broadcast followed by Snapshot.take,
+        # The op sequence is SHARED across every wrapper over the same
+        # underlying (store, rank) — attached to the store object, keyed by
+        # rank (see _shared_op_seq_ref): keyed store ops are only cleaned
+        # up by the *last* rank to finish one, so a fresh wrapper
+        # restarting at op 1 would overwrite a key a slow peer has not
+        # read yet (e.g. a manager broadcast followed by Snapshot.take,
         # which builds its own wrapper). Call sequences are SPMD-identical
         # across ranks, so the shared counter stays aligned everywhere.
         if pg is None:
@@ -109,30 +116,36 @@ class PGWrapper:
 
 
 def _shared_op_seq_ref(pg: Any) -> List[int]:
-    """One op-seq counter per underlying pg object, surviving wrapper
-    churn. Attribute attachment first; weak-ref registry for frozen/slots
-    pgs; only truly un-referenceable pgs degrade to per-wrapper sequences
-    (loudly — aliasing re-appears then)."""
-    ref = getattr(pg, "_ts_op_seq_ref", None)
-    if ref is not None:
-        return ref
-    ref = [0]
-    try:
-        pg._ts_op_seq_ref = ref
-        return ref
-    except Exception:
-        pass
-    try:
-        existing = _OP_SEQ_REFS.get(pg)
-        if existing is not None:
-            return existing
-        _OP_SEQ_REFS[pg] = ref
-        return ref
-    except TypeError:
-        logger.warning(
-            "Process group %r accepts neither attributes nor weak "
-            "references; store-key sequences degrade to per-wrapper and "
-            "may alias across wrappers",
-            type(pg).__name__,
-        )
-        return ref
+    """One op-seq counter per ``(store, rank)``, surviving wrapper and pg
+    churn. Store-key collisions are scoped to the *store*, not the pg: two
+    ProcessGroup objects wrapping the same store (e.g. two
+    ``jax_process_group()`` calls, one handed to CheckpointManager and one
+    to Snapshot) must share one ``__pg/*`` namespace counter. The rank is
+    part of the key because each rank mirrors the global op sequence
+    through its own call stream (relevant when a test harness runs several
+    ranks as threads over one store object). Attribute attachment first;
+    weak-ref registry for frozen/slots stores; only truly un-referenceable
+    keys degrade to per-wrapper sequences (loudly — aliasing re-appears
+    then)."""
+    key = getattr(pg, "store", None)
+    if key is None:
+        key = pg
+    rank = int(getattr(pg, "rank", 0))
+    with _OP_SEQ_LOCK:
+        refs = getattr(key, "_ts_op_seq_refs", None)
+        if refs is None:
+            refs = {}
+            try:
+                key._ts_op_seq_refs = refs
+            except Exception:
+                try:
+                    refs = _OP_SEQ_REFS.setdefault(key, {})
+                except TypeError:
+                    logger.warning(
+                        "Store %r accepts neither attributes nor weak "
+                        "references; store-key sequences degrade to "
+                        "per-wrapper and may alias across wrappers",
+                        type(key).__name__,
+                    )
+                    return [0]
+        return refs.setdefault(rank, [0])

@@ -91,6 +91,12 @@ def _load_into(dst: Any, src: Any, mutate: bool = True) -> Any:
             k: _load_into(dst[k], src[k], mutate) if k in dst else src[k]
             for k in src
         }
+        if mutate:
+            # A caller holding the original dict must see restored
+            # non-tensor leaves (step counters, lr floats) too — update
+            # the destination in place instead of returning a new dict.
+            dst.update(merged_dict)
+            return dst
         for k in dst:
             if k not in src:
                 merged_dict[k] = dst[k]
@@ -100,6 +106,9 @@ def _load_into(dst: Any, src: Any, mutate: bool = True) -> Any:
         merged += list(src[len(dst):]) if len(src) > len(dst) else list(
             dst[len(src):]
         )
+        if mutate and isinstance(dst, list):
+            dst[: len(merged)] = merged
+            return dst
         return merged if isinstance(dst, list) else tuple(merged)
     return src
 
