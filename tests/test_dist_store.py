@@ -138,3 +138,154 @@ def test_collectives_across_processes(pg) -> None:
     assert gathered == [{"rank": 0}, {"rank": 1}]
     assert wrapper.broadcast_object("x" if pg.rank == 0 else None) == "x"
     wrapper.barrier()
+
+
+def test_world_32_stress_over_tcp() -> None:
+    """Scale check for the coordination layer (VERDICT r1 item 4): 32 ranks
+    — each with its own TCP client connection — run LinearBarrier
+    arrive/depart, a manifest-sized exchange, and a counter barrier, and
+    the whole thing completes in seconds. The leader's waits are single
+    counter-key polls and exchange is a rank-0 aggregate + one fetch per
+    rank, so wall time stays flat-ish in world size."""
+    world = 32
+    server = TCPStore("127.0.0.1", 0, is_server=True)
+    payload = {"manifest": ["0/model/layer/%d" % i for i in range(200)]}
+    results: dict = {}
+    errors: list = []
+
+    def worker(rank: int) -> None:
+        client = (
+            server
+            if rank == 0
+            else TCPStore("127.0.0.1", server.port, is_server=False)
+        )
+        try:
+            pg = PGWrapper(
+                ProcessGroup(store=client, rank=rank, world_size=world)
+            )
+            gathered = pg.all_gather_object({**payload, "rank": rank})
+            assert [g["rank"] for g in gathered] == list(range(world))
+            barrier = LinearBarrier(
+                "stress32", client, rank=rank, world_size=world
+            )
+            barrier.arrive(timeout=60)
+            barrier.depart(timeout=60)
+            pg.barrier()
+            results[rank] = True
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+        finally:
+            if rank != 0:
+                client.close()
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    elapsed = time.monotonic() - t0
+    server.close()
+    assert not errors, errors[:3]
+    assert len(results) == world
+    assert elapsed < 60, f"world-32 coordination took {elapsed:.1f}s"
+
+
+def test_jax_pg_fallback_bootstraps_tcp_store() -> None:
+    """A coordination client without atomic increment must get a TCPStore
+    bootstrapped through set/get (the two primitives every KV has) instead
+    of NotImplementedError surfacing mid-collective."""
+    from torchsnapshot_tpu.dist_store import _bootstrap_tcp_store
+
+    kv = InProcessStore()  # stands in for the coordination KV (set/get only)
+    stores = {}
+
+    def worker(rank: int) -> None:
+        stores[rank] = _bootstrap_tcp_store(kv, rank, timeout=30)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sorted(stores) == [0, 1, 2]
+    try:
+        stores[0].set("k", b"v")
+        assert stores[1].try_get("k") == b"v"
+        assert stores[2].add("c", 5) == 5
+        assert stores[1].add("c", 1) == 6
+    finally:
+        for s in stores.values():
+            s.close()
+
+
+def test_world_32_snapshot_take_restore(tmp_path) -> None:
+    """Full Snapshot.take + restore at world 32 over one TCP store: the
+    manifest gather (rank-0 aggregate exchange), replicated verification,
+    partitioning, commit barrier — every coordination round at a pod-ish
+    world size, in seconds."""
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+
+    world = 32
+    server = TCPStore("127.0.0.1", 0, is_server=True)
+    path = str(tmp_path / "snap")
+    errors: list = []
+
+    def worker(rank: int) -> None:
+        client = (
+            server
+            if rank == 0
+            else TCPStore("127.0.0.1", server.port, is_server=False)
+        )
+        try:
+            pg = ProcessGroup(store=client, rank=rank, world_size=world)
+            state = {"w": np.full((64,), float(rank), np.float32), "r": rank}
+            ts.Snapshot.take(path, {"s": ts.PyTreeState(state)}, pg=pg)
+            dst = {"w": np.zeros((64,), np.float32), "r": -1}
+            wrapped = ts.PyTreeState(dst)
+            ts.Snapshot(path, pg=pg).restore({"s": wrapped})
+            np.testing.assert_array_equal(
+                wrapped.tree["w"], np.full((64,), float(rank), np.float32)
+            )
+            assert wrapped.tree["r"] == rank
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, repr(e)))
+        finally:
+            if rank != 0:
+                client.close()
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    elapsed = time.monotonic() - t0
+    server.close()
+    assert not errors, errors[:3]
+    assert elapsed < 120, f"world-32 take+restore took {elapsed:.1f}s"
+
+
+def test_jax_process_group_is_cached(monkeypatch) -> None:
+    """Repeated jax_process_group() calls must return the same ProcessGroup
+    (same store object): op-seq namespaces stay shared, and the TCPStore
+    fallback never bootstraps a second server under the same address key."""
+    import torchsnapshot_tpu.dist_store as ds
+
+    monkeypatch.setattr(ds, "_JAX_PG", None)
+    sentinel_store = InProcessStore()
+    monkeypatch.setattr(ds, "JaxCoordinationStore", lambda: sentinel_store)
+    monkeypatch.setattr(
+        ds.InProcessStore, "supports_add", lambda self: True, raising=False
+    )
+    pg1 = ds.jax_process_group()
+    pg2 = ds.jax_process_group()
+    assert pg1 is pg2
+    assert pg1.store is sentinel_store
+    monkeypatch.setattr(ds, "_JAX_PG", None)

@@ -282,3 +282,25 @@ def test_unreadable_index_fails_save_instead_of_orphaning(tmp_path) -> None:
     assert mgr.all_steps() == [1, 2]
     dst = _state(0.0)
     assert mgr.restore_latest(dst) == 2
+
+
+def test_torn_first_index_write_self_recovers(tmp_path) -> None:
+    """Corrupt primary + absent backup = the very first index write tore
+    before the backup slot existed; nothing was ever committed to the
+    index, so the manager must self-recover, not brick."""
+    (tmp_path / INDEX_BLOB).write_text("{torn")
+    mgr = ts.CheckpointManager(str(tmp_path))
+    assert mgr.all_steps() == []
+    mgr.save(1, _state(1.0))
+    assert mgr.all_steps() == [1]
+
+
+def test_both_index_slots_corrupt_raises(tmp_path) -> None:
+    from torchsnapshot_tpu.manager import INDEX_BACKUP_BLOB
+
+    mgr = ts.CheckpointManager(str(tmp_path))
+    mgr.save(1, _state(1.0))
+    (tmp_path / INDEX_BLOB).write_text("{torn")
+    (tmp_path / INDEX_BACKUP_BLOB).write_text("{torn")
+    with pytest.raises(RuntimeError, match="index unreadable"):
+        mgr.all_steps()

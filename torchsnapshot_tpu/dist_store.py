@@ -118,13 +118,32 @@ class Store(abc.ABC):
         obj: Any,
         timeout: float = _DEFAULT_TIMEOUT_S,
     ) -> List[Any]:
-        """All-gather of picklable objects."""
+        """All-gather of picklable objects.
+
+        Rank 0 aggregates the per-rank blobs into ONE combined value that
+        everyone else fetches with a single get: O(1) store round-trips
+        per non-leader rank instead of O(world), so a v4-32-pod manifest
+        gather doesn't issue world² sequential requests through the
+        leader's socket (the bytes are inherently O(world²) for an
+        all-gather; the round-trips need not be).
+        """
         self.set(f"{prefix}/{rank}", pickle.dumps(obj))
-        out = [
-            pickle.loads(self.get(f"{prefix}/{i}", timeout))
-            for i in range(world_size)
-        ]
-        self._cleanup(prefix, world_size, [f"{prefix}/{i}" for i in range(world_size)])
+        if rank == 0:
+            blobs = [
+                self.get(f"{prefix}/{i}", timeout) for i in range(world_size)
+            ]
+            out = [pickle.loads(b) for b in blobs]
+            self.set(f"{prefix}/__all", pickle.dumps(blobs))
+        else:
+            out = [
+                pickle.loads(b)
+                for b in pickle.loads(self.get(f"{prefix}/__all", timeout))
+            ]
+        self._cleanup(
+            prefix,
+            world_size,
+            [f"{prefix}/{i}" for i in range(world_size)] + [f"{prefix}/__all"],
+        )
         return out
 
     def broadcast(
@@ -363,6 +382,15 @@ class JaxCoordinationStore(Store):
         except Exception:
             return None
 
+    def supports_add(self) -> bool:
+        """Whether this jaxlib's coordination client has atomic increment.
+        ``add`` is load-bearing for every collective's cleanup and for
+        ``Store.barrier``, so a runtime without it must be detected at
+        :func:`jax_process_group` time (which then bootstraps a TCPStore
+        through the KV service — set/get are always available), not
+        mid-collective."""
+        return getattr(self._client, "key_value_increment", None) is not None
+
     def add(self, key: str, amount: int) -> int:
         inc = getattr(self._client, "key_value_increment", None)
         if inc is not None:
@@ -391,14 +419,81 @@ def jax_process_group():
 
     (Reference analog: get_or_create_store reusing the c10d default
     TCPStore, dist_store.py:22-88.)
-    """
-    import jax
 
-    return ProcessGroup(
-        store=JaxCoordinationStore(),
-        rank=jax.process_index(),
-        world_size=jax.process_count(),
-    )
+    On a jaxlib whose coordination client lacks atomic increment, a
+    TCPStore is bootstrapped through the KV service transparently (rank 0
+    hosts, publishes its address via set; everyone else gets it) — the
+    failure mode otherwise would be a ``NotImplementedError`` surfacing
+    mid-collective, far from its cause.
+
+    The result is cached per process: repeated calls return the SAME
+    ProcessGroup (hence the same store object). This keeps the ``__pg/*``
+    op-seq namespace shared across call sites, and — on the TCPStore
+    fallback path — prevents a second call from bootstrapping a second
+    server under the same address key and splitting ranks between the two.
+    """
+    global _JAX_PG
+    with _JAX_PG_LOCK:
+        if _JAX_PG is not None:
+            return _JAX_PG
+        import jax
+
+        rank = jax.process_index()
+        kv = JaxCoordinationStore()
+        store: Store = kv
+        if not kv.supports_add():
+            store = _bootstrap_tcp_store(kv, rank)
+        _JAX_PG = ProcessGroup(
+            store=store,
+            rank=rank,
+            world_size=jax.process_count(),
+        )
+        return _JAX_PG
+
+
+_JAX_PG: Optional[ProcessGroup] = None
+_JAX_PG_LOCK = threading.Lock()
+
+
+def _routable_host() -> str:
+    """An address peers on other hosts can dial for this machine. The jax
+    coordinator address is best (rank 0 of jax.distributed hosts the
+    coordinator, and every process demonstrably reached it); else the
+    outbound-interface IP (UDP connect sends no traffic); hostname last."""
+    try:
+        from jax._src import distributed
+
+        addr = getattr(distributed.global_state, "coordinator_address", None)
+        if addr:
+            return addr.rsplit(":", 1)[0]
+    except Exception:
+        pass
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect(("8.8.8.8", 80))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except Exception:
+        return socket.gethostname()
+
+
+def _bootstrap_tcp_store(
+    kv: Store, rank: int, timeout: float = _DEFAULT_TIMEOUT_S
+) -> "TCPStore":
+    """Bootstrap a TCPStore using only ``set``/``get`` of ``kv`` (the two
+    primitives every coordination KV has): rank 0 binds a free port and
+    publishes ``host:port``; the rest fetch and connect."""
+    addr_key = "__ts/tcp_store_addr"
+    if rank == 0:
+        host = _routable_host()
+        tcp = TCPStore(host="0.0.0.0", port=0, is_server=True)
+        tcp.host = host  # clients (and rank 0's own socket) dial this addr
+        kv.set(addr_key, f"{host}:{tcp.port}".encode())
+        return tcp
+    host, port = kv.get(addr_key, timeout).decode().rsplit(":", 1)
+    return TCPStore(host=host, port=int(port), is_server=False)
 
 
 # ---------------------------------------------------------------------------
@@ -451,14 +546,36 @@ class LinearBarrier:
                 )
             time.sleep(_POLL_INTERVAL_S)
 
+    def _wait_count(self, key: str, target: int, timeout: float) -> None:
+        """Poll ONE counter key until it reaches ``target``: the leader's
+        wait is O(1) store requests per poll regardless of world size
+        (a per-rank-key scan would be world−1 sequential requests per
+        5 ms tick — minutes of pure polling on a large pod)."""
+        if target <= 0:
+            self._check_error()
+            return
+        deadline = time.monotonic() + timeout
+        while True:
+            self._check_error()
+            val = self.store.try_get(key)
+            if val is not None and int(val) >= target:
+                return
+            if time.monotonic() > deadline:
+                raise StoreTimeoutError(
+                    f"Rank {self.rank} timed out in barrier {self.prefix!r} "
+                    f"waiting for {key!r} to reach {target}"
+                )
+            time.sleep(_POLL_INTERVAL_S)
+
     def _phase(self, phase: str, timeout: float) -> None:
         if self.rank == 0:
-            for i in range(1, self.world_size):
-                self._wait_for(self._key(f"{phase}/{i}"), timeout)
+            self._wait_count(
+                self._key(f"{phase}/count"), self.world_size - 1, timeout
+            )
             self.store.set(self._key(f"{phase}/go"), b"1")
         else:
             self._check_error()
-            self.store.set(self._key(f"{phase}/{self.rank}"), b"1")
+            self.store.add(self._key(f"{phase}/count"), 1)
             self._wait_for(self._key(f"{phase}/go"), timeout)
 
     def arrive(self, timeout: float = _DEFAULT_TIMEOUT_S) -> None:
@@ -477,13 +594,13 @@ class LinearBarrier:
         that they are past the depart release before the leader deletes."""
         try:
             if self.rank != 0:
-                self.store.set(self._key(f"done/{self.rank}"), b"1")
+                self.store.add(self._key("done/count"), 1)
                 return
-            for i in range(1, self.world_size):
-                self._wait_for(self._key(f"done/{i}"), timeout)
+            self._wait_count(
+                self._key("done/count"), self.world_size - 1, timeout
+            )
             for phase in ("arrive", "depart", "done"):
-                for i in range(1, self.world_size):
-                    self.store.delete(self._key(f"{phase}/{i}"))
+                self.store.delete(self._key(f"{phase}/count"))
                 self.store.delete(self._key(f"{phase}/go"))
             self.store.delete(self._key("error"))
         except Exception:  # pragma: no cover - cleanup must never fail a commit

@@ -212,18 +212,22 @@ class CheckpointManager:
         """Primary slot, falling back to the backup slot: the index is
         rewritten on every save, so a crash mid-write must not brick the
         manager (the backup holds at worst the previous step list)."""
-        unreadable: List[str] = []
+        io_failed: List[str] = []
+        corrupt: List[str] = []
+        absent: List[str] = []
         for slot in (INDEX_BLOB, INDEX_BACKUP_BLOB):
             read_io = ReadIO(path=slot)
             try:
                 await storage.read(read_io)
             except FileNotFoundError:
+                absent.append(slot)
                 continue
             except Exception as e:  # noqa: BLE001
                 logger.warning("Could not read index slot %s: %r", slot, e)
-                unreadable.append(slot)
+                io_failed.append(slot)
                 continue
             if read_io.buf is None:
+                absent.append(slot)
                 continue
             try:
                 return sorted(
@@ -236,14 +240,19 @@ class CheckpointManager:
                     e,
                     INDEX_BACKUP_BLOB,
                 )
-                unreadable.append(slot)
-        if unreadable:
-            # "Slots absent" (fresh directory) yields []; "slots unreadable"
-            # must NOT — a subsequent index rewrite would silently orphan
-            # every previously committed step.  Fail the operation loudly
-            # instead; a transient storage error heals on retry.
+                corrupt.append(slot)
+        # "Slots absent" (fresh directory) yields []. One corrupt slot with
+        # the OTHER slot absent is the same thing: the very first index
+        # write tore before the backup existed, so no step list was ever
+        # committed — self-recover.  Everything else ("slots unreadable":
+        # transient I/O errors, or BOTH slots corrupt) must NOT be treated
+        # as empty — a subsequent index rewrite would silently orphan every
+        # previously committed step.  Fail the operation loudly instead; a
+        # transient storage error heals on retry.
+        if io_failed or len(corrupt) > 1:
             raise RuntimeError(
-                f"checkpoint index unreadable (slots {unreadable!r}); "
+                "checkpoint index unreadable "
+                f"(io_failed={io_failed!r}, corrupt={corrupt!r}); "
                 "refusing to treat the step list as empty"
             )
         return []
