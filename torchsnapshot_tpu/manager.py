@@ -210,8 +210,9 @@ class CheckpointManager:
 
     async def _read_index_async(self, storage: StoragePlugin) -> List[int]:
         """Primary slot, falling back to the backup slot: the index is
-        rewritten on every save, so a crash mid-write must not brick the
-        manager (the backup holds at worst the previous step list)."""
+        rewritten on every save (backup slot first), so a crash mid-write
+        must not brick the manager — whichever slot survives is valid,
+        at worst one save stale."""
         io_failed: List[str] = []
         corrupt: List[str] = []
         absent: List[str] = []
@@ -242,13 +243,14 @@ class CheckpointManager:
                 )
                 corrupt.append(slot)
         # "Slots absent" (fresh directory) yields []. One corrupt slot with
-        # the OTHER slot absent is the same thing: the very first index
-        # write tore before the backup existed, so no step list was ever
-        # committed — self-recover.  Everything else ("slots unreadable":
-        # transient I/O errors, or BOTH slots corrupt) must NOT be treated
-        # as empty — a subsequent index rewrite would silently orphan every
-        # previously committed step.  Fail the operation loudly instead; a
-        # transient storage error heals on retry.
+        # the OTHER slot absent is the same thing: writes go backup-then-
+        # primary (_write_index_async), so that state can only be a torn
+        # FIRST-ever index write — no step list was ever readable; self-
+        # recover.  Everything else ("slots unreadable": transient I/O
+        # errors, or BOTH slots corrupt) must NOT be treated as empty — a
+        # subsequent index rewrite would silently orphan every previously
+        # committed step.  Fail the operation loudly instead; a transient
+        # storage error heals on retry.
         if io_failed or len(corrupt) > 1:
             raise RuntimeError(
                 "checkpoint index unreadable "
@@ -261,10 +263,15 @@ class CheckpointManager:
         self, steps: List[int], storage: StoragePlugin
     ) -> None:
         payload = json.dumps({"steps": steps}).encode()
-        # Primary first, backup second: a crash between the writes leaves a
-        # valid (possibly one-save-stale) slot either way.
-        await storage.write(WriteIO(path=INDEX_BLOB, buf=payload))
+        # Backup FIRST, primary second. With this order a torn *primary*
+        # write always leaves a valid new backup behind it, and a torn
+        # backup write leaves the previous (valid, one-save-stale) primary
+        # — consistent with the caller's view, since the save never
+        # returned. It also makes "corrupt primary + absent backup"
+        # impossible except for a torn first-ever index write, which is
+        # what _read_index_async's recovery rule assumes.
         await storage.write(WriteIO(path=INDEX_BACKUP_BLOB, buf=payload))
+        await storage.write(WriteIO(path=INDEX_BLOB, buf=payload))
 
     def _read_index(self) -> List[int]:
         return self._with_root_storage(self._read_index_async)

@@ -31,6 +31,7 @@ Pipeline parallelism is intentionally not modeled via GSPMD annotations
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -41,7 +42,14 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import causal_attention
+from ..ops.flash_attention import flash_causal_attention
 from ..ops.ring_attention import ring_causal_attention
+
+
+def _pallas_interpret() -> bool:
+    """Pallas kernels compile natively only on TPU; everywhere else (CPU
+    meshes in tests, the virtual-device dryrun) they run interpreted."""
+    return jax.devices()[0].platform != "tpu"
 
 Params = Dict[str, Any]
 
@@ -58,9 +66,14 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     learning_rate: float = 1e-3
     # "ulysses": heads-sharded attention, sp↔tp all-to-alls at the block
-    # boundary (short/medium context). "ring": sequence stays sharded and
+    # boundary (short/medium context). "flash": same layout, but the dense
+    # einsum is replaced by the Pallas flash kernel
+    # (ops/flash_attention.py — O(block·d) VMEM instead of s² HBM logits;
+    # requires seq % 128 == 0 on TPU). "ring": sequence stays sharded and
     # KV blocks rotate the sp ring (ops/ring_attention.py — long context,
-    # O(seq_local^2) memory per device).
+    # O(seq_local^2) memory per device). "ring_flash": ring whose
+    # per-step blockwise attention runs in the flash kernel (long context
+    # without the O(seq_local^2) HBM intermediate either).
     attn_impl: str = "ulysses"
 
     @property
@@ -213,6 +226,32 @@ def _moe_mlp(block: Params, x: jax.Array) -> jax.Array:
     return jnp.einsum("ebsd,bse->bsd", y, gates)
 
 
+def _flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh],
+    interpret: bool,
+) -> jax.Array:
+    """Flash attention under GSPMD: a ``pallas_call`` is a custom call XLA
+    cannot partition, so on a mesh it must be wrapped in ``shard_map`` over
+    the batch/head axes (sequence replicated — the Ulysses layout) to run
+    per-device; single-device calls go straight through."""
+    if mesh is None:
+        return flash_causal_attention(q, k, v, interpret=interpret)
+    has_dp = "dp" in mesh.axis_names
+    has_tp = "tp" in mesh.axis_names
+    spec = P("dp" if has_dp else None, None, "tp" if has_tp else None, None)
+    fn = jax.shard_map(
+        functools.partial(flash_causal_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def forward(
     cfg: TransformerConfig,
     params: Params,
@@ -232,17 +271,38 @@ def forward(
         h = _rmsnorm(x, block["ln1_scale"])
         qkv = jnp.einsum("bsd,dz->bsz", h, block["wqkv"])
         qkv = qkv.reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
-        if cfg.attn_impl == "ring" and mesh is not None:
+        if cfg.attn_impl in ("ring", "ring_flash") and mesh is not None:
             # Sequence stays sp-sharded; KV blocks rotate the ring.
             qkv = _constrain(qkv, mesh, "dp", "sp", None, "tp", None)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            attn = ring_causal_attention(q, k, v, mesh=mesh)
+            attn = ring_causal_attention(
+                q,
+                k,
+                v,
+                mesh=mesh,
+                use_flash=(cfg.attn_impl == "ring_flash"),
+                interpret=_pallas_interpret(),
+            )
         else:
             # Ulysses: resharding to heads-over-tp makes XLA insert the
-            # sp↔tp all-to-alls around the dense attention op.
+            # sp↔tp all-to-alls around the attention op.
             qkv = _constrain(qkv, mesh, "dp", None, None, "tp", None)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            attn = causal_attention(q, k, v)
+            if cfg.attn_impl == "flash":
+                if s % 128:
+                    # Never degrade silently: the user chose flash to avoid
+                    # the s² logits tensor; a quiet dense fallback would
+                    # reintroduce exactly that (OOM at long seq).
+                    raise ValueError(
+                        f"attn_impl='flash' requires seq % 128 == 0, got "
+                        f"seq={s}; pad the sequence or use attn_impl="
+                        f"'ulysses'"
+                    )
+                attn = _flash_attention_sharded(
+                    q, k, v, mesh, interpret=_pallas_interpret()
+                )
+            else:
+                attn = causal_attention(q, k, v)
         attn = attn.reshape(b, s, d)
         x = x + _constrain(
             jnp.einsum("bsz,zd->bsd", attn, block["wo"]), mesh, "dp", "sp", None

@@ -47,6 +47,61 @@ _NEG_BIG = -1e30
 _LANES = 128
 
 
+def _init_stats(m_ref, l_ref, acc_ref, block_q: int, d: int) -> None:
+    m_ref[:] = jnp.full((block_q, _LANES), _NEG_BIG, jnp.float32)
+    l_ref[:] = jnp.zeros((block_q, _LANES), jnp.float32)
+    acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+
+
+def _online_softmax_update(
+    q_ref,
+    k_ref,
+    v_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    qi,
+    ki,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+) -> None:
+    """The ONE shared online-softmax tile update both kernels run: logits
+    for this K/V tile, (masked) running max/normalizer rescale, MXU
+    accumulate. Any numerics change here reaches the standalone causal
+    kernel and the ring-merge chunk kernel alike."""
+    d = q_ref.shape[-1]
+    scale = 1.0 / (d**0.5)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    kb = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    vb = v_ref[0].astype(jnp.float32)
+    logits = lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        logits = jnp.where(q_pos >= k_pos, logits, _NEG_BIG)
+
+    m_prev = m_ref[:]  # (block_q, _LANES), lanes identical
+    row_max = logits.max(axis=-1, keepdims=True)  # (block_q, 1)
+    m_next = jnp.maximum(m_prev, row_max)  # lanes stay identical
+    m1 = m_next.max(axis=-1, keepdims=True)  # (block_q, 1)
+    p = jnp.exp(logits - m1)
+    alpha = jnp.exp(m_prev - m_next)  # (block_q, _LANES), lanes identical
+    alpha1 = alpha.max(axis=-1, keepdims=True)  # (block_q, 1)
+    m_ref[:] = m_next
+    l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha1 + lax.dot_general(
+        p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
@@ -66,45 +121,233 @@ def _flash_kernel(
 
     @pl.when(ki == 0)
     def _init():
-        m_ref[:] = jnp.full((block_q, _LANES), _NEG_BIG, jnp.float32)
-        l_ref[:] = jnp.zeros((block_q, _LANES), jnp.float32)
-        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+        _init_stats(m_ref, l_ref, acc_ref, block_q, d)
 
     # Tiles fully beyond the causal frontier contribute nothing.
     @pl.when(ki * block_k <= qi * block_q + block_q - 1)
     def _update():
-        scale = 1.0 / (d**0.5)
-        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-        kb = k_ref[0].astype(jnp.float32)  # (block_k, d)
-        vb = v_ref[0].astype(jnp.float32)
-        logits = lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        q_pos = qi * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ki * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        logits = jnp.where(q_pos >= k_pos, logits, _NEG_BIG)
-
-        m_prev = m_ref[:]  # (block_q, _LANES), lanes identical
-        row_max = logits.max(axis=-1, keepdims=True)  # (block_q, 1)
-        m_next = jnp.maximum(m_prev, row_max)  # lanes stay identical
-        m1 = m_next.max(axis=-1, keepdims=True)  # (block_q, 1)
-        p = jnp.exp(logits - m1)
-        alpha = jnp.exp(m_prev - m_next)  # (block_q, _LANES), lanes identical
-        alpha1 = alpha.max(axis=-1, keepdims=True)  # (block_q, 1)
-        m_ref[:] = m_next
-        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha1 + lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        _online_softmax_update(
+            q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            qi=qi, ki=ki, block_q=block_q, block_k=block_k, causal=True,
         )
 
     @pl.when(ki == n_k - 1)
     def _finalize():
         l1 = l_ref[:].max(axis=-1, keepdims=True)  # (block_q, 1)
         o_ref[0] = (acc_ref[:] / l1).astype(o_ref.dtype)
+
+
+def _flash_chunk_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_out_ref,
+    l_out_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+    causal: bool,
+):
+    """Blockwise attention over one local K/V chunk, emitting the
+    UNNORMALIZED accumulator plus the (max, normalizer) stats, so an outer
+    loop (the sp ring in ops/ring_attention.py) can merge chunks with the
+    online-softmax recurrence instead of materializing s_local² logits."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    d = q_ref.shape[-1]
+
+    @pl.when(ki == 0)
+    def _init():
+        _init_stats(m_ref, l_ref, acc_ref, block_q, d)
+
+    def _update():
+        _online_softmax_update(
+            q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            qi=qi, ki=ki, block_q=block_q, block_k=block_k, causal=causal,
+        )
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_update)
+    else:
+        _update()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[:]
+        m_out_ref[0] = m_ref[:].max(axis=-1, keepdims=True)
+        l_out_ref[0] = l_ref[:].max(axis=-1, keepdims=True)
+
+
+def flash_attention_chunk(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Streamed blockwise attention of ``q`` against one K/V chunk.
+
+    Args:
+        q: ``(batch, s_q, n_heads, head_dim)``.
+        k, v: ``(batch, s_k, n_heads, head_dim)``.
+        causal: apply the *local* causal mask (chunk diagonal); ``False``
+            means every position of the chunk is visible (a ring step whose
+            K/V block lies entirely in the past).
+
+    Returns:
+        ``(o, m, l)``: unnormalized f32 accumulator ``(batch, n_heads,
+        s_q, head_dim)`` and the per-row running max / normalizer
+        ``(batch, n_heads, s_q)``. Normalize with ``o / l[..., None]`` or
+        merge with another chunk via the online-softmax recurrence.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {sk}) must divide by blocks "
+            f"({block_q}, {block_k})"
+        )
+    n_k = sk // block_k
+    to_rows = lambda x, s: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qf, kf, vf = to_rows(q, sq), to_rows(k, sk), to_rows(v, sk)
+
+    o, m, l = pl.pallas_call(
+        functools.partial(
+            _flash_chunk_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            n_k=n_k,
+            causal=causal,
+        ),
+        grid=(b * h, sq // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    o = o.reshape(b, h, sq, d)
+    m = m.reshape(b, h, sq)
+    l = l.reshape(b, h, sq)
+    return o, m, l
+
+
+def _flash_bwd_blockwise(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    m: jax.Array,
+    l: jax.Array,
+    o: jax.Array,
+    do: jax.Array,
+    block_k: int,
+):
+    """Flash attention backward in pure lax, blockwise over key tiles:
+    recomputes each tile's probabilities from the saved (max, normalizer)
+    stats instead of keeping s² anything — O(s·block_k) temporaries, so
+    training at the sequence lengths where the dense backward would OOM
+    stays feasible. Standard recurrence: with P = softmax tile and
+    D = rowsum(dO ∘ O), dS = P ∘ (dO Vᵀ − D), dQ = scale·dS K,
+    dK = scale·dSᵀ Q, dV = Pᵀ dO.
+
+    Shapes: q/k/v/o/do ``(b, h, s, d)`` f32, m/l ``(b, h, s)``.
+    """
+    b, h, s, d = q.shape
+    scale = 1.0 / (d**0.5)
+    n_k = s // block_k
+    pos = jnp.arange(s)
+    D = jnp.sum(do * o, axis=-1)  # (b, h, s)
+
+    def kblock(carry, j):
+        dq = carry
+        kj = lax.dynamic_slice_in_dim(k, j * block_k, block_k, axis=2)
+        vj = lax.dynamic_slice_in_dim(v, j * block_k, block_k, axis=2)
+        k_pos = j * block_k + jnp.arange(block_k)
+        sj = scale * jnp.einsum(
+            "bhqd,bhkd->bhqk", q, kj, preferred_element_type=jnp.float32
+        )
+        mask = pos[:, None] >= k_pos[None, :]
+        p = jnp.where(mask, jnp.exp(sj - m[..., None]) / l[..., None], 0.0)
+        dp = jnp.einsum(
+            "bhqd,bhkd->bhqk", do, vj, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - D[..., None])
+        dq = dq + scale * jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, kj, preferred_element_type=jnp.float32
+        )
+        dkj = scale * jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, q, preferred_element_type=jnp.float32
+        )
+        dvj = jnp.einsum(
+            "bhqk,bhqd->bhkd", p, do, preferred_element_type=jnp.float32
+        )
+        return dq, (dkj, dvj)
+
+    dq, (dks, dvs) = lax.scan(kblock, jnp.zeros_like(q), jnp.arange(n_k))
+    # (n_k, b, h, block_k, d) → (b, h, s, d)
+    dk = dks.transpose(1, 2, 0, 3, 4).reshape(b, h, s, d)
+    dv = dvs.transpose(1, 2, 0, 3, 4).reshape(b, h, s, d)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_causal_vjp_fn(block_q: int, block_k: int, interpret: bool):
+    """A custom_vjp-wrapped flash attention for one static block config
+    (cached so jit sees a stable function identity). Primal: the fused
+    normalize-in-VMEM kernel. Under differentiation: the chunk kernel
+    (which also emits the (max, normalizer) stats) + the blockwise lax
+    backward above."""
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _flash_causal_forward(q, k, v, block_q, block_k, interpret)
+
+    def fwd(q, k, v):
+        o_u, m, l = flash_attention_chunk(
+            q, k, v, causal=True, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+        o = o_u / l[..., None]  # (b, h, s, d) f32, normalized
+        out = o.transpose(0, 2, 1, 3).astype(q.dtype)
+        return out, (q, k, v, m, l, o)
+
+    def bwd(res, g):
+        q, k, v, m, l, o = res
+        to_h = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
+        dq, dk, dv = _flash_bwd_blockwise(
+            to_h(q), to_h(k), to_h(v), m, l, o, to_h(g),
+            block_k=min(block_k, q.shape[1]),
+        )
+        back = lambda x, like: x.transpose(0, 2, 1, 3).astype(like.dtype)
+        return back(dq, q), back(dk, k), back(dv, v)
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 def flash_causal_attention(
@@ -118,11 +361,26 @@ def flash_causal_attention(
     """Drop-in for :func:`~torchsnapshot_tpu.ops.causal_attention` on
     shapes where ``seq`` divides by the block sizes.
 
+    Differentiable: reverse-mode goes through a blockwise backward that
+    recomputes probability tiles from saved (max, normalizer) stats —
+    no s² residuals (see :func:`_flash_bwd_blockwise`).
+
     Args:
         q, k, v: ``(batch, seq, n_heads, head_dim)``.
         block_q, block_k: VMEM tile sizes (128 aligns with the MXU).
         interpret: run in the Pallas interpreter (CPU-safe; tests).
     """
+    return _flash_causal_vjp_fn(block_q, block_k, interpret)(q, k, v)
+
+
+def _flash_causal_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
     b, s, h, d = q.shape
     if s % block_q or s % block_k:
         raise ValueError(

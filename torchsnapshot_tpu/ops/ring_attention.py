@@ -44,12 +44,23 @@ def _ring_attention_local(
     *,
     axis_name: str,
     axis_size: int,
+    use_flash: bool = False,
+    interpret: bool = False,
 ) -> jax.Array:
     """Per-device program: local blocks ``(b, s_local, h, d)``.
 
     Device ``r`` holds query block ``r``; at ring step ``t`` it holds the
     KV block originally owned by device ``(r - t) mod n`` and merges that
     block's contribution into the (max, sum, acc) online-softmax carry.
+
+    With ``use_flash`` each step's blockwise attention runs in the Pallas
+    kernel (ops/flash_attention.py ``flash_attention_chunk``) instead of
+    einsums that materialize ``(b, h, s_local, s_local)`` logits in HBM:
+    per-step memory drops to O(block·d) VMEM, which is what makes
+    s_local in the tens of thousands (multi-million-token global context)
+    fit. The step's mask mode depends on where the wandering KV block sits
+    relative to the resident queries: fully behind → no mask, the diagonal
+    step → local causal mask, fully ahead → skipped.
     """
     r = jax.lax.axis_index(axis_name)
     b, s, h, d = q.shape
@@ -59,9 +70,7 @@ def _ring_attention_local(
     local_pos = jnp.arange(s)
     q_pos = r * s + local_pos  # global positions of resident queries
 
-    def ring_step(carry, t):
-        o, m, l, k_t, v_t = carry
-        src = (r - t) % axis_size
+    def _contrib_einsum(k_t, v_t, src):
         k_pos = src * s + local_pos
         # (b, h, s_q, s_k) logits on the MXU, f32 accumulation.
         logits = jnp.einsum(
@@ -72,18 +81,54 @@ def _ring_attention_local(
         )
         mask = q_pos[:, None] >= k_pos[None, :]
         logits = jnp.where(mask, logits, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
+        m_c = jnp.max(logits, axis=-1)
+        p = jnp.exp(logits - m_c[..., None])
         # A fully-masked block contributes p == exp(_NEG_INF - m) == 0.
         p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
+        l_c = jnp.sum(p, axis=-1)
+        o_c = jnp.einsum(
             "bhqk,bkhd->bhqd",
             p,
             v_t.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
+        return o_c, m_c, l_c
+
+    def _contrib_flash(k_t, v_t, src):
+        from .flash_attention import flash_attention_chunk
+
+        def _full(_):
+            return flash_attention_chunk(
+                q, k_t, v_t, causal=False, interpret=interpret
+            )
+
+        def _diag(_):
+            return flash_attention_chunk(
+                q, k_t, v_t, causal=True, interpret=interpret
+            )
+
+        def _skip(_):
+            return (
+                jnp.zeros((b, h, s, d), jnp.float32),
+                jnp.full((b, h, s), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h, s), jnp.float32),
+            )
+
+        branch = jnp.where(src < r, 0, jnp.where(src == r, 1, 2))
+        return jax.lax.switch(branch, [_full, _diag, _skip], None)
+
+    def ring_step(carry, t):
+        o, m, l, k_t, v_t = carry
+        src = (r - t) % axis_size
+        contrib = _contrib_flash if use_flash else _contrib_einsum
+        o_c, m_c, l_c = contrib(k_t, v_t, src)
+        # Merge the chunk's (unnormalized acc, max, normalizer) into the
+        # carry with the two-way online-softmax recurrence.
+        m_new = jnp.maximum(m, m_c)
+        corr = jnp.exp(m - m_new)
+        corr_c = jnp.exp(m_c - m_new)
+        l_new = l * corr + l_c * corr_c
+        o_new = o * corr[..., None] + o_c * corr_c[..., None]
         # Rotate KV around the ring: i → i+1, so next step holds src-1's
         # block. XLA overlaps this ppermute with the next step's einsums.
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -101,13 +146,17 @@ def _ring_attention_local(
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis_name", "use_flash", "interpret")
+)
 def ring_causal_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     mesh: Optional[Mesh] = None,
     axis_name: str = "sp",
+    use_flash: bool = False,
+    interpret: bool = False,
 ) -> jax.Array:
     """Exact causal attention with sequence sharded over ``axis_name``.
 
@@ -117,6 +166,10 @@ def ring_causal_attention(
         mesh: mesh containing ``axis_name`` (and optionally ``dp``/``tp``
             for batch/head parallelism — those partitions need no
             collectives here). ``None`` falls back to the dense op.
+        use_flash: run each ring step's blockwise attention in the Pallas
+            flash kernel instead of HBM-materializing einsums (long local
+            sequences). ``interpret`` runs that kernel in the Pallas
+            interpreter (CPU tests).
 
     Returns:
         ``(batch, seq, n_heads, head_dim)``, numerically equal (up to f32
@@ -128,16 +181,43 @@ def ring_causal_attention(
     has_dp = "dp" in mesh.axis_names
     has_tp = "tp" in mesh.axis_names
     spec = P("dp" if has_dp else None, axis_name, "tp" if has_tp else None, None)
-    fn = jax.shard_map(
-        functools.partial(
-            _ring_attention_local, axis_name=axis_name, axis_size=axis_size
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    return fn(q, k, v)
+
+    def mapped(flash: bool):
+        return jax.shard_map(
+            functools.partial(
+                _ring_attention_local,
+                axis_name=axis_name,
+                axis_size=axis_size,
+                use_flash=flash,
+                interpret=interpret,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+    if not use_flash:
+        return mapped(False)(q, k, v)
+
+    # The Pallas chunk kernel has no autodiff rule; the einsum ring
+    # computes the same function, so its vjp IS this function's vjp.
+    # Forward runs the kernel (no s_local² HBM intermediate); backward
+    # rematerializes through the einsum ring — the same backward cost the
+    # non-flash ring path pays.
+    @jax.custom_vjp
+    def rca(q, k, v):
+        return mapped(True)(q, k, v)
+
+    def fwd(q, k, v):
+        return mapped(True)(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        _, vjp = jax.vjp(mapped(False), *res)
+        return vjp(g)
+
+    rca.defvjp(fwd, bwd)
+    return rca(q, k, v)
 
 
 def ring_attention_block_specs(
