@@ -81,17 +81,21 @@ def main():
         )
         t_flash = timeit(flash, q, k, v, iters=args.iters)
         try:
+            dense_out = np.asarray(dense(q, k, v), np.float32)
+        except Exception:
+            # The s^2 logits tensor no longer fits in HBM — the reason the
+            # flash kernel exists. Flash keeps going. (Only the dense
+            # computation is guarded: a flash-vs-dense MISMATCH must
+            # propagate, never masquerade as a capacity limit.)
+            dense_ms, speedup = f"{'OOM':>9}", f"{'—':>8}"
+        else:
             np.testing.assert_allclose(
                 np.asarray(flash(q, k, v), np.float32),
-                np.asarray(dense(q, k, v), np.float32),
+                dense_out,
                 atol=0.06, rtol=0.06,
             )
             t_dense = timeit(dense, q, k, v, iters=args.iters)
             dense_ms, speedup = f"{t_dense*1e3:9.2f}", f"{t_dense/t_flash:8.2f}"
-        except Exception:
-            # The s^2 logits tensor no longer fits in HBM — the reason the
-            # flash kernel exists. Flash keeps going.
-            dense_ms, speedup = f"{'OOM':>9}", f"{'—':>8}"
         # causal attention FLOPs: 2 matmuls * 2*b*h*s^2*d, halved by causality
         flops = 2 * 2 * b * h * s * s * d / 2
         print(

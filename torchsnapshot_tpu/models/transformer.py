@@ -264,6 +264,13 @@ def forward(
     they are heads-sharded (tp). With ``mesh=None`` the same trace runs
     single-device (the graft ``entry()`` path).
     """
+    if cfg.attn_impl not in ("ulysses", "flash", "ring", "ring_flash"):
+        # A typo must not silently run the dense path the user was
+        # explicitly opting out of.
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r}; expected one of "
+            f"'ulysses', 'flash', 'ring', 'ring_flash'"
+        )
     x = jnp.take(params["embed"], tokens, axis=0)
     x = _constrain(x, mesh, "dp", "sp", None)
     b, s, d = x.shape

@@ -1,0 +1,6 @@
+from .overlap import Box  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_stage_shardings,
+    pipelined_apply,
+    stack_stage_params,
+)
