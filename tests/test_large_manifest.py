@@ -1,0 +1,85 @@
+"""Large-manifest scale: the torchrec regime that motivated the
+reference's JSON-for-huge-manifests escape hatch (reference
+manifest.py:19-22). A 1e5-leaf app state must plan, commit, and restore
+in seconds with bounded metadata, not minutes of per-leaf overhead.
+
+Measured on this repo's CI-class CPU (1 core, 2026-07-30), batching on:
+take ~5 s, restore ~4 s, metadata ~23 MB committed as JSON. The three
+scale enablers, each load-bearing: slab batching (1e5 files -> 3),
+inline staging/consuming of sub-1MiB buffers (no executor round-trip per
+tiny leaf), and shallow manifest encoding (no dataclasses.asdict deep
+recursion)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.knobs import enable_batching
+
+N_LEAVES = 100_000
+
+
+@pytest.fixture(scope="module")
+def big_tree():
+    return {
+        f"table_{i // 1000}/row_{i % 1000}": np.full((4,), i % 97, np.float32)
+        for i in range(N_LEAVES)
+    }
+
+
+@pytest.mark.slow
+def test_1e5_leaf_take_restore(tmp_path, big_tree) -> None:
+    path = str(tmp_path / "snap")
+    with enable_batching():
+        t0 = time.perf_counter()
+        ts.Snapshot.take(path, {"emb": ts.PyTreeState(big_tree)})
+        t_take = time.perf_counter() - t0
+
+        # Metadata stays JSON-parseable (the huge-manifest invariant) and
+        # bounded: ~230 B/leaf, not KBs of YAML ceremony.
+        meta_path = os.path.join(path, ".snapshot_metadata")
+        meta_bytes = os.path.getsize(meta_path)
+        with open(meta_path) as f:
+            manifest = json.load(f)["manifest"]
+        assert len(manifest) > N_LEAVES  # leaves + container entries
+        assert meta_bytes < 400 * N_LEAVES
+
+        # Slab batching collapsed 1e5 tiny blobs into a handful of files.
+        n_files = sum(len(fs) for _, _, fs in os.walk(path))
+        assert n_files < 50, f"{n_files} files for {N_LEAVES} leaves"
+
+        dst = {k: np.zeros((4,), np.float32) for k in big_tree}
+        wrapped = ts.PyTreeState(dst)
+        t0 = time.perf_counter()
+        ts.Snapshot(path).restore({"emb": wrapped})
+        t_restore = time.perf_counter() - t0
+
+    np.testing.assert_array_equal(
+        wrapped.tree["table_5/row_500"], np.full((4,), 5500 % 97, np.float32)
+    )
+    np.testing.assert_array_equal(
+        wrapped.tree[f"table_{N_LEAVES // 1000 - 1}/row_999"],
+        np.full((4,), (N_LEAVES - 1) % 97, np.float32),
+    )
+    # Generous CI bounds (~10x of measured) — regressions to per-leaf
+    # executor hops or asdict recursion blow through them immediately.
+    assert t_take < 60, f"take took {t_take:.1f}s"
+    assert t_restore < 60, f"restore took {t_restore:.1f}s"
+
+
+@pytest.mark.slow
+def test_1e5_leaf_read_object(tmp_path, big_tree) -> None:
+    """Random access into a huge snapshot must not pay the full restore."""
+    path = str(tmp_path / "snap")
+    with enable_batching():
+        ts.Snapshot.take(path, {"emb": ts.PyTreeState(big_tree)})
+        snap = ts.Snapshot(path)
+        t0 = time.perf_counter()
+        val = snap.read_object("0/emb/table_7%2Frow_123")
+        t_read = time.perf_counter() - t0
+    np.testing.assert_array_equal(val, np.full((4,), 7123 % 97, np.float32))
+    assert t_read < 30, f"read_object took {t_read:.1f}s"

@@ -100,6 +100,12 @@ def get_storage_path(logical_path: str, rank: int, replicated: bool) -> str:
 # ---------------------------------------------------------------------------
 
 
+# Below this size a host-resident buffer is staged inline on the event
+# loop instead of a ThreadPoolExecutor round-trip (GIL release buys
+# nothing for a sub-millisecond memcpy; the future machinery costs more).
+_INLINE_STAGE_MAX_BYTES = 1 << 20
+
+
 class ArrayBufferStager(BufferStager):
     """Stages a dense array (np.ndarray or unsharded jax.Array) to a host
     byte buffer.
@@ -128,6 +134,19 @@ class ArrayBufferStager(BufferStager):
                 pass  # prefetch is best-effort; np.asarray below still works
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        # Tiny host-resident leaves (torchrec-style 1e5-leaf manifests are
+        # mostly these) aren't worth an executor hop: the future/queue
+        # machinery costs ~100x the memcpy. Device arrays always go to the
+        # executor — np.asarray would block the event loop on D2H — and so
+        # do prepare-func stagers: the hook is arbitrary user code and may
+        # return a device array or something larger than the pre-prepare
+        # size gate saw (same exclusion batcher._is_batchable applies).
+        if (
+            self.array_prepare_func is None
+            and not is_jax_array(self.arr)
+            and self.get_staging_cost_bytes() <= _INLINE_STAGE_MAX_BYTES
+        ):
+            return self._stage_sync()
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(executor, self._stage_sync)
 
@@ -195,6 +214,11 @@ class ArrayBufferConsumer(BufferConsumer):
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
+        # Mirror of ArrayBufferStager.stage_buffer: a tiny copy is cheaper
+        # than the future/queue round-trip it would ride.
+        if self.get_consuming_cost_bytes() <= _INLINE_STAGE_MAX_BYTES:
+            self._consume_sync(buf)
+            return
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(executor, self._consume_sync, buf)
 
