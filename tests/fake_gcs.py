@@ -1,0 +1,222 @@
+"""An in-repo fake GCS server (the fake-gcs-server role, no deps).
+
+Implements exactly the HTTP surface storage_plugins/gcs.py exercises via
+google-resumable-media, so the resumable-upload recover path and the
+transient-retry taxonomy run against a REAL http server instead of mock
+choreography:
+
+- ``POST /upload/storage/v1/b/{bucket}/o?uploadType=resumable`` →
+  ``Location`` session URL
+- ``PUT {session}`` with ``Content-Range: bytes a-b/total`` chunks;
+  ``bytes */total`` status probes (what ``ResumableUpload.recover``
+  sends) answered with 308 + ``Range: bytes=0-N``
+- ``GET /download/storage/v1/b/{bucket}/o/{blob}?alt=media`` with
+  optional ``Range`` header → 200/206 (+ ``Content-Range``)
+- ``DELETE /storage/v1/b/{bucket}/o/{blob}``
+
+Fault injection: ``server.fail_next(n, status=503)`` makes the next
+``n`` chunk PUTs (or ``where="download"``/``"initiate"`` requests) fail
+with ``status`` — mid-upload brownouts, throttles, 5xx storms.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _Upload:
+    def __init__(self, blob: str, total: int) -> None:
+        self.blob = blob
+        self.total = total
+        self.data = bytearray(total)
+        self.received = 0  # contiguous high-water mark
+
+
+class FakeGCSServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self) -> None:
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.blobs: Dict[str, bytes] = {}
+        self.uploads: Dict[str, _Upload] = {}
+        self.lock = threading.Lock()
+        self._faults: Dict[str, list] = {"chunk": [], "download": [], "initiate": []}
+        self.request_counts: Dict[str, int] = {
+            "chunk": 0, "download": 0, "initiate": 0, "probe": 0
+        }
+
+    # -- fault injection -------------------------------------------------
+    def fail_next(self, n: int, status: int = 503, where: str = "chunk") -> None:
+        with self.lock:
+            self._faults[where].extend([status] * n)
+
+    def _pop_fault(self, where: str) -> Optional[int]:
+        with self.lock:
+            self.request_counts[where] += 1
+            if self._faults[where]:
+                return self._faults[where].pop(0)
+        return None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> str:
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+        return f"http://127.0.0.1:{self.server_address[1]}"
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: FakeGCSServer
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def _reply(
+        self,
+        status: int,
+        body: bytes = b"",
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    # -- resumable upload ------------------------------------------------
+    def do_POST(self) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path.startswith("/upload/storage/v1/b/"):
+            body = self._read_body()  # drain BEFORE any fault reply, or the
+            # leftover bytes corrupt the next keep-alive request
+            fault = self.server._pop_fault("initiate")
+            if fault is not None:
+                self._reply(fault, b"injected fault")
+                return
+            meta = json.loads(body or b"{}")
+            blob = meta.get("name", "")
+            total = int(self.headers.get("x-upload-content-length") or 0)
+            sid = uuid.uuid4().hex
+            with self.server.lock:
+                self.server.uploads[sid] = _Upload(blob, total)
+            host = f"http://127.0.0.1:{self.server.server_address[1]}"
+            self._reply(
+                200, b"{}", {"Location": f"{host}/upload/session/{sid}"}
+            )
+            return
+        self._reply(404, b"not found")
+
+    def do_PUT(self) -> None:
+        m = re.match(r"^/upload/session/([0-9a-f]+)$", self.path)
+        if not m:
+            self._reply(404, b"not found")
+            return
+        upload = self.server.uploads.get(m.group(1))
+        if upload is None:
+            self._reply(404, b"no such session")
+            return
+        body = self._read_body()
+        crange = self.headers.get("Content-Range", "")
+        probe = re.match(r"^bytes \*/(\d+|\*)$", crange)
+        if probe:
+            with self.server.lock:
+                self.server.request_counts["probe"] += 1
+            self._incomplete(upload)
+            return
+        dataspec = re.match(r"^bytes (\d+)-(\d+)/(\d+)$", crange)
+        if not dataspec:
+            self._reply(400, f"bad Content-Range {crange!r}".encode())
+            return
+        fault = self.server._pop_fault("chunk")
+        if fault is not None:
+            self._reply(fault, b"injected fault")
+            return
+        start, end, total = (int(g) for g in dataspec.groups())
+        if len(body) != end - start + 1:
+            self._reply(400, b"length mismatch")
+            return
+        with self.server.lock:
+            upload.total = total
+            if len(upload.data) < total:
+                upload.data.extend(bytearray(total - len(upload.data)))
+            upload.data[start : end + 1] = body
+            if start <= upload.received:
+                upload.received = max(upload.received, end + 1)
+        if upload.received >= total:
+            with self.server.lock:
+                self.server.blobs[upload.blob] = bytes(upload.data[:total])
+            self._reply(
+                200,
+                json.dumps(
+                    {"name": upload.blob, "size": str(total)}
+                ).encode(),
+                {"Content-Type": "application/json"},
+            )
+        else:
+            self._incomplete(upload)
+
+    def _incomplete(self, upload: _Upload) -> None:
+        headers = {}
+        if upload.received > 0:
+            headers["Range"] = f"bytes=0-{upload.received - 1}"
+        self._reply(308, b"", headers)
+
+    # -- download --------------------------------------------------------
+    def do_GET(self) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        m = re.match(r"^/download/storage/v1/b/[^/]+/o/(.+)$", parsed.path)
+        if not m:
+            self._reply(404, b"not found")
+            return
+        fault = self.server._pop_fault("download")
+        if fault is not None:
+            self._reply(fault, b"injected fault")
+            return
+        blob = urllib.parse.unquote(m.group(1))
+        data = self.server.blobs.get(blob)
+        if data is None:
+            self._reply(404, b"no such object")
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            rm = re.match(r"^bytes=(\d+)-(\d+)$", rng)
+            start, end = int(rm.group(1)), min(int(rm.group(2)), len(data) - 1)
+            body = data[start : end + 1]
+            self._reply(
+                206,
+                body,
+                {
+                    "Content-Range": f"bytes {start}-{end}/{len(data)}",
+                    "Content-Type": "application/octet-stream",
+                },
+            )
+        else:
+            self._reply(
+                200, data, {"Content-Type": "application/octet-stream"}
+            )
+
+    # -- delete ----------------------------------------------------------
+    def do_DELETE(self) -> None:
+        m = re.match(r"^/storage/v1/b/[^/]+/o/(.+)$", self.path)
+        if not m:
+            self._reply(404, b"not found")
+            return
+        blob = urllib.parse.unquote(m.group(1))
+        with self.server.lock:
+            existed = self.server.blobs.pop(blob, None) is not None
+        self._reply(204 if existed else 404)

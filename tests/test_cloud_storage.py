@@ -275,6 +275,7 @@ def test_gcs_missing_blob_normalized_to_file_not_found() -> None:
     plugin._common = common
     plugin._chunked_download_cls = FakeDownload
     plugin._session = None
+    plugin._base_url = "https://storage.example"
     plugin.bucket = "b"
     plugin.prefix = "p"
 
@@ -332,3 +333,33 @@ def test_s3_put_body_streams_without_copy() -> None:
     run_in_fresh_event_loop(go())
     assert captured["key"] == "p/blob"
     assert captured["data"] == payload.tobytes()
+
+
+@pytest.mark.s3_integration_test
+@pytest.mark.skipif(
+    "TORCHSNAPSHOT_TPU_ENABLE_AWS_TEST" not in os.environ
+    or "TORCHSNAPSHOT_TPU_S3_URL" not in os.environ,
+    reason="live/emulated S3 test not enabled (set both "
+    "TORCHSNAPSHOT_TPU_ENABLE_AWS_TEST and TORCHSNAPSHOT_TPU_S3_URL; a "
+    "default bucket name would be attacker-squattable on real AWS)",
+)
+def test_s3_live_roundtrip() -> None:
+    """Write/ranged-read/delete against real S3 or a MinIO endpoint
+    (TORCHSNAPSHOT_TPU_S3_ENDPOINT — the CI service-container lane)."""
+    pytest.importorskip("botocore")
+    from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    url = os.environ["TORCHSNAPSHOT_TPU_S3_URL"]
+    plugin = url_to_storage_plugin(url)
+
+    async def go():
+        data = os.urandom(1 << 20)
+        await plugin.write(WriteIO(path="smoke/blob", buf=data))
+        io_ = ReadIO(path="smoke/blob", byte_range=(100, 1100))
+        await plugin.read(io_)
+        assert bytes(io_.buf) == data[100:1100]
+        await plugin.delete("smoke/blob")
+        await plugin.close()
+
+    run_in_fresh_event_loop(go())

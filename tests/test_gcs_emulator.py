@@ -1,0 +1,129 @@
+"""GCS plugin against a real local HTTP server (tests/fake_gcs.py), the
+fake-gcs-server role: resumable-upload chunking and RECOVER, ranged
+chunked downloads, 404 normalization, and the transient-retry taxonomy —
+previously verified only against hand-rolled mocks. Full Snapshot
+round-trips ride the gs:// scheme end to end."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu.storage_plugins.gcs as gcs_mod
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+
+from fake_gcs import FakeGCSServer
+
+
+@pytest.fixture()
+def emulator(monkeypatch):
+    srv = FakeGCSServer()
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", srv.start())
+    yield srv
+    srv.stop()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_write_read_delete_roundtrip(emulator) -> None:
+    async def main():
+        p = gcs_mod.GCSStoragePlugin("bkt/prefix")
+        data = bytes(range(256)) * 64
+        await p.write(WriteIO(path="x/y", buf=data))
+        rio = ReadIO(path="x/y")
+        await p.read(rio)
+        assert bytes(rio.buf) == data
+        rio = ReadIO(path="x/y", byte_range=(10, 5000))
+        await p.read(rio)
+        assert bytes(rio.buf) == data[10:5000]
+        with pytest.raises(FileNotFoundError):
+            await p.read(ReadIO(path="nope"))
+        await p.delete("x/y")
+        with pytest.raises(FileNotFoundError):
+            await p.read(ReadIO(path="x/y"))
+        await p.close()
+
+    _run(main())
+
+
+def test_resumable_upload_recovers_mid_upload(emulator, monkeypatch) -> None:
+    """A 503 on a middle chunk must trigger ResumableUpload.recover (a
+    'bytes */N' probe answered 308+Range) and resume from the confirmed
+    offset — not restart from byte 0, not fail the write."""
+    monkeypatch.setattr(gcs_mod, "_UPLOAD_CHUNK_SIZE", 256 * 1024)
+    data = os.urandom(1280 * 1024)  # 5 chunks of 256 KiB
+
+    async def main():
+        p = gcs_mod.GCSStoragePlugin("bkt")
+        emulator.fail_next(1, status=503, where="chunk")
+        await p.write(WriteIO(path="big", buf=data))
+        rio = ReadIO(path="big")
+        await p.read(rio)
+        assert bytes(rio.buf) == data
+        await p.close()
+
+    _run(main())
+    # 5 data chunks + the failed attempt; recover probed the offset.
+    assert emulator.request_counts["chunk"] >= 6
+    assert emulator.request_counts["probe"] >= 1
+
+
+def test_initiate_5xx_retried_by_collective_strategy(emulator) -> None:
+    """A 503 storm on initiate is transient: the collective-progress retry
+    re-runs the op and the write lands."""
+    emulator.fail_next(2, status=503, where="initiate")
+
+    async def main():
+        p = gcs_mod.GCSStoragePlugin("bkt")
+        await p.write(WriteIO(path="k", buf=b"payload"))
+        rio = ReadIO(path="k")
+        await p.read(rio)
+        assert bytes(rio.buf) == b"payload"
+        await p.close()
+
+    _run(main())
+    assert emulator.request_counts["initiate"] == 3
+
+
+def test_download_5xx_retried(emulator) -> None:
+    async def main():
+        p = gcs_mod.GCSStoragePlugin("bkt")
+        await p.write(WriteIO(path="k", buf=b"v" * 1000))
+        emulator.fail_next(1, status=500, where="download")
+        rio = ReadIO(path="k")
+        await p.read(rio)
+        assert bytes(rio.buf) == b"v" * 1000
+        await p.close()
+
+    _run(main())
+
+
+def test_nonretriable_4xx_raises(emulator) -> None:
+    async def main():
+        p = gcs_mod.GCSStoragePlugin("bkt")
+        emulator.fail_next(1, status=403, where="initiate")
+        with pytest.raises(Exception) as ei:
+            await p.write(WriteIO(path="k", buf=b"x"))
+        assert "403" in str(ei.value) or "InvalidResponse" in type(ei.value).__name__
+        await p.close()
+
+    _run(main())
+    assert emulator.request_counts["initiate"] == 1  # no retry on 403
+
+
+def test_snapshot_roundtrip_over_gs_scheme(emulator) -> None:
+    """The whole checkpointer over gs://: take -> commit marker -> restore
+    byte-identically, all through the live HTTP server."""
+    import torchsnapshot_tpu as ts
+
+    tree = {"w": np.arange(4096, dtype=np.float32).reshape(64, 64), "step": 3}
+    ts.Snapshot.take("gs://bkt/ckpt", {"s": ts.PyTreeState(tree)})
+    assert any(b.endswith(".snapshot_metadata") for b in emulator.blobs)
+    dst = {"w": np.zeros((64, 64), np.float32), "step": 0}
+    wrapped = ts.PyTreeState(dst)
+    ts.Snapshot("gs://bkt/ckpt").restore({"s": wrapped})
+    np.testing.assert_array_equal(wrapped.tree["w"], tree["w"])
+    assert wrapped.tree["step"] == 3

@@ -2,6 +2,8 @@
 migration in both directions — the analog of the reference's DeepSpeed
 bridge coverage (tricks/deepspeed.py)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -100,3 +102,56 @@ def test_orbax_roundtrip_both_directions(tmp_path) -> None:
     np.testing.assert_array_equal(
         np.asarray(back["nested"]["b"]), tree["nested"]["b"]
     )
+
+
+def test_orbax_handler_checkpointer_roundtrip(tmp_path) -> None:
+    """The deepspeed-trick analog: an existing orbax Checkpointer call site
+    writes/reads THIS framework's format once the handler is swapped in."""
+    ocp = pytest.importorskip("orbax.checkpoint")
+    from torchsnapshot_tpu.tricks.orbax import (
+        snapshot_checkpoint_handler,
+        snapshot_restore_args,
+        snapshot_save_args,
+    )
+
+    tree = {"params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}, "step": 42}
+    path = str(tmp_path / "ckpt")
+    ckptr = ocp.Checkpointer(snapshot_checkpoint_handler())
+    ckptr.save(path, args=snapshot_save_args(tree))
+    assert os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+    raw = ckptr.restore(path)  # template-free (orbax raw semantics)
+    np.testing.assert_array_equal(raw["params"]["w"], tree["params"]["w"])
+    assert raw["step"] == 42
+
+    tmpl = {"params": {"w": np.zeros((3, 4), np.float32)}, "step": 0}
+    out = ckptr.restore(path, args=snapshot_restore_args(tmpl))
+    np.testing.assert_array_equal(out["params"]["w"], tree["params"]["w"])
+    assert out["step"] == 42
+    ckptr.close()
+
+
+def test_orbax_handler_checkpoint_manager_retention(tmp_path) -> None:
+    """An EXISTING orbax CheckpointManager retention loop (max_to_keep)
+    runs unchanged over the snapshot format."""
+    ocp = pytest.importorskip("orbax.checkpoint")
+    from torchsnapshot_tpu.tricks.orbax import (
+        snapshot_checkpoint_handler,
+        snapshot_save_args,
+    )
+
+    mgr = ocp.CheckpointManager(
+        str(tmp_path),
+        options=ocp.CheckpointManagerOptions(max_to_keep=2),
+        item_handlers=snapshot_checkpoint_handler(),
+    )
+    for step in range(4):
+        mgr.save(
+            step,
+            args=snapshot_save_args({"w": np.full((8,), float(step), np.float32)}),
+        )
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [2, 3]
+    out = mgr.restore(mgr.latest_step())
+    np.testing.assert_array_equal(out["w"], np.full((8,), 3.0, np.float32))
+    mgr.close()

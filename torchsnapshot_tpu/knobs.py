@@ -22,6 +22,7 @@ _DISABLE_PARTITIONER_ENV = "TORCHSNAPSHOT_TPU_DISABLE_PARTITIONER"
 _PER_RANK_IO_CONCURRENCY_ENV = "TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY"
 _STAGING_THREADS_ENV = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _DISABLE_CHECKSUMS_ENV = "TORCHSNAPSHOT_TPU_DISABLE_CHECKSUMS"
+_S3_ENDPOINT_URL_ENV = "TORCHSNAPSHOT_TPU_S3_ENDPOINT"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -70,6 +71,12 @@ def is_partitioner_disabled() -> bool:
 def get_per_rank_io_concurrency() -> int:
     """Max concurrent storage I/O ops per process (reference: scheduler.py:30)."""
     return _get_int_env(_PER_RANK_IO_CONCURRENCY_ENV, 16)
+
+
+def get_s3_endpoint_url() -> Optional[str]:
+    """Non-AWS S3-compatible endpoint (MinIO CI lanes, private object
+    stores); unset = real S3."""
+    return os.environ.get(_S3_ENDPOINT_URL_ENV) or None
 
 
 def get_staging_threads() -> int:

@@ -14,6 +14,7 @@ import asyncio
 import logging
 from typing import Optional, Tuple
 
+from .. import knobs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from .retry import CollectiveProgressRetryStrategy
 
@@ -84,7 +85,13 @@ class S3StoragePlugin(StoragePlugin):
         if self._client is None:
             async with self._client_lock:
                 if self._client is None:
-                    self._client_ctx = self._session.create_client("s3")
+                    # MinIO CI lanes and private S3-compatible deployments
+                    # point this at a non-AWS endpoint; unset = real S3.
+                    endpoint = knobs.get_s3_endpoint_url()
+                    kwargs = {"endpoint_url": endpoint} if endpoint else {}
+                    self._client_ctx = self._session.create_client(
+                        "s3", **kwargs
+                    )
                     self._client = await self._client_ctx.__aenter__()
         return self._client
 

@@ -1,20 +1,28 @@
-"""Orbax interop: migrate checkpoints between orbax and Snapshot formats.
+"""Orbax interop: handler-level interception plus format migration.
 
-Reference parity: the reference's tricks layer bridges an external
-checkpoint system into its own take/restore path (tricks/deepspeed.py —
+Reference parity: the reference's tricks layer *intercepts* an external
+checkpoint system's save path (tricks/deepspeed.py:19-104 —
 ``_save_zero_checkpoint``/``_load_zero_checkpoint`` are rerouted to
-torchsnapshot). On TPU the incumbent checkpointer is orbax; teams
-switching to this framework have orbax checkpoint dirs to carry over, and
-tooling they still run may expect orbax layout. These helpers convert in
-both directions through host memory (one pytree at a time).
+torchsnapshot so the engine's existing call sites write the new format
+transparently). On TPU the incumbent is orbax, and the equivalent
+interception point is the ``CheckpointHandler``:
+:func:`snapshot_checkpoint_handler` returns a handler that plugs into
+``ocp.Checkpointer`` / ``ocp.CheckpointManager``, so EXISTING orbax call
+sites — ``checkpointer.save(path, args=...)``, manager ``.save(step,
+args=...)`` retention loops, all of it — produce this framework's
+snapshot format without the trainer changing a line beyond handler
+construction.
 
-Orbax is import-gated: the package works without it, these two functions
+The migration helpers below convert existing checkpoint *directories*
+between the two formats (one pytree at a time, through host memory).
+
+Orbax is import-gated: the package works without it, these functions
 don't.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 
 def _import_orbax():
@@ -26,6 +34,128 @@ def _import_orbax():
             "orbax-checkpoint)"
         ) from e
     return ocp
+
+
+class _RawState:
+    """Stateful that accepts whatever structure the snapshot holds —
+    the template-free restore path (orbax ``restore(path)`` semantics:
+    nested dicts/lists of arrays come back without an ``item``)."""
+
+    def __init__(self) -> None:
+        self.value: Any = None
+
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state_dict) -> None:
+        self.value = state_dict
+
+
+_handler_cache: Optional[Tuple[Any, Any, Any]] = None
+
+
+def _build_handler_classes() -> Tuple[Any, Any, Any]:
+    global _handler_cache
+    if _handler_cache is not None:
+        return _handler_cache
+    import dataclasses
+
+    ocp = _import_orbax()
+    from ..snapshot import Snapshot
+    from ..state_dict import PyTreeState
+
+    class SnapshotCheckpointHandler(ocp.CheckpointHandler):
+        """Writes/reads this framework's snapshot format behind orbax's
+        handler protocol. ``directory`` is whatever orbax hands over
+        (including its atomic temporary dir — orbax still performs its own
+        finalize/rename, layering its atomicity on top of the snapshot
+        commit marker).
+
+        Usage::
+
+            handler = snapshot_checkpoint_handler()
+            with ocp.Checkpointer(handler) as ckptr:
+                ckptr.save(path, args=SnapshotSave(tree))       # new format
+                tree = ckptr.restore(path)                       # raw
+                tree = ckptr.restore(path, args=SnapshotRestore(template))
+        """
+
+        def __init__(self, key: str = "state", pg: Optional[Any] = None):
+            self._key = key
+            self._pg = pg
+
+        def save(self, directory, *args, **kwargs) -> None:
+            ckpt_args = kwargs.get("args") or (args[0] if args else None)
+            item = getattr(ckpt_args, "item", ckpt_args)
+            Snapshot.take(
+                str(directory), {self._key: PyTreeState(item)}, pg=self._pg
+            )
+
+        def restore(self, directory, *args, **kwargs) -> Any:
+            ckpt_args = kwargs.get("args") or (args[0] if args else None)
+            template = getattr(ckpt_args, "item", ckpt_args)
+            snap = Snapshot(str(directory), pg=self._pg)
+            if template is None:
+                raw = _RawState()
+                snap.restore({self._key: raw})
+                return raw.value
+            stateful = PyTreeState(template)
+            snap.restore({self._key: stateful})
+            return stateful.tree
+
+        def metadata(self, directory) -> Optional[Any]:
+            return None
+
+        def finalize(self, directory) -> None:
+            pass
+
+        def close(self) -> None:
+            pass
+
+    @ocp.args.register_with_handler(SnapshotCheckpointHandler, for_save=True)
+    @dataclasses.dataclass
+    class SnapshotSave(ocp.args.CheckpointArgs):
+        item: Any
+
+    @ocp.args.register_with_handler(
+        SnapshotCheckpointHandler, for_restore=True
+    )
+    @dataclasses.dataclass
+    class SnapshotRestore(ocp.args.CheckpointArgs):
+        item: Any = None
+
+    _handler_cache = (SnapshotCheckpointHandler, SnapshotSave, SnapshotRestore)
+    return _handler_cache
+
+
+def snapshot_checkpoint_handler(key: str = "state", pg: Optional[Any] = None):
+    """An orbax ``CheckpointHandler`` that writes THIS framework's format.
+
+    Drop it into an existing orbax setup and every save/restore at that
+    call site transparently becomes a snapshot (the deepspeed-trick
+    interception pattern, reference tricks/deepspeed.py:19-104)::
+
+        import orbax.checkpoint as ocp
+        from torchsnapshot_tpu.tricks.orbax import snapshot_checkpoint_handler
+
+        ckptr = ocp.Checkpointer(snapshot_checkpoint_handler())
+        ckptr.save(path, args=snapshot_save_args(tree))
+        tree = ckptr.restore(path)
+    """
+    cls, _, _ = _build_handler_classes()
+    return cls(key=key, pg=pg)
+
+
+def snapshot_save_args(item: Any):
+    """``ocp.args`` save wrapper for :func:`snapshot_checkpoint_handler`."""
+    _, save_cls, _ = _build_handler_classes()
+    return save_cls(item)
+
+
+def snapshot_restore_args(item: Optional[Any] = None):
+    """``ocp.args`` restore wrapper (``item`` = optional template)."""
+    _, _, restore_cls = _build_handler_classes()
+    return restore_cls(item)
 
 
 def load_orbax_pytree(orbax_path: str, item: Optional[Any] = None) -> Any:
