@@ -195,6 +195,13 @@ class _Handler(BaseHTTPRequestHandler):
         rng = self.headers.get("Range")
         if rng:
             rm = re.match(r"^bytes=(\d+)-(\d+)$", rng)
+            if rm is None:
+                # Open-ended/suffix ranges aren't needed by ChunkedDownload;
+                # answer 400 cleanly instead of crashing the handler (which
+                # would surface as a retriable connection error and hang
+                # the collective-progress retry until its deadline).
+                self._reply(400, f"unsupported Range {rng!r}".encode())
+                return
             start, end = int(rm.group(1)), min(int(rm.group(2)), len(data) - 1)
             body = data[start : end + 1]
             self._reply(

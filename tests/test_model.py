@@ -105,3 +105,41 @@ def test_elastic_restore_to_different_mesh(tmp_path) -> None:
         jax.tree_util.tree_leaves(dest.tree),
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restored_state_reenters_train_step(tmp_path):
+    """Value equality is not enough: the restored train state must be
+    USABLE — re-enter the jitted train step next to mesh-committed params.
+    Regression: mesh-replicated scalars (optax counts) restored into an
+    uncommitted destination used to come back committed to device 0,
+    making the first post-restore step fail with incompatible devices."""
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.models.transformer import TrainState
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=0,
+    )
+    mesh = make_mesh(8)
+    state = init_train_state(cfg, seed=0, mesh=mesh)
+    step_fn = make_train_step(cfg, mesh=mesh)
+    tokens = jax.device_put(
+        np.random.default_rng(0).integers(0, 128, (4, 16)).astype(np.int32),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    state, _ = step_fn(state, tokens)  # counts become mesh-committed
+
+    path = str(tmp_path / "snap")
+    ts.Snapshot.take(path, {"train": ts.PyTreeState(state.as_pytree())})
+    dest = init_train_state(cfg, seed=1, mesh=mesh)
+    wrapped = ts.PyTreeState(dest.as_pytree())
+    ts.Snapshot(path).restore({"train": wrapped})
+    t = wrapped.tree
+    restored = TrainState(
+        params=t["params"], opt_state=t["opt_state"], step=t["step"], rng=t["rng"]
+    )
+    next_state, loss = step_fn(restored, tokens)  # must not raise
+    assert np.isfinite(float(loss))
+    assert int(next_state.step) == 2

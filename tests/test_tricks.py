@@ -155,3 +155,20 @@ def test_orbax_handler_checkpoint_manager_retention(tmp_path) -> None:
     out = mgr.restore(mgr.latest_step())
     np.testing.assert_array_equal(out["w"], np.full((8,), 3.0, np.float32))
     mgr.close()
+
+
+def test_orbax_handler_key_mismatch_raises(tmp_path) -> None:
+    ocp = pytest.importorskip("orbax.checkpoint")
+    from torchsnapshot_tpu.tricks.orbax import (
+        snapshot_checkpoint_handler,
+        snapshot_save_args,
+    )
+
+    path = str(tmp_path / "c")
+    ckptr = ocp.Checkpointer(snapshot_checkpoint_handler(key="state"))
+    ckptr.save(path, args=snapshot_save_args({"x": np.ones(2, np.float32)}))
+    ckptr.close()
+    other = ocp.Checkpointer(snapshot_checkpoint_handler(key="model"))
+    with pytest.raises(ValueError, match="no app-state key"):
+        other.restore(path)
+    other.close()

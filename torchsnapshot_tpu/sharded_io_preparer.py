@@ -222,6 +222,21 @@ class ShardedArrayIOPreparer:
                     boxes[box] = np.empty(box.sizes, dtype=np_dtype)
                 device_to_box[device] = box
 
+            # Uncommitted destination leaves (e.g. optax step counters
+            # created by plain jnp ops) must stay uncommitted — the same
+            # rule as snapshot._restore_destination: committing them to a
+            # concrete device makes the restored state unusable in a jit
+            # alongside differently-placed arrays. An uncommitted array is
+            # single-device by construction, so it has exactly one box.
+            if not getattr(current_leaf, "_committed", True) and len(boxes) == 1:
+
+                def assemble_uncommitted(filled: Dict[Box, np.ndarray]) -> Any:
+                    import jax.numpy as jnp
+
+                    return jnp.asarray(next(iter(filled.values())))
+
+                return boxes, assemble_uncommitted, True
+
             def assemble(filled: Dict[Box, np.ndarray]) -> Any:
                 # One batched H2D dispatch for all shards (a per-device
                 # device_put loop pays per-call dispatch latency 8x over).

@@ -98,6 +98,15 @@ def _build_handler_classes() -> Tuple[Any, Any, Any]:
             if template is None:
                 raw = _RawState()
                 snap.restore({self._key: raw})
+                if raw.value is None:
+                    # Nothing under this key: a key mismatch or a non-
+                    # snapshot directory must fail AT the checkpoint
+                    # boundary, not as a None-tree crash in the trainer.
+                    raise ValueError(
+                        f"snapshot at {directory} has no app-state key "
+                        f"{self._key!r}; was it saved with a different "
+                        f"handler key?"
+                    )
                 return raw.value
             stateful = PyTreeState(template)
             snap.restore({self._key: stateful})
