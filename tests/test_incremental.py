@@ -1,0 +1,514 @@
+"""Incremental takes: unchanged chunks become base refs (no bytes
+written), changed chunks rewrite, restores stay byte-exact — across
+dense, chunked, and sharded leaves, with checksum inheritance and chained
+bases. No reference counterpart (the reference rewrites all bytes every
+take); see incremental.py."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.incremental import relative_ref_prefix
+from torchsnapshot_tpu.knobs import (
+    override_max_chunk_size_bytes,
+    override_max_shard_size_bytes,
+)
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    ShardedArrayEntry,
+)
+from torchsnapshot_tpu.test_utils import assert_tree_eq
+
+
+def _blob_files(root: str):
+    """Relative paths of all data blobs under a snapshot dir (metadata,
+    checksums excluded)."""
+    out = set()
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            rel = os.path.relpath(os.path.join(dirpath, f), root)
+            if rel.startswith((".snapshot_metadata", "checksums")):
+                continue
+            out.add(rel)
+    return out
+
+
+def _take_pair(tmp_path, state0, state1, **take1_kwargs):
+    """Full take of state0 at step0; incremental take of state1 at step1."""
+    p0 = str(tmp_path / "step_0")
+    p1 = str(tmp_path / "step_1")
+    ts.Snapshot.take(p0, state0, record_digests=True)
+    ts.Snapshot.take(p1, state1, incremental_base=p0, **take1_kwargs)
+    return p0, p1
+
+
+def test_relative_ref_prefix():
+    assert relative_ref_prefix("/r/step_1", "/r/step_0") == "../step_0"
+    assert relative_ref_prefix("s3://b/r/step_1", "s3://b/r/step_0") == "../step_0"
+    assert relative_ref_prefix("/r/step_1", "s3://b/r/step_0") is None
+    assert relative_ref_prefix("/r/a", "/r/a") is None
+
+
+def test_dense_unchanged_is_not_rewritten(tmp_path):
+    w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    b = jnp.ones((8,), jnp.float32)
+    state0 = {"m": ts.PyTreeState({"w": w, "b": b})}
+    state1 = {"m": ts.PyTreeState({"w": w, "b": b + 1})}  # only b changes
+    p0, p1 = _take_pair(tmp_path, state0, state1)
+
+    files1 = _blob_files(p1)
+    assert any("b" in f for f in files1)
+    assert not any("/w" in f or f.endswith("w") for f in files1), files1
+
+    manifest = ts.Snapshot(p1).get_manifest()
+    w_entry = manifest["0/m/w"]
+    assert isinstance(w_entry, ArrayEntry)
+    assert w_entry.location == "../step_0/0/m/w"
+    assert w_entry.digest is not None
+
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w), "b": jnp.zeros_like(b)})}
+    ts.Snapshot(p1).restore(dest)
+    assert_tree_eq(dest["m"].tree, {"w": w, "b": b + 1})
+
+
+def test_unchanged_leaf_skips_d2h(tmp_path, monkeypatch):
+    """The whole point: an unchanged leaf's bytes never cross to the host.
+    Patch the stager's staging entry point and count invocations."""
+    from torchsnapshot_tpu import io_preparer
+
+    w = jnp.arange(1024, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p0 = str(tmp_path / "s0")
+    ts.Snapshot.take(p0, state, record_digests=True)
+
+    calls = []
+    orig = io_preparer.ArrayBufferStager.__init__
+
+    def counting_init(self, arr, *a, **k):
+        calls.append(1)
+        return orig(self, arr, *a, **k)
+
+    monkeypatch.setattr(io_preparer.ArrayBufferStager, "__init__", counting_init)
+    ts.Snapshot.take(str(tmp_path / "s1"), state, incremental_base=p0)
+    assert calls == []  # no stager was even constructed
+
+
+def test_chunked_partial_change(tmp_path):
+    """A large dense array chunked at dim 0: mutate one chunk's rows; the
+    other chunks must be refs."""
+    with override_max_chunk_size_bytes(256):  # 8x8 f32 rows = 32B/row
+        base = np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+        changed = base.copy()
+        changed[20, 3] += 1.0  # touches exactly one chunk
+        state0 = {"m": ts.PyTreeState({"big": jnp.asarray(base)})}
+        state1 = {"m": ts.PyTreeState({"big": jnp.asarray(changed)})}
+        p0, p1 = _take_pair(tmp_path, state0, state1)
+
+        manifest = ts.Snapshot(p1).get_manifest()
+        entry = manifest["0/m/big"]
+        assert isinstance(entry, ChunkedArrayEntry)
+        ref_chunks = [
+            c for c in entry.chunks if c.array.location.startswith("../")
+        ]
+        new_chunks = [
+            c for c in entry.chunks if not c.array.location.startswith("../")
+        ]
+        assert len(new_chunks) == 1
+        assert len(ref_chunks) == len(entry.chunks) - 1
+        assert new_chunks[0].offsets[0] <= 20 < new_chunks[0].offsets[0] + new_chunks[0].sizes[0]
+
+        dest = {"m": ts.PyTreeState({"big": jnp.zeros((32, 8), jnp.float32)})}
+        ts.Snapshot(p1).restore(dest)
+        np.testing.assert_array_equal(np.asarray(dest["m"].tree["big"]), changed)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_sharded_partial_change(tmp_path):
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    sharding = NamedSharding(mesh, P("x", None))
+    base = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    changed = base.copy()
+    changed[9, 1] = -5.0  # third shard (rows 8..12)
+    arr0 = jax.device_put(base, sharding)
+    arr1 = jax.device_put(changed, sharding)
+    p0, p1 = _take_pair(
+        tmp_path, {"m": ts.PyTreeState({"t": arr0})}, {"m": ts.PyTreeState({"t": arr1})}
+    )
+
+    manifest = ts.Snapshot(p1).get_manifest()
+    entry = manifest["0/m/t"]
+    assert isinstance(entry, ShardedArrayEntry)
+    refs = [s for s in entry.shards if s.array.location.startswith("../")]
+    news = [s for s in entry.shards if not s.array.location.startswith("../")]
+    assert len(news) == 1 and news[0].offsets == [8, 0]
+    assert len(refs) == 3
+
+    dest_arr = jax.device_put(np.zeros((16, 4), np.float32), sharding)
+    dest = {"m": ts.PyTreeState({"t": dest_arr})}
+    ts.Snapshot(p1).restore(dest)
+    np.testing.assert_array_equal(np.asarray(dest["m"].tree["t"]), changed)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_sharded_subdivided_pieces(tmp_path):
+    """Shards above the shard-size knob subdivide; piece-level skipping
+    must work at that granularity too."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    sharding = NamedSharding(mesh, P("x", None))
+    with override_max_shard_size_bytes(64):  # 4 f32 per row -> 4 rows/piece... 16B/row
+        base = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        changed = base.copy()
+        changed[0, 0] = 99.0  # first piece of first shard
+        arr0 = jax.device_put(base, sharding)
+        arr1 = jax.device_put(changed, sharding)
+        p0, p1 = _take_pair(
+            tmp_path,
+            {"m": ts.PyTreeState({"t": arr0})},
+            {"m": ts.PyTreeState({"t": arr1})},
+        )
+        manifest = ts.Snapshot(p1).get_manifest()
+        entry = manifest["0/m/t"]
+        news = [s for s in entry.shards if not s.array.location.startswith("../")]
+        refs = [s for s in entry.shards if s.array.location.startswith("../")]
+        assert len(news) == 1 and news[0].offsets == [0, 0]
+        assert len(refs) == len(entry.shards) - 1
+
+        dest = {
+            "m": ts.PyTreeState(
+                {"t": jax.device_put(np.zeros((16, 4), np.float32), sharding)}
+            )
+        }
+        ts.Snapshot(p1).restore(dest)
+        np.testing.assert_array_equal(np.asarray(dest["m"].tree["t"]), changed)
+
+
+def test_chained_refs_collapse_to_origin(tmp_path):
+    """step2 references an unchanged blob written at step0 *directly*,
+    through the chain step2 -> step1 -> step0."""
+    w = jnp.arange(32, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p0 = str(tmp_path / "step_0")
+    p1 = str(tmp_path / "step_1")
+    p2 = str(tmp_path / "step_2")
+    ts.Snapshot.take(p0, state, record_digests=True)
+    ts.Snapshot.take(p1, state, incremental_base=p0)
+    ts.Snapshot.take(p2, state, incremental_base=p1)
+
+    entry = ts.Snapshot(p2).get_manifest()["0/m/w"]
+    assert entry.location == "../step_0/0/m/w"  # not ../step_1/...
+
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w)})}
+    ts.Snapshot(p2).restore(dest)
+    assert_tree_eq(dest["m"].tree, {"w": w})
+
+
+def test_checksum_inheritance_detects_base_corruption(tmp_path):
+    """Refs inherit the base's CRC entries: corrupting the base blob makes
+    the *incremental* snapshot's restore fail loudly."""
+    from torchsnapshot_tpu.integrity import ChecksumError
+
+    w = jnp.arange(64, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p0, p1 = _take_pair(
+        tmp_path, state, {"m": ts.PyTreeState({"w": w})}
+    )
+    blob = os.path.join(p0, "0", "m", "w")
+    with open(blob, "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\xff\xff\xff")
+
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w)})}
+    with pytest.raises(ChecksumError):
+        ts.Snapshot(p1).restore(dest)
+
+
+def test_digest_recorded_on_full_take(tmp_path):
+    p0 = str(tmp_path / "s")
+    ts.Snapshot.take(
+        p0, {"m": ts.PyTreeState({"w": jnp.ones(8)})}, record_digests=True
+    )
+    entry = ts.Snapshot(p0).get_manifest()["0/m/w"]
+    assert entry.digest and entry.digest.startswith("mlh64:")
+
+
+def test_no_digests_without_flag(tmp_path):
+    p0 = str(tmp_path / "s")
+    ts.Snapshot.take(p0, {"m": ts.PyTreeState({"w": jnp.ones(8)})})
+    entry = ts.Snapshot(p0).get_manifest()["0/m/w"]
+    assert entry.digest is None
+
+
+def test_base_without_digests_falls_back_to_full(tmp_path):
+    w = jnp.arange(16, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p0 = str(tmp_path / "s0")
+    ts.Snapshot.take(p0, state)  # no digests recorded
+    p1 = str(tmp_path / "s1")
+    ts.Snapshot.take(p1, state, incremental_base=p0)
+    entry = ts.Snapshot(p1).get_manifest()["0/m/w"]
+    assert not entry.location.startswith("../")  # full write
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w)})}
+    ts.Snapshot(p1).restore(dest)
+    assert_tree_eq(dest["m"].tree, {"w": w})
+
+
+def test_missing_base_falls_back_to_full(tmp_path):
+    w = jnp.arange(16, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p1 = str(tmp_path / "s1")
+    ts.Snapshot.take(
+        p1, state, incremental_base=str(tmp_path / "never_existed")
+    )
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w)})}
+    ts.Snapshot(p1).restore(dest)
+    assert_tree_eq(dest["m"].tree, {"w": w})
+
+
+def test_dtype_change_forces_rewrite(tmp_path):
+    """Same byte pattern, different dtype: must not ref."""
+    a32 = jnp.asarray(np.zeros(16, np.float32))
+    ai32 = jnp.asarray(np.zeros(16, np.int32))
+    p0, p1 = _take_pair(
+        tmp_path,
+        {"m": ts.PyTreeState({"x": a32})},
+        {"m": ts.PyTreeState({"x": ai32})},
+    )
+    entry = ts.Snapshot(p1).get_manifest()["0/m/x"]
+    assert not entry.location.startswith("../")
+
+
+def test_chunk_knob_change_forces_rewrite(tmp_path):
+    base = np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+    state = {"m": ts.PyTreeState({"big": jnp.asarray(base)})}
+    p0 = str(tmp_path / "s0")
+    with override_max_chunk_size_bytes(256):
+        ts.Snapshot.take(p0, state, record_digests=True)
+    p1 = str(tmp_path / "s1")
+    with override_max_chunk_size_bytes(512):  # different chunk boundaries
+        ts.Snapshot.take(p1, state, incremental_base=p0)
+        entry = ts.Snapshot(p1).get_manifest()["0/m/big"]
+        for chunk in entry.chunks:
+            assert not chunk.array.location.startswith("../")
+    dest = {"m": ts.PyTreeState({"big": jnp.zeros((32, 8), jnp.float32)})}
+    ts.Snapshot(p1).restore(dest)
+    np.testing.assert_array_equal(np.asarray(dest["m"].tree["big"]), base)
+
+
+def test_incremental_async_take(tmp_path):
+    w = jnp.arange(64, dtype=jnp.float32)
+    b = jnp.ones((8,), jnp.float32)
+    state0 = {"m": ts.PyTreeState({"w": w, "b": b})}
+    p0 = str(tmp_path / "s0")
+    ts.Snapshot.take(p0, state0, record_digests=True)
+
+    state1 = {"m": ts.PyTreeState({"w": w, "b": b * 3})}
+    pending = ts.Snapshot.async_take(
+        str(tmp_path / "s1"), state1, incremental_base=p0
+    )
+    snap = pending.wait()
+    entry = snap.get_manifest()["0/m/w"]
+    assert entry.location == "../s0/0/m/w"
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w), "b": jnp.zeros_like(b)})}
+    snap.restore(dest)
+    assert_tree_eq(dest["m"].tree, {"w": w, "b": b * 3})
+
+
+def test_read_object_through_ref(tmp_path):
+    w = jnp.arange(16, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p0, p1 = _take_pair(tmp_path, state, {"m": ts.PyTreeState({"w": w})})
+    out = ts.Snapshot(p1).read_object("0/m/w")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(w))
+
+
+def test_np_host_leaves_incremental(tmp_path):
+    """Host numpy leaves participate via the bit-identical host digest."""
+    w = np.arange(24, dtype=np.float32)
+    state0 = {"m": ts.StateDict(w=w.copy(), v=np.zeros(4, np.int32))}
+    state1 = {"m": ts.StateDict(w=w.copy(), v=np.ones(4, np.int32))}
+    p0, p1 = _take_pair(tmp_path, state0, state1)
+    manifest = ts.Snapshot(p1).get_manifest()
+    assert manifest["0/m/w"].location.startswith("../")
+    assert not manifest["0/m/v"].location.startswith("../")
+    dest = {"m": ts.StateDict(w=np.zeros_like(w), v=np.zeros(4, np.int32))}
+    ts.Snapshot(p1).restore(dest)
+    np.testing.assert_array_equal(dest["m"]["w"], w)
+    np.testing.assert_array_equal(dest["m"]["v"], np.ones(4, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# distributed
+# ---------------------------------------------------------------------------
+
+from torchsnapshot_tpu.test_utils import multiprocess_test  # noqa: E402
+
+
+@multiprocess_test(nproc=2)
+def test_distributed_incremental_replicated_and_per_rank(pg) -> None:
+    """World-2 incremental take: replicated refs agree across ranks (the
+    consolidation assert would trip otherwise), changed per-rank state
+    rewrites, unchanged replicated state refs the base."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    root = os.path.join(tempfile.gettempdir(), "dist-incr-test")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    p0 = os.path.join(root, "step_0")
+    p1 = os.path.join(root, "step_1")
+
+    w = jnp.full((64, 8), 7.5, jnp.float32)
+    state0 = {
+        "params": ts.PyTreeState({"w": w, "b": jnp.arange(8.0)}),
+        "progress": ts.StateDict(rank_steps=100 + pg.rank),
+    }
+    ts.Snapshot.take(p0, state0, pg=pg, replicated=["params/**"],
+                     record_digests=True)
+
+    state1 = {
+        "params": ts.PyTreeState({"w": w, "b": jnp.arange(8.0) + 1}),
+        "progress": ts.StateDict(rank_steps=200 + pg.rank),
+    }
+    snap = ts.Snapshot.take(
+        p1, state1, pg=pg, replicated=["params/**"], incremental_base=p0
+    )
+    md = snap.metadata
+    # Unchanged replicated leaf refs the base; changed one was rewritten.
+    assert md.manifest["0/params/w"].location == "../step_0/replicated/params/w"
+    assert md.manifest["0/params/b"].location == "replicated/params/b"
+    assert not os.path.exists(os.path.join(p1, "replicated", "params", "w"))
+
+    fresh = {
+        "params": ts.PyTreeState({"w": jnp.zeros((64, 8)), "b": jnp.zeros(8)}),
+        "progress": ts.StateDict(rank_steps=-1),
+    }
+    ts.Snapshot(p1, pg=pg).restore(fresh)
+    assert float(fresh["params"].tree["w"][0, 0]) == 7.5
+    assert float(fresh["params"].tree["b"][5]) == 6.0
+    assert fresh["progress"]["rank_steps"] == 200 + pg.rank
+
+
+def test_incremental_chunk_knob_refines_skip_unit(tmp_path):
+    """Digest-enabled takes chunk at the incremental-chunk knob, so a
+    sparse row update skips the untouched fine chunks even when the array
+    is below the plain chunk threshold."""
+    from torchsnapshot_tpu.knobs import override_incremental_chunk_size_bytes
+
+    base = np.random.default_rng(0).standard_normal((256, 16)).astype(np.float32)
+    changed = base.copy()
+    changed[100] += 1.0
+    with override_incremental_chunk_size_bytes(1024):  # 16 rows/chunk
+        p0, p1 = _take_pair(
+            tmp_path,
+            {"m": ts.PyTreeState({"t": jnp.asarray(base)})},
+            {"m": ts.PyTreeState({"t": jnp.asarray(changed)})},
+        )
+        entry = ts.Snapshot(p1).get_manifest()["0/m/t"]
+        assert isinstance(entry, ChunkedArrayEntry)
+        news = [c for c in entry.chunks if not c.array.location.startswith("../")]
+        refs = [c for c in entry.chunks if c.array.location.startswith("../")]
+        assert len(news) == 1 and len(refs) == len(entry.chunks) - 1
+    dest = {"m": ts.PyTreeState({"t": jnp.zeros((256, 16), jnp.float32)})}
+    ts.Snapshot(p1).restore(dest)
+    np.testing.assert_array_equal(np.asarray(dest["m"].tree["t"]), changed)
+
+
+def test_plain_take_chunking_unaffected_by_incremental_knob(tmp_path):
+    """Without digests, the incremental-chunk knob must not change blob
+    layout (a plain take of a 1 MiB array stays one blob)."""
+    from torchsnapshot_tpu.knobs import override_incremental_chunk_size_bytes
+
+    arr = jnp.asarray(np.zeros((256, 16), np.float32))
+    with override_incremental_chunk_size_bytes(1024):
+        p = str(tmp_path / "s")
+        ts.Snapshot.take(p, {"m": ts.PyTreeState({"t": arr})})
+        entry = ts.Snapshot(p).get_manifest()["0/m/t"]
+        assert isinstance(entry, ArrayEntry)  # not chunked
+
+
+def test_memory_scheme_refuses_refs(tmp_path):
+    """memory:// stores are flat per-name namespaces: refs must be
+    refused (full take) rather than written and then unrestorable."""
+    assert relative_ref_prefix("memory://s1", "memory://s0") is None
+
+    w = jnp.arange(16, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    ts.Snapshot.take("memory://incr-s0", state, record_digests=True)
+    ts.Snapshot.take(
+        "memory://incr-s1", state, incremental_base="memory://incr-s0"
+    )
+    entry = ts.Snapshot("memory://incr-s1").get_manifest()["0/m/w"]
+    assert not entry.location.startswith("../")
+    dest = {"m": ts.PyTreeState({"w": jnp.zeros_like(w)})}
+    ts.Snapshot("memory://incr-s1").restore(dest)
+    assert_tree_eq(dest["m"].tree, {"w": w})
+
+
+def test_cross_bucket_refuses_refs():
+    assert relative_ref_prefix("s3://b1/r/s1", "s3://b2/r/s0") is None
+    assert relative_ref_prefix("gs://b/x/s1", "gs://b/y/s0") == "../../y/s0"
+
+
+@multiprocess_test(nproc=2)
+def test_distributed_degraded_base_agrees(pg) -> None:
+    """If only one rank can read the base, no rank may emit refs for
+    replicated leaves — the take degrades to full on every rank instead
+    of tripping the consolidation assert."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    root = os.path.join(tempfile.gettempdir(), "dist-incr-degraded")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    p0 = os.path.join(root, "step_0")
+    p1 = os.path.join(root, "step_1")
+    w = jnp.full((16, 4), 2.0, jnp.float32)
+    state = {"params": ts.PyTreeState({"w": w})}
+    ts.Snapshot.take(p0, state, pg=pg, replicated=["params/**"],
+                     record_digests=True)
+
+    # Rank 1 is handed a nonexistent base: its build() falls back.
+    base = p0 if pg.rank == 0 else os.path.join(root, "no_such_step")
+    snap = ts.Snapshot.take(
+        p1, state, pg=pg, replicated=["params/**"], incremental_base=base
+    )
+    entry = snap.metadata.manifest["0/params/w"]
+    assert not entry.location.startswith("../")  # degraded to full everywhere
+
+    fresh = {"params": ts.PyTreeState({"w": jnp.zeros((16, 4))})}
+    ts.Snapshot(p1, pg=pg).restore(fresh)
+    assert float(fresh["params"].tree["w"][3, 3]) == 2.0
+
+
+@multiprocess_test(nproc=2)
+def test_replication_promotion_forces_rewrite(pg) -> None:
+    """A leaf saved per-rank at the base and replicated now must rewrite
+    (per-rank base locations would diverge across ranks)."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    root = os.path.join(tempfile.gettempdir(), "dist-incr-promote")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    p0 = os.path.join(root, "step_0")
+    p1 = os.path.join(root, "step_1")
+    w = jnp.full((8,), 4.0, jnp.float32)
+    state = {"params": ts.PyTreeState({"w": w})}
+    ts.Snapshot.take(p0, state, pg=pg, record_digests=True)  # per-rank
+    snap = ts.Snapshot.take(
+        p1, state, pg=pg, replicated=["params/**"], incremental_base=p0
+    )
+    entry = snap.metadata.manifest["0/params/w"]
+    assert entry.replicated and not entry.location.startswith("../")

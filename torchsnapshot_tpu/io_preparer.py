@@ -253,16 +253,30 @@ class ArrayIOPreparer:
         replicated: bool,
         is_async_snapshot: bool,
         array_prepare_func: Optional[ArrayPrepareFunc] = None,
+        incremental: Optional[Any] = None,
     ) -> Tuple[Entry, List[WriteReq]]:
         location = get_storage_path(logical_path, rank, replicated)
         dtype_str = dtype_to_string(obj.dtype)
         shape = [int(d) for d in obj.shape]
+        if incremental is not None:
+            # Unchanged since the incremental base: reference its blob and
+            # construct no stager (so no D2H prefetch fires).
+            ref = incremental.ref_entry(
+                tuple(0 for _ in shape), tuple(shape), replicated
+            )
+            if ref is not None:
+                return ref, []
         entry = ArrayEntry(
             location=location,
             serializer=Serializer.BUFFER_PROTOCOL.value,
             dtype=dtype_str,
             shape=shape,
             replicated=replicated,
+            digest=(
+                incremental.digest_for(tuple(0 for _ in shape), tuple(shape))
+                if incremental is not None
+                else None
+            ),
         )
         req = WriteReq(
             path=location,
@@ -382,16 +396,35 @@ def chunk_shapes(
     return [(p.offsets[0], p.offsets[0] + p.sizes[0]) for p in pieces]
 
 
+def effective_max_chunk_size_bytes(incremental: Optional[Any]) -> int:
+    """Digest-enabled takes chunk tighter (the incremental-chunk knob) so
+    the skip unit is fine enough for sparse updates; plain takes use the
+    chunk knob alone. Applied identically on every step of a base chain,
+    keeping chunk boundaries (the digest keys) stable."""
+    size = knobs.get_max_chunk_size_bytes()
+    if incremental is not None:
+        size = min(size, knobs.get_incremental_chunk_size_bytes())
+    return size
+
+
+def effective_max_shard_size_bytes(incremental: Optional[Any]) -> int:
+    """Shard-piece analog of :func:`effective_max_chunk_size_bytes`."""
+    size = knobs.get_max_shard_size_bytes()
+    if incremental is not None:
+        size = min(size, knobs.get_incremental_chunk_size_bytes())
+    return size
+
+
 class ChunkedArrayIOPreparer:
     """Reference parity: ChunkedTensorIOPreparer (io_preparer.py:71-164)."""
 
     @staticmethod
-    def should_chunk(obj: Any) -> bool:
+    def should_chunk(obj: Any, incremental: Optional[Any] = None) -> bool:
         nbytes = int(
             np.dtype(obj.dtype).itemsize * np.prod(obj.shape, dtype=np.int64)
         )
         return (
-            nbytes > knobs.get_max_chunk_size_bytes()
+            nbytes > effective_max_chunk_size_bytes(incremental)
             and len(obj.shape) >= 1
             and int(obj.shape[0]) > 1
         )
@@ -404,6 +437,7 @@ class ChunkedArrayIOPreparer:
         replicated: bool,
         is_async_snapshot: bool,
         array_prepare_func: Optional[ArrayPrepareFunc] = None,
+        incremental: Optional[Any] = None,
     ) -> Tuple[ChunkedArrayEntry, List[WriteReq]]:
         location = get_storage_path(logical_path, rank, replicated)
         dtype_str = dtype_to_string(obj.dtype)
@@ -411,13 +445,21 @@ class ChunkedArrayIOPreparer:
         chunks: List[Shard] = []
         write_reqs: List[WriteReq] = []
         for start, stop in chunk_shapes(
-            shape, dtype_str, knobs.get_max_chunk_size_bytes()
+            shape, dtype_str, effective_max_chunk_size_bytes(incremental)
         ):
             chunk_location = f"{location}_{start}"
             chunk_shape = [stop - start] + shape[1:]
+            offsets = [start] + [0] * (len(shape) - 1)
+            if incremental is not None:
+                ref = incremental.ref_entry(offsets, chunk_shape, replicated)
+                if ref is not None:
+                    chunks.append(
+                        Shard(offsets=offsets, sizes=chunk_shape, array=ref)
+                    )
+                    continue
             chunks.append(
                 Shard(
-                    offsets=[start] + [0] * (len(shape) - 1),
+                    offsets=offsets,
                     sizes=chunk_shape,
                     array=ArrayEntry(
                         location=chunk_location,
@@ -425,6 +467,11 @@ class ChunkedArrayIOPreparer:
                         dtype=dtype_str,
                         shape=chunk_shape,
                         replicated=replicated,
+                        digest=(
+                            incremental.digest_for(offsets, chunk_shape)
+                            if incremental is not None
+                            else None
+                        ),
                     ),
                 )
             )
@@ -570,25 +617,31 @@ def prepare_write(
     replicated: bool = False,
     is_async_snapshot: bool = False,
     array_prepare_func: Optional[ArrayPrepareFunc] = None,
+    incremental: Optional[Any] = None,
 ) -> Tuple[Entry, List[WriteReq]]:
-    """Reference parity: io_preparer.py:872-927 (dispatch order preserved)."""
+    """Reference parity: io_preparer.py:872-927 (dispatch order preserved).
+
+    ``incremental`` is a per-leaf :class:`incremental.LeafIncrementalPlan`
+    consulted chunk-by-chunk: unchanged chunks become base-referencing
+    entries with no write request (and no stager, hence no D2H)."""
     if PrimitivePreparer.should_inline(obj):
         return PrimitivePreparer.prepare_write(obj, replicated), []
     if is_sharded_array(obj):
         from .sharded_io_preparer import ShardedArrayIOPreparer
 
         return ShardedArrayIOPreparer.prepare_write(
-            obj, logical_path, is_async_snapshot, array_prepare_func
+            obj, logical_path, is_async_snapshot, array_prepare_func,
+            incremental=incremental,
         )
     if _is_dense_array(obj):
-        if ChunkedArrayIOPreparer.should_chunk(obj):
+        if ChunkedArrayIOPreparer.should_chunk(obj, incremental=incremental):
             return ChunkedArrayIOPreparer.prepare_write(
                 obj, logical_path, rank, replicated, is_async_snapshot,
-                array_prepare_func,
+                array_prepare_func, incremental=incremental,
             )
         return ArrayIOPreparer.prepare_write(
             obj, logical_path, rank, replicated, is_async_snapshot,
-            array_prepare_func,
+            array_prepare_func, incremental=incremental,
         )
     return ObjectIOPreparer.prepare_write(obj, logical_path, rank, replicated)
 

@@ -23,10 +23,12 @@ _PER_RANK_IO_CONCURRENCY_ENV = "TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY"
 _STAGING_THREADS_ENV = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _DISABLE_CHECKSUMS_ENV = "TORCHSNAPSHOT_TPU_DISABLE_CHECKSUMS"
 _S3_ENDPOINT_URL_ENV = "TORCHSNAPSHOT_TPU_S3_ENDPOINT"
+_INCREMENTAL_CHUNK_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_INCREMENTAL_CHUNK_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES: int = 128 * 1024 * 1024
+_DEFAULT_INCREMENTAL_CHUNK_SIZE_BYTES: int = 16 * 1024 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -91,6 +93,17 @@ def is_checksums_disabled() -> bool:
     return _DISABLE_CHECKSUMS_ENV in os.environ
 
 
+def get_incremental_chunk_size_bytes() -> int:
+    """Chunk/shard-piece granularity for digest-enabled takes: the skip
+    unit of incremental checkpointing. Tighter than the plain chunk knob
+    (a sparse update dirties only the chunks its rows land in); applied
+    as ``min`` with the chunk/shard knobs whenever digests are recorded,
+    so boundaries stay stable across the base/incremental chain."""
+    return _get_int_env(
+        _INCREMENTAL_CHUNK_SIZE_BYTES_ENV, _DEFAULT_INCREMENTAL_CHUNK_SIZE_BYTES
+    )
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
     prev = os.environ.get(name)
@@ -140,4 +153,12 @@ def override_per_rank_memory_budget_bytes(nbytes: int) -> Generator[None, None, 
 @contextlib.contextmanager
 def disable_checksums() -> Generator[None, None, None]:
     with _override_env(_DISABLE_CHECKSUMS_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_incremental_chunk_size_bytes(
+    nbytes: int,
+) -> Generator[None, None, None]:
+    with _override_env(_INCREMENTAL_CHUNK_SIZE_BYTES_ENV, str(nbytes)):
         yield

@@ -59,7 +59,12 @@ def _register(tag: str):
 class ArrayEntry(Entry):
     """A dense array persisted at ``location`` (reference TensorEntry,
     manifest.py:40-72). ``byte_range`` is set when the bytes live inside a
-    batched slab or a subdivided shard file."""
+    batched slab or a subdivided shard file. ``digest`` is an optional
+    content digest of the payload (ops/device_digest.py format) recorded
+    by digest-enabled takes; incremental takes compare against it to skip
+    rewriting unchanged chunks. A ``location`` may be *snapshot-relative
+    with parent refs* (``../step_.../...``) when the bytes live in a base
+    snapshot this one was taken incrementally against."""
 
     location: str
     serializer: str
@@ -67,6 +72,7 @@ class ArrayEntry(Entry):
     shape: List[int]
     replicated: bool
     byte_range: Optional[List[int]]
+    digest: Optional[str]
 
     def __init__(
         self,
@@ -76,6 +82,7 @@ class ArrayEntry(Entry):
         shape: List[int],
         replicated: bool,
         byte_range: Optional[List[int]] = None,
+        digest: Optional[str] = None,
     ) -> None:
         super().__init__(type="Array")
         self.location = location
@@ -84,6 +91,7 @@ class ArrayEntry(Entry):
         self.shape = list(shape)
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
+        self.digest = digest
 
     @property
     def byte_range_tuple(self) -> Optional[Tuple[int, int]]:
@@ -101,6 +109,7 @@ def _array_from_yaml(obj: Dict[str, Any]) -> ArrayEntry:
         shape=obj["shape"],
         replicated=obj["replicated"],
         byte_range=obj.get("byte_range"),
+        digest=obj.get("digest"),
     )
 
 
@@ -338,6 +347,11 @@ def entry_to_yaml_obj(entry: Entry) -> Dict[str, Any]:
     explicitly. The returned dict aliases the entry's lists, which is fine
     for immediate json/yaml dumping (neither mutates its input)."""
     d = dict(entry.__dict__)
+    # ``digest`` stays out of the YAML form when unset so non-digest
+    # snapshots keep their exact metadata bytes (and 1e5-leaf manifests
+    # don't carry dead null fields).
+    if d.get("digest") is None:
+        d.pop("digest", None)
     for key in ("shards", "chunks"):
         shards = d.get(key)
         if shards:
@@ -345,11 +359,18 @@ def entry_to_yaml_obj(entry: Entry) -> Dict[str, Any]:
                 {
                     "offsets": s.offsets,
                     "sizes": s.sizes,
-                    "array": dict(s.array.__dict__),
+                    "array": _array_yaml_obj(s.array),
                 }
                 for s in shards
             ]
     return d
+
+
+def _array_yaml_obj(array: ArrayEntry) -> Dict[str, Any]:
+    a = dict(array.__dict__)
+    if a.get("digest") is None:
+        a.pop("digest", None)
+    return a
 
 
 @dataclass

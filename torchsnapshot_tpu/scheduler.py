@@ -205,6 +205,18 @@ class PendingIOWork:
         self._executor = executor
         # Filled in as writes complete; stable only after complete().
         self.checksums: ChecksumTable = checksums if checksums is not None else {}
+        # Optional hook run after complete() and before the checksum table
+        # is persisted (incremental takes inherit base-table entries here —
+        # storage reads that must stay off the staging-critical path so
+        # async_take returns at staging-done as promised).
+        self.checksum_finalizer: Optional[Callable[[], None]] = None
+
+    def finalize_checksums(self) -> None:
+        if self.checksum_finalizer is not None:
+            try:
+                self.checksum_finalizer()
+            finally:
+                self.checksum_finalizer = None
 
     async def complete(self) -> None:
         try:

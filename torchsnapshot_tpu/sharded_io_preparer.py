@@ -123,14 +123,19 @@ class ShardedArrayIOPreparer:
         logical_path: str,
         is_async_snapshot: bool,
         array_prepare_func: Optional[Callable[..., Any]] = None,
+        incremental: Optional[Any] = None,
     ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
         dtype_str = dtype_to_string(obj.dtype)
         itemsize = np.dtype(obj.dtype).itemsize
-        max_shard = knobs.get_max_shard_size_bytes()
         shards: List[Shard] = []
         write_reqs: List[WriteReq] = []
 
-        from .io_preparer import ArrayBufferStager
+        from .io_preparer import (
+            ArrayBufferStager,
+            effective_max_shard_size_bytes,
+        )
+
+        max_shard = effective_max_shard_size_bytes(incremental)
 
         for dev_shard in obj.addressable_shards:
             # Write-once election: the replica-0 copy of each box exists on
@@ -139,6 +144,21 @@ class ShardedArrayIOPreparer:
                 continue
             box = Box.from_index(dev_shard.index, obj.shape)
             for piece in subdivide_box(box, max_shard, itemsize):
+                if incremental is not None:
+                    # Unchanged since the incremental base: reference its
+                    # blob; no stager, no D2H for this piece.
+                    ref = incremental.ref_entry(
+                        piece.offsets, piece.sizes, False
+                    )
+                    if ref is not None:
+                        shards.append(
+                            Shard(
+                                offsets=list(piece.offsets),
+                                sizes=list(piece.sizes),
+                                array=ref,
+                            )
+                        )
+                        continue
                 location = _shard_location(logical_path, piece)
                 slc: Optional[slice] = None
                 if piece != box:
@@ -154,6 +174,13 @@ class ShardedArrayIOPreparer:
                             dtype=dtype_str,
                             shape=list(piece.sizes),
                             replicated=False,
+                            digest=(
+                                incremental.digest_for(
+                                    piece.offsets, piece.sizes
+                                )
+                                if incremental is not None
+                                else None
+                            ),
                         ),
                     )
                 )

@@ -98,9 +98,18 @@ class Snapshot:
         app_state: AppState,
         pg: Optional[Any] = None,
         replicated: Optional[List[str]] = None,
+        incremental_base: Optional[Any] = None,
+        record_digests: bool = False,
         _custom_array_prepare_func=None,
     ) -> "Snapshot":
-        """Synchronous distributed checkpoint (reference snapshot.py:175-243)."""
+        """Synchronous distributed checkpoint (reference snapshot.py:175-243).
+
+        ``incremental_base`` (a snapshot path or Snapshot, consistent
+        across ranks) enables the incremental take: chunks whose on-device
+        digest matches the base's recorded digest are not staged or
+        written — the manifest references the base's blob instead
+        (incremental.py). ``record_digests`` records digests without a
+        base, making this snapshot usable as a future base."""
         pg_wrapper = PGWrapper(pg)
         path = pg_wrapper.broadcast_object(path)  # rank-0 path wins
         event_loop = asyncio.new_event_loop()
@@ -114,9 +123,12 @@ class Snapshot:
                 storage=storage,
                 event_loop=event_loop,
                 is_async_snapshot=False,
+                incremental_base=incremental_base,
+                record_digests=record_digests,
                 _custom_array_prepare_func=_custom_array_prepare_func,
             )
             pending_io_work.sync_complete(event_loop)
+            pending_io_work.finalize_checksums()
             _maybe_write_checksum_table(
                 pending_io_work, pg_wrapper.get_rank(), storage, event_loop
             )
@@ -141,11 +153,14 @@ class Snapshot:
         app_state: AppState,
         pg: Optional[Any] = None,
         replicated: Optional[List[str]] = None,
+        incremental_base: Optional[Any] = None,
+        record_digests: bool = False,
         _custom_array_prepare_func=None,
     ) -> "PendingSnapshot":
         """Pipelined checkpoint: returns once staging completes; storage I/O
         and the commit continue on a background thread (reference
-        snapshot.py:245-314)."""
+        snapshot.py:245-314). ``incremental_base``/``record_digests`` as in
+        :meth:`take`."""
         import uuid
 
         pg_wrapper = PGWrapper(pg)
@@ -164,6 +179,8 @@ class Snapshot:
             storage=storage,
             event_loop=event_loop,
             is_async_snapshot=True,
+            incremental_base=incremental_base,
+            record_digests=record_digests,
             _custom_array_prepare_func=_custom_array_prepare_func,
         )
         return PendingSnapshot(
@@ -186,6 +203,8 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         is_async_snapshot: bool,
+        incremental_base: Optional[Any] = None,
+        record_digests: bool = False,
         _custom_array_prepare_func=None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         """Shared take core (reference snapshot.py:316-440)."""
@@ -224,6 +243,22 @@ class Snapshot:
             inferred=_infer_replicated_paths(flattened_global, world_size),
         )
 
+        incr_ctx = None
+        if incremental_base is not None or record_digests:
+            from .incremental import IncrementalTakeContext
+
+            incr_ctx = IncrementalTakeContext.build(
+                path, incremental_base, rank
+            )
+            # One launch pass before any stager exists: device digests
+            # dispatch asynchronously and overlap each other; skip
+            # decisions must precede D2H prefetches.
+            incr_ctx.launch(flattened_global, _custom_array_prepare_func)
+            # Replicated entries are asserted equal at consolidation, so
+            # per-rank degradation (unreadable base, failed digest launch)
+            # must degrade every rank identically.
+            incr_ctx.synchronize(pg_wrapper, replicated_paths)
+
         write_reqs: List[WriteReq] = []
         for logical_path, leaf in flattened_global.items():
             entry, reqs = prepare_write(
@@ -233,6 +268,9 @@ class Snapshot:
                 replicated=logical_path in replicated_paths,
                 is_async_snapshot=is_async_snapshot,
                 array_prepare_func=_custom_array_prepare_func,
+                incremental=(
+                    incr_ctx.plan_for(logical_path) if incr_ctx else None
+                ),
             )
             rank_manifest[logical_path] = entry
             write_reqs.extend(reqs)
@@ -264,6 +302,16 @@ class Snapshot:
             rank=rank,
             event_loop=event_loop,
         )
+        if incr_ctx is not None:
+            # Referenced blobs were not rewritten, so their checksums come
+            # from the base snapshot's tables (keyed by the ref location):
+            # restore-time verification must cover unwritten bytes too.
+            # Deferred to finalize_checksums (the background commit thread
+            # for async takes) — it reads base tables from storage, which
+            # must not delay the staging-done return.
+            pending_io_work.checksum_finalizer = (
+                lambda: incr_ctx.inherit_checksums(pending_io_work.checksums)
+            )
         return pending_io_work, metadata
 
     @staticmethod
@@ -618,6 +666,7 @@ class PendingSnapshot:
                     world_size=self.pg.get_world_size(),
                 )
             self._pending_io_work.sync_complete(self._event_loop)
+            self._pending_io_work.finalize_checksums()
             _maybe_write_checksum_table(
                 self._pending_io_work,
                 self.pg.get_rank(),

@@ -119,7 +119,15 @@ class GCSStoragePlugin(StoragePlugin):
     # ------------------------------------------------------------------
 
     def _blob_name(self, path: str) -> str:
-        return f"{self.prefix}/{path}" if self.prefix else path
+        name = f"{self.prefix}/{path}" if self.prefix else path
+        if ".." in path:
+            # Incremental snapshots reference base-step blobs through
+            # parent-relative locations (../step_.../...); object names
+            # have no directory semantics, so resolve them lexically.
+            import posixpath
+
+            name = posixpath.normpath(name)
+        return name
 
     def _upload_sync(self, path: str, data: bytes) -> None:
         blob = self._blob_name(path)
