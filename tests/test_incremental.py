@@ -4,6 +4,7 @@ dense, chunked, and sharded leaves, with checksum inheritance and chained
 bases. No reference counterpart (the reference rewrites all bytes every
 take); see incremental.py."""
 
+import json
 import os
 
 import jax
@@ -512,3 +513,116 @@ def test_replication_promotion_forces_rewrite(pg) -> None:
     )
     entry = snap.metadata.manifest["0/params/w"]
     assert entry.replicated and not entry.location.startswith("../")
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager integration: chained saves, pinning, cascade GC
+# ---------------------------------------------------------------------------
+
+
+def _mgr_state(v_w, v_t):
+    return {
+        "m": ts.PyTreeState(
+            {
+                "w": jnp.full((64,), float(v_w), jnp.float32),
+                "t": jnp.full((32,), float(v_t), jnp.float32),
+            }
+        )
+    }
+
+
+def test_manager_incremental_chain_and_restore(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root, incremental=True)
+    mgr.save(0, _mgr_state(1, 1))
+    mgr.save(1, _mgr_state(1, 2))  # only t changes
+    mgr.save(2, _mgr_state(1, 3))
+
+    man2 = ts.Snapshot(mgr.step_path(2)).get_manifest()
+    assert man2["0/m/w"].location == "../step_0000000000/0/m/w"
+    assert not man2["0/m/t"].location.startswith("../")
+
+    dest = _mgr_state(0, 0)
+    assert mgr.restore_latest(dest) == 2
+    assert float(dest["m"].tree["w"][0]) == 1.0
+    assert float(dest["m"].tree["t"][0]) == 3.0
+
+
+def test_manager_retention_pins_referenced_base(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root, keep_last_n=2, incremental=True)
+    for step in range(4):
+        mgr.save(step, _mgr_state(1, step))  # w never changes -> refs step 0
+
+    index = json.loads(
+        (tmp_path / "ckpts" / ".manager_index").read_text()
+    )
+    assert index["steps"] == [2, 3]
+    assert index["pinned"] == [0]  # w blob origin, still referenced
+    # Pinned step's blobs survive; its commit marker too (blobs readable).
+    assert os.path.exists(os.path.join(mgr.step_path(0), "0", "m", "w"))
+    # Step 1 was dropped and not referenced (its only novel blob was t).
+    assert not os.path.exists(os.path.join(mgr.step_path(1), "0", "m", "t"))
+
+    # Restore still works through the pin.
+    dest = _mgr_state(0, 0)
+    assert mgr.restore_latest(dest) == 3
+    assert float(dest["m"].tree["w"][0]) == 1.0
+    assert float(dest["m"].tree["t"][0]) == 3.0
+
+
+def test_manager_cascade_deletes_unreferenced_pin(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root, keep_last_n=2, incremental=True)
+    for step in range(4):
+        mgr.save(step, _mgr_state(1, step))  # pins step 0 (w origin)
+    assert os.path.exists(os.path.join(mgr.step_path(0), "0", "m", "w"))
+
+    # Change w: new steps stop referencing step 0; once no retained step
+    # refs it, the pin is released and its blobs deleted.
+    mgr.save(4, _mgr_state(2, 4))
+    mgr.save(5, _mgr_state(2, 5))
+    index = json.loads((tmp_path / "ckpts" / ".manager_index").read_text())
+    assert index["steps"] == [4, 5]
+    assert index.get("pinned", []) == [4] or index.get("pinned", []) == []
+    assert not os.path.exists(os.path.join(mgr.step_path(0), "0", "m", "w"))
+
+    dest = _mgr_state(0, 0)
+    assert mgr.restore_latest(dest) == 5
+    assert float(dest["m"].tree["w"][0]) == 2.0
+    assert float(dest["m"].tree["t"][0]) == 5.0
+
+
+def test_manager_async_incremental(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root, incremental=True)
+    mgr.async_save(0, _mgr_state(1, 1)).wait()
+    pending = mgr.async_save(1, _mgr_state(1, 2))
+    pending.wait()
+    man1 = ts.Snapshot(mgr.step_path(1)).get_manifest()
+    assert man1["0/m/w"].location.startswith("../step_0000000000")
+    index = json.loads((tmp_path / "ckpts" / ".manager_index").read_text())
+    assert index["refs"]["1"] == [0]
+
+
+def test_manager_old_index_format_still_reads(tmp_path):
+    root = tmp_path / "ckpts"
+    mgr = ts.CheckpointManager(str(root))
+    mgr.save(0, _mgr_state(1, 1))
+    # Rewrite the index in the pre-incremental format.
+    (root / ".manager_index").write_text(json.dumps({"steps": [0]}))
+    (root / ".manager_index.backup").write_text(json.dumps({"steps": [0]}))
+    assert mgr.all_steps() == [0]
+    mgr.save(1, _mgr_state(1, 2))
+    assert mgr.all_steps() == [0, 1]
+
+
+def test_manager_non_incremental_unaffected(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root)  # incremental off
+    mgr.save(0, _mgr_state(1, 1))
+    mgr.save(1, _mgr_state(1, 2))
+    man1 = ts.Snapshot(mgr.step_path(1)).get_manifest()
+    assert not man1["0/m/w"].location.startswith("../")
+    index = json.loads((tmp_path / "ckpts" / ".manager_index").read_text())
+    assert "refs" not in index and "pinned" not in index

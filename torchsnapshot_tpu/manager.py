@@ -20,6 +20,15 @@ of truth; a step whose take crashed before commit never enters it and is
 invisible to restore). Retention deletes every blob named by the dropped
 step's manifest — the commit marker first, so a half-deleted step can
 never be mistaken for a valid one.
+
+Incremental mode (``incremental=True``, or per-save): each save records
+on-device digests and references the previous committed step's unchanged
+chunks instead of rewriting them (incremental.py). The index additionally
+tracks which origin steps each step's manifest references; retention
+*pins* a dropped step whose blobs are still referenced by a retained step
+(blobs stay, step leaves the visible list) and deletes it as soon as no
+retained step references it — so incremental chains never dangle and
+storage is reclaimed exactly when safe.
 """
 
 from __future__ import annotations
@@ -27,7 +36,8 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-from typing import Any, List, Optional, Set
+import re
+from typing import Any, Dict, List, Optional, Set
 
 from . import knobs
 from .event_loop import run_in_fresh_event_loop
@@ -54,6 +64,22 @@ def _step_dirname(step: int) -> str:
     return f"step_{step:010d}"
 
 
+_REF_LOCATION_RE = re.compile(r"^\.\./step_(\d+)/")
+
+
+def referenced_steps(manifest: Manifest) -> Set[int]:
+    """Origin steps an (incremental) snapshot's manifest references.
+    Chained refs collapse at take time (incremental.py), so locations
+    always name the originating step directly."""
+    out: Set[int] = set()
+    for entry in manifest.values():
+        for location in _entry_locations(entry):
+            m = _REF_LOCATION_RE.match(location)
+            if m:
+                out.add(int(m.group(1)))
+    return out
+
+
 def _entry_locations(entry: Entry) -> List[str]:
     """Every storage location a manifest entry's bytes live at (batched
     entries share slab locations; callers dedupe)."""
@@ -76,7 +102,9 @@ class _PendingManagedSnapshot:
 
     def wait(self) -> Snapshot:
         snapshot = self._pending.wait()  # raises on failed take: no index entry
-        self._manager._commit_step(self._step)
+        self._manager._commit_step(
+            self._step, refs=referenced_steps(self._pending._metadata.manifest)
+        )
         return snapshot
 
     def done(self) -> bool:
@@ -89,11 +117,15 @@ class CheckpointManager:
         root: str,
         keep_last_n: Optional[int] = None,
         pg: Optional[Any] = None,
+        incremental: bool = False,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
         self.root = root
         self.keep_last_n = keep_last_n
+        # Default for save()/async_save(): digest-enabled takes that
+        # reference the previous committed step's unchanged chunks.
+        self.incremental = incremental
         # One wrapper for the manager's own collectives; Snapshot calls get
         # the raw pg and build their own wrappers — safe because the op
         # sequence is shared across wrappers of the same pg (pg_wrapper).
@@ -107,20 +139,56 @@ class CheckpointManager:
     def step_path(self, step: int) -> str:
         return f"{self.root.rstrip('/')}/{_step_dirname(step)}"
 
-    def save(self, step: int, app_state: AppState, **take_kwargs: Any) -> Snapshot:
+    def _incremental_take_kwargs(
+        self, incremental: Optional[bool], take_kwargs: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Resolve the per-save incremental setting and, when on, point the
+        take at the latest committed step. Rank 0 resolves the base and
+        everyone follows — ranks must never diff against different bases."""
+        if incremental is None:
+            incremental = self.incremental
+        if not incremental:
+            return take_kwargs
+        if "incremental_base" in take_kwargs:
+            return {**take_kwargs, "record_digests": True}
+        base_step = (
+            self.latest_step() if self._pg.get_rank() == 0 else None
+        )
+        base_step = self._pg.broadcast_object(base_step)
+        out = {**take_kwargs, "record_digests": True}
+        if base_step is not None:
+            out["incremental_base"] = self.step_path(base_step)
+        return out
+
+    def save(
+        self,
+        step: int,
+        app_state: AppState,
+        incremental: Optional[bool] = None,
+        **take_kwargs: Any,
+    ) -> Snapshot:
         """Synchronous checkpoint of ``step``; updates the index and
-        applies retention after the commit."""
+        applies retention after the commit. ``incremental`` overrides the
+        manager-level default for this save."""
+        take_kwargs = self._incremental_take_kwargs(incremental, take_kwargs)
         snapshot = Snapshot.take(
             self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
         )
-        self._commit_step(step)
+        self._commit_step(
+            step, refs=referenced_steps(snapshot.metadata.manifest)
+        )
         return snapshot
 
     def async_save(
-        self, step: int, app_state: AppState, **take_kwargs: Any
+        self,
+        step: int,
+        app_state: AppState,
+        incremental: Optional[bool] = None,
+        **take_kwargs: Any,
     ) -> _PendingManagedSnapshot:
         """Pipelined checkpoint; the index entry and retention pass happen
         in ``wait()`` after the background commit succeeds."""
+        take_kwargs = self._incremental_take_kwargs(incremental, take_kwargs)
         pending = Snapshot.async_take(
             self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
         )
@@ -154,6 +222,23 @@ class CheckpointManager:
         self.restore(step, app_state)
         return step
 
+    def async_restore(self, step: int, app_state: AppState):
+        """Pipelined restore of ``step`` (Snapshot.async_restore): reads
+        run in the background; call ``.wait()`` to apply."""
+        return Snapshot(self.step_path(step), pg=self._pg_arg).async_restore(
+            app_state
+        )
+
+    def async_restore_latest(self, app_state: AppState):
+        """Kick off a pipelined restore of the newest committed step;
+        returns ``(step, PendingRestore)`` or ``None`` on a fresh run.
+        Overlap jit compilation with the reads, then ``wait()``."""
+        step = self.latest_step() if self._pg.get_rank() == 0 else None
+        step = self._pg.broadcast_object(step)
+        if step is None:
+            return None
+        return step, self.async_restore(step, app_state)
+
     # ------------------------------------------------------------------
     # index + retention (rank 0 only; peers observe via the index blob)
     # ------------------------------------------------------------------
@@ -171,17 +256,27 @@ class CheckpointManager:
 
         return run_in_fresh_event_loop(body())
 
-    def _commit_step(self, step: int) -> None:
+    def _commit_step(self, step: int, refs: Optional[Set[int]] = None) -> None:
         if self._pg.get_rank() != 0:
             return
         self._with_root_storage(
-            lambda storage: self._commit_step_async(step, storage)
+            lambda storage: self._commit_step_async(step, storage, refs or set())
         )
 
-    async def _commit_step_async(self, step: int, storage: StoragePlugin) -> None:
-        steps = [s for s in await self._read_index_async(storage) if s != step]
+    async def _commit_step_async(
+        self, step: int, storage: StoragePlugin, refs: Set[int]
+    ) -> None:
+        index = await self._read_index_full_async(storage)
+        steps = [s for s in index["steps"] if s != step]
         steps.append(step)
         steps.sort()
+        refs_map: Dict[str, List[int]] = dict(index["refs"])
+        if refs:
+            refs_map[str(step)] = sorted(refs)
+        else:
+            refs_map.pop(str(step), None)
+        pinned: Set[int] = set(index["pinned"])
+
         dropped: List[int] = []
         if self.keep_last_n is not None and len(steps) > self.keep_last_n:
             dropped = steps[: -self.keep_last_n]
@@ -201,18 +296,49 @@ class CheckpointManager:
                 )
                 dropped.remove(step)
                 steps = sorted(steps + [step])
-        await self._write_index_async(steps, storage)
+
+        # Pin-or-delete: a dropped (or previously pinned) step whose blobs
+        # a *retained* step's manifest still references must keep its
+        # blobs. Refs name origin steps directly (chained refs collapse at
+        # take time), so one pass over retained steps' ref lists is the
+        # full liveness set — pins don't propagate.
+        needed: Set[int] = set()
+        for s in steps:
+            needed.update(refs_map.get(str(s), ()))
+        to_delete: List[int] = []
         for old in dropped:
+            if old in needed:
+                pinned.add(old)
+            else:
+                to_delete.append(old)
+        for p in sorted(pinned):
+            if p not in needed:
+                pinned.discard(p)
+                to_delete.append(p)
+        for gone in to_delete:
+            refs_map.pop(str(gone), None)
+
+        await self._write_index_async(
+            steps, storage, refs=refs_map, pinned=sorted(pinned)
+        )
+        for old in to_delete:
             try:
                 await self._delete_step_async(old)
             except Exception as e:  # noqa: BLE001 - GC must not fail a save
                 logger.warning("Failed to GC step %d: %r", old, e)
 
     async def _read_index_async(self, storage: StoragePlugin) -> List[int]:
+        return (await self._read_index_full_async(storage))["steps"]
+
+    async def _read_index_full_async(
+        self, storage: StoragePlugin
+    ) -> Dict[str, Any]:
         """Primary slot, falling back to the backup slot: the index is
         rewritten on every save (backup slot first), so a crash mid-write
         must not brick the manager — whichever slot survives is valid,
-        at worst one save stale."""
+        at worst one save stale. Returns ``{"steps": [...], "refs":
+        {step: [origin steps]}, "pinned": [...]}``; the latter two default
+        empty for pre-incremental indexes."""
         io_failed: List[str] = []
         corrupt: List[str] = []
         absent: List[str] = []
@@ -231,9 +357,15 @@ class CheckpointManager:
                 absent.append(slot)
                 continue
             try:
-                return sorted(
-                    int(s) for s in json.loads(bytes(read_io.buf))["steps"]
-                )
+                raw = json.loads(bytes(read_io.buf))
+                return {
+                    "steps": sorted(int(s) for s in raw["steps"]),
+                    "refs": {
+                        str(int(k)): sorted(int(v) for v in vs)
+                        for k, vs in raw.get("refs", {}).items()
+                    },
+                    "pinned": sorted(int(p) for p in raw.get("pinned", [])),
+                }
             except (ValueError, KeyError, TypeError) as e:
                 logger.warning(
                     "Index slot %s is corrupt (%r); trying %s",
@@ -257,12 +389,21 @@ class CheckpointManager:
                 f"(io_failed={io_failed!r}, corrupt={corrupt!r}); "
                 "refusing to treat the step list as empty"
             )
-        return []
+        return {"steps": [], "refs": {}, "pinned": []}
 
     async def _write_index_async(
-        self, steps: List[int], storage: StoragePlugin
+        self,
+        steps: List[int],
+        storage: StoragePlugin,
+        refs: Optional[Dict[str, List[int]]] = None,
+        pinned: Optional[List[int]] = None,
     ) -> None:
-        payload = json.dumps({"steps": steps}).encode()
+        payload_obj: Dict[str, Any] = {"steps": steps}
+        if refs:
+            payload_obj["refs"] = refs
+        if pinned:
+            payload_obj["pinned"] = pinned
+        payload = json.dumps(payload_obj).encode()
         # Backup FIRST, primary second. With this order a torn *primary*
         # write always leaves a valid new backup behind it, and a torn
         # backup write leaves the previous (valid, one-save-stale) primary
@@ -297,6 +438,9 @@ class CheckpointManager:
             manifest: Manifest = metadata.manifest
             for entry in manifest.values():
                 locations.update(_entry_locations(entry))
+            # Parent-relative locations are another step's blobs (this
+            # step was incremental): never delete outside the step dir.
+            locations = {l for l in locations if not l.startswith("../")}
             for rank in range(metadata.world_size):
                 locations.add(table_path(rank))
             # Bounded-concurrent deletes: a dropped step of a large sharded
