@@ -1,0 +1,211 @@
+"""Async restore: reads on a background thread, state applied at wait().
+
+No reference counterpart (its restore is synchronous); the TPU use case
+is overlapping restore I/O with train-step compilation — the dominant
+term in restore-to-step0 (BENCH.md)."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.test_utils import assert_tree_eq, multiprocess_test
+
+
+def _state(seed: float):
+    return {
+        "params": ts.PyTreeState(
+            {
+                "w": jnp.full((32, 16), seed, jnp.float32),
+                "b": jnp.full((16,), seed * 2, jnp.bfloat16),
+            }
+        ),
+        "progress": ts.StateDict(step=int(seed * 10), lr=0.5),
+        "rng": ts.RngState(jax.random.key(int(seed))),
+    }
+
+
+def test_async_restore_matches_sync(tmp_path):
+    src = _state(3.0)
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, src)
+
+    dest_sync = _state(0.0)
+    ts.Snapshot(p).restore(dest_sync)
+
+    dest_async = _state(0.0)
+    pending = ts.Snapshot(p).async_restore(dest_async)
+    pending.wait()
+
+    assert_tree_eq(dest_async["params"].tree, dest_sync["params"].tree)
+    assert dict(dest_async["progress"]) == dict(dest_sync["progress"])
+    np.testing.assert_array_equal(
+        jax.random.key_data(dest_async["rng"].keys),
+        jax.random.key_data(dest_sync["rng"].keys),
+    )
+
+
+def test_jax_leaves_untouched_until_wait(tmp_path):
+    """Until wait() returns, the destination's jax leaves must hold their
+    pre-restore values (reads land in fresh buffers)."""
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, _state(5.0))
+
+    dest = _state(1.0)
+    before = np.asarray(dest["params"].tree["w"]).copy()
+    pending = ts.Snapshot(p).async_restore(dest)
+    # Regardless of background progress, the leaf object is immutable and
+    # still bound: the application sees old state until wait().
+    np.testing.assert_array_equal(np.asarray(dest["params"].tree["w"]), before)
+    pending.wait()
+    assert float(dest["params"].tree["w"][0, 0]) == 5.0
+
+
+def test_wait_idempotent(tmp_path):
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, _state(2.0))
+    dest = _state(0.0)
+    pending = ts.Snapshot(p).async_restore(dest)
+    pending.wait()
+    pending.wait()  # second wait is a no-op, not a double-apply
+    assert float(dest["params"].tree["w"][0, 0]) == 2.0
+
+
+def test_error_propagates_and_state_unmodified(tmp_path):
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, _state(4.0))
+    # Corrupt storage: remove one blob after take.
+    os.remove(os.path.join(p, "0", "params", "w"))
+
+    dest = _state(1.0)
+    pending = ts.Snapshot(p).async_restore(dest)
+    with pytest.raises(FileNotFoundError):
+        pending.wait()
+    # Nothing was applied: jax leaves still hold pre-restore values.
+    assert float(dest["params"].tree["w"][0, 0]) == 1.0
+    assert dest["progress"]["step"] == 10
+
+
+def test_done_flips_after_reads(tmp_path):
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, _state(2.0))
+    dest = _state(0.0)
+    pending = ts.Snapshot(p).async_restore(dest)
+    pending.wait()
+    assert pending.done()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_async_restore_sharded(tmp_path):
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    sharding = NamedSharding(mesh, P("x", None))
+    host = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    arr = jax.device_put(host, sharding)
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, {"m": ts.PyTreeState({"t": arr})})
+
+    dest_arr = jax.device_put(np.zeros((16, 8), np.float32), sharding)
+    dest = {"m": ts.PyTreeState({"t": dest_arr})}
+    pending = ts.Snapshot(p).async_restore(dest)
+    pending.wait()
+    restored = dest["m"].tree["t"]
+    assert restored.sharding == sharding
+    np.testing.assert_array_equal(np.asarray(restored), host)
+
+
+def test_overlap_with_computation(tmp_path):
+    """The intended pattern: kick off restore, compile/compute, wait."""
+    p = str(tmp_path / "snap")
+    ts.Snapshot.take(p, _state(7.0))
+    dest = _state(0.0)
+    pending = ts.Snapshot(p).async_restore(dest)
+    # Simulate compilation work on the main thread while reads proceed.
+    f = jax.jit(lambda x: jnp.tanh(x) @ jnp.tanh(x).T)
+    _ = f(jnp.ones((64, 64))).block_until_ready()
+    pending.wait()
+    assert float(dest["params"].tree["w"][0, 0]) == 7.0
+
+
+def test_manager_async_restore_latest(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root)
+    assert mgr.async_restore_latest(_state(0.0)) is None  # fresh run
+    mgr.save(0, _state(1.0))
+    mgr.save(5, _state(6.0))
+    dest = _state(0.0)
+    out = mgr.async_restore_latest(dest)
+    assert out is not None
+    step, pending = out
+    assert step == 5
+    pending.wait()
+    assert float(dest["params"].tree["w"][0, 0]) == 6.0
+
+
+def test_async_restore_incremental_chain(tmp_path):
+    """Async restore reads through ../ refs like the sync path."""
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root, incremental=True)
+    mgr.save(0, _state(1.0))
+    s = _state(1.0)
+    s["progress"] = ts.StateDict(step=99, lr=0.25)
+    mgr.save(1, s)
+    dest = _state(0.0)
+    step, pending = mgr.async_restore_latest(dest)
+    pending.wait()
+    assert step == 1
+    assert float(dest["params"].tree["w"][0, 0]) == 1.0
+    assert dest["progress"]["step"] == 99
+
+
+@multiprocess_test(nproc=2)
+def test_distributed_async_restore(pg) -> None:
+    import shutil
+
+    root = os.path.join(tempfile.gettempdir(), "dist-async-restore")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    state = {
+        "params": ts.PyTreeState({"w": jnp.full((8, 4), 3.0, jnp.float32)}),
+        "progress": ts.StateDict(rank_steps=10 + pg.rank),
+    }
+    ts.Snapshot.take(root, state, pg=pg, replicated=["params/**"])
+
+    dest = {
+        "params": ts.PyTreeState({"w": jnp.zeros((8, 4), jnp.float32)}),
+        "progress": ts.StateDict(rank_steps=-1),
+    }
+    pending = ts.Snapshot(root, pg=pg).async_restore(dest)
+    pending.wait()
+    assert float(dest["params"].tree["w"][1, 1]) == 3.0
+    assert dest["progress"]["rank_steps"] == 10 + pg.rank
+
+
+@multiprocess_test(nproc=2)
+def test_distributed_async_restore_asymmetric_keys(pg) -> None:
+    """Ranks holding plans for different key subsets must not diverge on
+    barrier counts (one barrier per gathered key, plan or no plan)."""
+    import shutil
+
+    root = os.path.join(tempfile.gettempdir(), "dist-async-asym")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    state = {
+        "progress": ts.StateDict(rank_steps=10 + pg.rank),
+    }
+    if pg.rank == 0:
+        state["extra"] = ts.StateDict(only_on_rank0=42)
+    ts.Snapshot.take(root, state, pg=pg)
+
+    dest = {"progress": ts.StateDict(rank_steps=-1)}
+    if pg.rank == 0:
+        dest["extra"] = ts.StateDict(only_on_rank0=-1)
+    pending = ts.Snapshot(root, pg=pg).async_restore(dest)
+    pending.wait()
+    assert dest["progress"]["rank_steps"] == 10 + pg.rank
+    if pg.rank == 0:
+        assert dest["extra"]["only_on_rank0"] == 42

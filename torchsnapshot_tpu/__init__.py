@@ -18,7 +18,7 @@ from .knobs import (
 )
 from .manager import CheckpointManager
 from .rng_state import RngState, RNGState
-from .snapshot import PendingSnapshot, Snapshot
+from .snapshot import PendingRestore, PendingSnapshot, Snapshot
 from .state_dict import PyTreeState, StateDict
 from .stateful import AppState, Stateful
 from .version import __version__
@@ -26,6 +26,7 @@ from .version import __version__
 __all__ = [
     "AppState",
     "CheckpointManager",
+    "PendingRestore",
     "PendingSnapshot",
     "PyTreeState",
     "Snapshot",

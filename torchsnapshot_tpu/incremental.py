@@ -388,9 +388,21 @@ class IncrementalTakeContext:
         if launch is None:
             return None
         digests: Dict[ChunkKey, str] = {}
-        for key, fut in launch.pending.items():
-            value = fut if isinstance(fut, tuple) else dd.materialize(fut)
-            digests[key] = dd.format_digest(value)
+        try:
+            for key, fut in launch.pending.items():
+                value = fut if isinstance(fut, tuple) else dd.materialize(fut)
+                digests[key] = dd.format_digest(value)
+        except Exception as e:  # noqa: BLE001 - digest is an optimization
+            # Device errors surface at materialize time, not dispatch time;
+            # the fail-soft contract of launch() applies here too — the
+            # leaf is simply written in full, without digests.
+            logger.warning(
+                "Digest materialization failed for %r (%r); leaf will be "
+                "written in full",
+                logical_path,
+                e,
+            )
+            return None
 
         refs: Dict[ChunkKey, Tuple[ArrayEntry, str]] = {}
         base_entry = self._base_available.get(logical_path)
@@ -476,13 +488,27 @@ class IncrementalTakeContext:
         from .integrity import load_checksum_tables
         from .storage_plugin import url_to_storage_plugin
 
+        # Fail-soft: every data blob and the manifest are already durable
+        # by the time this runs; a transient error reading the base's
+        # tables must degrade the referenced blobs to UNVERIFIED restores
+        # (with a warning), not fail the whole checkpoint.
+        base_table = None
         event_loop = asyncio.new_event_loop()
         try:
             storage = url_to_storage_plugin(self._base_path)
-            base_table = load_checksum_tables(
-                self._base_world_size, storage, event_loop
+            try:
+                base_table = load_checksum_tables(
+                    self._base_world_size, storage, event_loop
+                )
+            finally:
+                event_loop.run_until_complete(storage.close())
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "Could not inherit checksum tables from base %s (%r); "
+                "referenced blobs will restore UNVERIFIED",
+                self._base_path,
+                e,
             )
-            event_loop.run_until_complete(storage.close())
         finally:
             event_loop.close()
         if not base_table:

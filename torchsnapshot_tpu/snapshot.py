@@ -421,6 +421,64 @@ class Snapshot:
         finally:
             event_loop.close()
 
+    def async_restore(self, app_state: AppState) -> "PendingRestore":
+        """Pipelined restore: storage reads (and H2D placement) run on a
+        background thread; ``wait()`` applies the restored state dicts.
+
+        No reference counterpart (its restore is synchronous only). The
+        use case is TPU cold-start: restore I/O overlaps the train-step
+        compilation that dominates restore-to-step0, e.g.::
+
+            pending = snapshot.async_restore(app_state)
+            compiled = train_step.lower(state, batch).compile()  # overlaps
+            pending.wait()                                        # applies
+
+        State capture (``state_dict()``) and the read *planning* happen on
+        the calling thread before this returns — collectives stay on the
+        main thread, mirroring async_take's discipline (reference
+        snapshot.py:948) — so until ``wait()`` returns, the application's
+        jax leaves are untouched (fresh host buffers absorb the reads;
+        ``wait()`` re-raises background failures before applying anything,
+        leaving app state unmodified on error). In-place numpy leaves are
+        the exception: they are read into directly and must not be used
+        until ``wait()`` returns."""
+        _validate_app_state(app_state)
+        pg_wrapper = PGWrapper(self._pg_arg)
+        rank = pg_wrapper.get_rank()
+        available = get_manifest_for_rank(self.metadata, rank)
+        memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
+
+        rng_key_and_state = _pop_rng_state(app_state)
+        rng_key = rng_key_and_state[0] if rng_key_and_state else None
+        keys = _gather_keys(app_state, pg_wrapper)
+        # RNG applies last (same invariant as the sync path).
+        if rng_key in keys:
+            keys.remove(rng_key)
+            keys.append(rng_key)
+
+        plans: Dict[str, _StatefulLoadPlan] = {}
+        for key in keys:
+            stateful = app_state.get(key)
+            if stateful is not None:
+                plan = self._plan_stateful_load(
+                    key, stateful, available, memory_budget_bytes
+                )
+                if plan is not None:
+                    plans[key] = plan
+            # state_dict() may itself run collectives: keep the capture
+            # globally ordered (reference snapshot.py:353-370).
+            pg_wrapper.barrier()
+
+        return PendingRestore(
+            path=self.path,
+            keys=keys,
+            plans=plans,
+            pg_wrapper=pg_wrapper,
+            memory_budget_bytes=memory_budget_bytes,
+            rank=rank,
+            world_size=self.metadata.world_size,
+        )
+
     def _load_stateful(
         self,
         key: str,
@@ -435,6 +493,37 @@ class Snapshot:
         """Memory-frugal restore of one stateful: reuse the leaves already
         allocated in its current state dict as read destinations so peak
         footprint stays ~1x (reference snapshot.py:668-766)."""
+        plan = self._plan_stateful_load(
+            key, stateful, available, memory_budget_bytes
+        )
+        if plan is None:
+            return
+        read_reqs = plan.read_reqs
+        if knobs.is_batching_enabled():
+            from .batcher import batch_read_requests
+
+            read_reqs = batch_read_requests(read_reqs)
+        sync_execute_read_reqs(
+            read_reqs=read_reqs,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes,
+            rank=rank,
+            event_loop=event_loop,
+            checksum_table=checksum_table,
+        )
+        plan.finish_reads()
+        plan.apply()
+
+    def _plan_stateful_load(
+        self,
+        key: str,
+        stateful: Stateful,
+        available: Manifest,
+        memory_budget_bytes: int,
+    ) -> Optional["_StatefulLoadPlan"]:
+        """Pure planning for one stateful's restore: captures its current
+        state dict, picks/allocates read destinations, builds read
+        requests + deferred conversions. No storage I/O happens here."""
         from .flatten import _encode
 
         encoded_key = _encode(key)
@@ -445,7 +534,7 @@ class Snapshot:
         }
         if not entries:
             logger.warning("No entries found for stateful %r; skipping", key)
-            return
+            return None
 
         current_container_entries, current_flattened = flatten(
             stateful.state_dict(), prefix=key
@@ -500,26 +589,14 @@ class Snapshot:
                     )
                 )
 
-        if knobs.is_batching_enabled():
-            from .batcher import batch_read_requests
-
-            read_reqs = batch_read_requests(read_reqs)
-
-        sync_execute_read_reqs(
+        return _StatefulLoadPlan(
+            key=key,
+            stateful=stateful,
+            container_entries=container_entries,
+            restored=restored,
+            postprocess=postprocess,
             read_reqs=read_reqs,
-            storage=storage,
-            memory_budget_bytes=memory_budget_bytes,
-            rank=rank,
-            event_loop=event_loop,
-            checksum_table=checksum_table,
         )
-        for fn in postprocess:
-            fn()
-
-        state_dict = inflate(
-            {**container_entries}, restored, prefix=key
-        )
-        stateful.load_state_dict(state_dict)
 
     # ------------------------------------------------------------------
     # read_object
@@ -611,6 +688,42 @@ class Snapshot:
             return restored[result_path]
         finally:
             event_loop.close()
+
+
+class _StatefulLoadPlan:
+    """Planned restore of one stateful: read requests plus the deferred
+    work that turns completed reads into application state."""
+
+    def __init__(
+        self,
+        key: str,
+        stateful: Stateful,
+        container_entries: Manifest,
+        restored: Dict[str, Any],
+        postprocess: List[Callable[[], None]],
+        read_reqs: List[Any],
+    ) -> None:
+        self.key = key
+        self.stateful = stateful
+        self.container_entries = container_entries
+        self.restored = restored
+        self.postprocess = postprocess
+        self.read_reqs = read_reqs
+
+    def finish_reads(self) -> None:
+        """Run deferred conversions (np buffers -> device arrays on their
+        original shardings). Safe off the main thread: conversions only
+        ``device_put`` addressable data — no collectives."""
+        for fn in self.postprocess:
+            fn()
+
+    def apply(self) -> None:
+        """Hand the restored state dict to the application. May run
+        arbitrary user code (collectives included) — main thread only."""
+        state_dict = inflate(
+            {**self.container_entries}, self.restored, prefix=self.key
+        )
+        self.stateful.load_state_dict(state_dict)
 
 
 # ---------------------------------------------------------------------------
@@ -709,6 +822,114 @@ class PendingSnapshot:
         return snapshot
 
     def done(self) -> bool:
+        return self._done.is_set()
+
+
+class PendingRestore:
+    """Handle on an in-flight async restore (see Snapshot.async_restore).
+
+    The background thread runs only storage reads, deserialization, and
+    device placement of addressable data — never collectives (the same
+    rule the async-take commit thread follows, reference snapshot.py:948).
+    ``wait()`` joins it, re-raises any failure *before* touching app
+    state, then applies the restored state dicts on the calling thread in
+    globally-sorted key order with barriers in between (load_state_dict
+    may run collectives)."""
+
+    def __init__(
+        self,
+        path: str,
+        keys: List[str],
+        plans: Dict[str, _StatefulLoadPlan],
+        pg_wrapper: PGWrapper,
+        memory_budget_bytes: int,
+        rank: int,
+        world_size: int,
+    ) -> None:
+        import threading
+
+        self.path = path
+        self._keys = keys
+        self._plans = plans
+        self._pg = pg_wrapper
+        self._memory_budget_bytes = memory_budget_bytes
+        self._rank = rank
+        self._world_size = world_size
+        self._exc_info: Optional[BaseException] = None
+        self._applied = False
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run_reads, name="restore-reads", daemon=True
+        )
+        self._thread.start()
+
+    def _run_reads(self) -> None:
+        event_loop = asyncio.new_event_loop()
+        try:
+            storage = url_to_storage_plugin(self.path)
+            read_reqs = [
+                r for plan in self._plans.values() for r in plan.read_reqs
+            ]
+            if knobs.is_batching_enabled():
+                from .batcher import batch_read_requests
+
+                read_reqs = batch_read_requests(read_reqs)
+            checksum_table = None
+            if not knobs.is_checksums_disabled():
+                from .integrity import load_checksum_tables
+
+                checksum_table = load_checksum_tables(
+                    self._world_size, storage, event_loop
+                )
+            sync_execute_read_reqs(
+                read_reqs=read_reqs,
+                storage=storage,
+                memory_budget_bytes=self._memory_budget_bytes,
+                rank=self._rank,
+                event_loop=event_loop,
+                checksum_table=checksum_table,
+            )
+            for plan in self._plans.values():
+                plan.finish_reads()
+            event_loop.run_until_complete(storage.close())
+        except BaseException as e:  # noqa: BLE001 - must propagate via wait()
+            self._exc_info = e
+            logger.error("Async restore failed: %r", e)
+        finally:
+            event_loop.close()
+            self._done.set()
+
+    def wait(self) -> None:
+        """Block until reads finish, then apply the state dicts. Must be
+        called from the thread that owns collective ordering (the one
+        that called async_restore)."""
+        self._thread.join()
+        if self._exc_info is not None:
+            raise self._exc_info
+        if self._applied:
+            return
+        # One barrier per gathered KEY, plan or no plan: different ranks
+        # may hold plans for different keys (per-rank statefuls, elastic
+        # world-size changes), and a per-plan barrier count would diverge
+        # and deadlock. Mirrors the sync path (restore(): barrier after
+        # every key, whether or not this rank loaded it).
+        for key in self._keys:
+            plan = self._plans.get(key)
+            if plan is not None:
+                plan.apply()
+            # load_state_dict may run collectives; keep global order
+            # (reference snapshot.py:466-476 barrier discipline).
+            self._pg.barrier()
+        # Applied only if every plan succeeded: a raised apply leaves the
+        # handle un-applied, so a retried wait() re-applies from the start
+        # (deterministic) instead of silently succeeding half-restored.
+        self._applied = True
+        # Release the checkpoint-sized host buffers the plans hold; the
+        # handle itself may outlive the restore (done()-polling callers).
+        self._plans = {}
+
+    def done(self) -> bool:
+        """True once background reads finished (wait() will not block)."""
         return self._done.is_set()
 
 

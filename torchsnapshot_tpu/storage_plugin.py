@@ -17,6 +17,20 @@ from .io_types import StoragePlugin
 _ENTRY_POINT_GROUP = "torchsnapshot_tpu.storage_plugins"
 
 
+def normalize_object_key(prefix: str, path: str) -> str:
+    """Join an object-store prefix with a blob path, resolving any
+    parent-relative components lexically. Incremental snapshots reference
+    base-step blobs through ``../step_.../...`` locations; object keys
+    have no directory semantics, so ``..`` must be collapsed here (shared
+    by the s3 and gcs plugins so ref resolution cannot diverge)."""
+    key = f"{prefix}/{path}" if prefix else path
+    if ".." in path:
+        import posixpath
+
+        key = posixpath.normpath(key)
+    return key
+
+
 def _parse_url(url_path: str) -> Tuple[str, str]:
     if "://" in url_path:
         scheme, _, path = url_path.partition("://")

@@ -119,15 +119,9 @@ class GCSStoragePlugin(StoragePlugin):
     # ------------------------------------------------------------------
 
     def _blob_name(self, path: str) -> str:
-        name = f"{self.prefix}/{path}" if self.prefix else path
-        if ".." in path:
-            # Incremental snapshots reference base-step blobs through
-            # parent-relative locations (../step_.../...); object names
-            # have no directory semantics, so resolve them lexically.
-            import posixpath
+        from ..storage_plugin import normalize_object_key
 
-            name = posixpath.normpath(name)
-        return name
+        return normalize_object_key(self.prefix, path)
 
     def _upload_sync(self, path: str, data: bytes) -> None:
         blob = self._blob_name(path)

@@ -77,15 +77,9 @@ class S3StoragePlugin(StoragePlugin):
         self._retry = CollectiveProgressRetryStrategy()
 
     def _key(self, path: str) -> str:
-        key = f"{self.prefix}/{path}" if self.prefix else path
-        if ".." in path:
-            # Incremental snapshots reference base-step blobs through
-            # parent-relative locations (../step_.../...); object keys have
-            # no directory semantics, so resolve them lexically.
-            import posixpath
+        from ..storage_plugin import normalize_object_key
 
-            key = posixpath.normpath(key)
-        return key
+        return normalize_object_key(self.prefix, path)
 
     async def _get_client(self):
         # Lock so N concurrent first ops don't each enter a client context
