@@ -1,0 +1,123 @@
+"""Restore-to-step0 cold start: sync restore vs async restore overlapped
+with train-step compilation.
+
+The north-star breakdown (BENCH.md) shows a cold start is dominated by
+XLA compilation, with the checkpoint restore serialized before it. Async
+restore (Snapshot.async_restore) hides the restore I/O under the compile:
+
+    pending = snapshot.async_restore(app_state)   # reads stream in
+    compiled = step.lower(state, batch).compile()  # compile overlaps
+    pending.wait()                                 # apply
+
+Run each mode in a fresh process (jit caches would poison the compile
+timing):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/sharded_transformer/cold_start.py --mode sync
+    ... --mode async
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from benchmarks.common import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import torchsnapshot_tpu as ts  # noqa: E402
+from torchsnapshot_tpu.models import (  # noqa: E402
+    TransformerConfig,
+    init_train_state,
+    make_mesh,
+    make_train_step,
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["sync", "async"], required=True)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--snap", type=str, default=None,
+                   help="existing snapshot dir (created if absent)")
+    args = p.parse_args()
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=max(4, args.d_model // 64),
+        n_layers=args.layers,
+        d_ff=args.d_model * 4,
+    )
+    mesh = make_mesh()
+    tokens = jax.device_put(
+        np.random.default_rng(0)
+        .integers(0, cfg.vocab_size, (8, 128))
+        .astype(np.int32),
+        NamedSharding(mesh, P("dp", None)),
+    )
+
+    snap_dir = args.snap or os.path.join(
+        tempfile.gettempdir(), "ts-cold-start-snap"
+    )
+    if not os.path.exists(os.path.join(snap_dir, ".snapshot_metadata")):
+        shutil.rmtree(snap_dir, ignore_errors=True)
+        state = init_train_state(cfg, seed=7, mesh=mesh)
+        ts.Snapshot.take(snap_dir, {"train": ts.PyTreeState(state.as_pytree())})
+        print(f"(snapshot created at {snap_dir}; re-run for timing)")
+
+    t_start = time.perf_counter()
+    state = init_train_state(cfg, seed=0, mesh=mesh)
+    jax.block_until_ready(state.params)
+    t_init = time.perf_counter() - t_start
+    step_fn = make_train_step(cfg, mesh=mesh)
+    dest = ts.PyTreeState(state.as_pytree())
+
+    if args.mode == "sync":
+        t0 = time.perf_counter()
+        ts.Snapshot(snap_dir).restore({"train": dest})
+        t_restore = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = step_fn.lower(state, tokens).compile()
+        t_compile = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        pending = ts.Snapshot(snap_dir).async_restore({"train": dest})
+        compiled = step_fn.lower(state, tokens).compile()
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pending.wait()
+        t_restore = time.perf_counter() - t0  # the part NOT hidden
+
+    # Rebuild the train state around the restored pytree and take step 0.
+    from torchsnapshot_tpu.models.transformer import TrainState
+
+    restored = TrainState(
+        params=dest.tree["params"],
+        opt_state=dest.tree["opt_state"],
+        step=dest.tree["step"],
+        rng=dest.tree["rng"],
+    )
+    t0 = time.perf_counter()
+    new_state, loss = compiled(restored, tokens)
+    jax.block_until_ready(new_state.params)
+    t_step = time.perf_counter() - t0
+    total = time.perf_counter() - t_start
+
+    print(
+        f"mode={args.mode}: init {t_init:.2f}s, "
+        f"{'restore' if args.mode == 'sync' else 'restore-not-hidden'} "
+        f"{t_restore:.2f}s, compile {t_compile:.2f}s, step0 {t_step:.2f}s, "
+        f"TOTAL {total:.2f}s (loss {float(loss):.3f})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -209,3 +209,38 @@ def test_distributed_async_restore_asymmetric_keys(pg) -> None:
     assert dest["progress"]["rank_steps"] == 10 + pg.rank
     if pg.rank == 0:
         assert dest["extra"]["only_on_rank0"] == 42
+
+
+@multiprocess_test(nproc=2)
+def test_distributed_async_restore_rng_on_one_rank(pg) -> None:
+    """An RngState present on only one rank must not perturb the shared
+    barrier schedule (the RNG key keeps its sorted slot; only its apply
+    is deferred)."""
+    import shutil
+
+    root = os.path.join(tempfile.gettempdir(), "dist-async-rng")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    state = {
+        "aa": ts.StateDict(v=1 + pg.rank),
+        "zz": ts.StateDict(w=100 + pg.rank),
+    }
+    if pg.rank == 0:
+        state["mm_rng"] = ts.RngState(jax.random.key(3))
+    ts.Snapshot.take(root, state, pg=pg)
+
+    dest = {
+        "aa": ts.StateDict(v=-1),
+        "zz": ts.StateDict(w=-1),
+    }
+    if pg.rank == 0:
+        dest["mm_rng"] = ts.RngState(jax.random.key(9))
+    pending = ts.Snapshot(root, pg=pg).async_restore(dest)
+    pending.wait()
+    assert dest["aa"]["v"] == 1 + pg.rank
+    assert dest["zz"]["w"] == 100 + pg.rank
+    if pg.rank == 0:
+        np.testing.assert_array_equal(
+            jax.random.key_data(dest["mm_rng"].keys),
+            jax.random.key_data(jax.random.key(3)),
+        )

@@ -144,11 +144,16 @@ class LeafIncrementalPlan:
         return self._digests.get((tuple(offsets), tuple(sizes)))
 
 
-class _LeafLaunch:
-    """Digest futures for one leaf: chunk key -> jax future | host tuple."""
+class _DigestBatch:
+    """Digest work for one device group, dispatched as a single fused
+    program (device_digest.digest_many_async): per-chunk dispatch
+    round-trips dominate digest cost on real accelerators, so a take
+    issues O(device groups) dispatches, not O(chunks)."""
 
     def __init__(self) -> None:
-        self.pending: Dict[ChunkKey, Any] = {}
+        self.specs: List[Tuple[Any, Optional[Tuple[Tuple[int, int], ...]]]] = []
+        # Output-row mapping: one (logical_path, chunk_key) per digest row.
+        self.rows: List[Tuple[str, ChunkKey]] = []
 
 
 def _base_chunk_map(entry: Entry) -> Dict[ChunkKey, ArrayEntry]:
@@ -185,7 +190,16 @@ class IncrementalTakeContext:
         self._ref_prefix = ref_prefix
         self._base_path = base_path
         self._base_world_size = base_world_size
-        self._launches: Dict[str, _LeafLaunch] = {}
+        # logical_path -> ordered chunk keys (the leaf's digest layout);
+        # presence of a path means its digests were (or are being)
+        # computed — the analog of a "launch" having happened.
+        self._layouts: Dict[str, List[ChunkKey]] = {}
+        # (logical_path, chunk_key) -> (d1, d2); host digests land here at
+        # launch, device digests at first plan_for (materialization).
+        self._results: Dict[Tuple[str, ChunkKey], Tuple[int, int]] = {}
+        # In-flight device groups: (future, output-row mapping).
+        self._group_futs: List[Tuple[Any, List[Tuple[str, ChunkKey]]]] = []
+        self._materialized = False
         self._current_leaves: Dict[str, Any] = {}
         self._replicated_paths: Set[str] = set()
         # new (normalized) ref location -> base-manifest location
@@ -258,9 +272,12 @@ class IncrementalTakeContext:
             # Written bytes are a function of the hook, not the leaf;
             # digests of the leaf would lie.
             return
+        # Device digest work batches per device group — one fused dispatch
+        # per group instead of one round-trip per chunk.
+        batches: Dict[Tuple[int, ...], _DigestBatch] = {}
         for logical_path, leaf in flattened.items():
             try:
-                launch = self._launch_leaf(leaf)
+                self._collect_leaf(logical_path, leaf, batches)
             except Exception as e:  # noqa: BLE001 - digest is an optimization
                 logger.warning(
                     "Digest launch failed for %r (%r); leaf will be "
@@ -268,11 +285,37 @@ class IncrementalTakeContext:
                     logical_path,
                     e,
                 )
+                self._layouts.pop(logical_path, None)
+        for batch in batches.values():
+            if not batch.specs:
                 continue
-            if launch is not None:
-                self._launches[logical_path] = launch
+            try:
+                fut = dd.digest_many_async(batch.specs)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "Batched digest dispatch failed (%r); %d leaves will "
+                    "be written in full",
+                    e,
+                    len({p for p, _ in batch.rows}),
+                )
+                for p, _ in batch.rows:
+                    self._layouts.pop(p, None)
+                continue
+            self._group_futs.append((fut, batch.rows))
 
-    def _launch_leaf(self, leaf: Any) -> Optional[_LeafLaunch]:
+    @staticmethod
+    def _device_group(arr: Any) -> Tuple[int, ...]:
+        try:
+            return tuple(sorted(d.id for d in arr.devices()))
+        except Exception:  # noqa: BLE001 - uncommitted/odd arrays
+            return (-1,)
+
+    def _collect_leaf(
+        self,
+        logical_path: str,
+        leaf: Any,
+        batches: Dict[Tuple[int, ...], _DigestBatch],
+    ) -> None:
         from .io_preparer import (
             ChunkedArrayIOPreparer,
             PrimitivePreparer,
@@ -284,17 +327,17 @@ class IncrementalTakeContext:
         )
 
         if PrimitivePreparer.should_inline(leaf):
-            return None
+            return
         if is_sharded_array(leaf):
-            if not dd.digest_supported(leaf.dtype):
-                return None
-            return self._launch_sharded(leaf)
+            if dd.digest_supported(leaf.dtype):
+                self._collect_sharded(logical_path, leaf, batches)
+            return
         if not _is_dense_array(leaf) or not dd.digest_supported(leaf.dtype):
-            return None
+            return
 
-        launch = _LeafLaunch()
         shape = tuple(int(d) for d in leaf.shape)
-        # ``incremental=True`` sentinel: the launch's chunk layout must
+        keys: List[ChunkKey] = []
+        # ``incremental=True`` sentinel: the collected chunk layout must
         # equal what the preparers will use when handed a non-None plan.
         if ChunkedArrayIOPreparer.should_chunk(leaf, incremental=True):
             ranges = chunk_shapes(
@@ -303,47 +346,97 @@ class IncrementalTakeContext:
                 effective_max_chunk_size_bytes(True),
             )
             for start, stop in ranges:
-                key = (
-                    (start,) + tuple(0 for _ in shape[1:]),
-                    (stop - start,) + shape[1:],
-                )
-                if is_jax_array(leaf):
-                    launch.pending[key] = dd.digest_device_async(
-                        leaf, row_range=(start, stop)
+                keys.append(
+                    (
+                        (start,) + tuple(0 for _ in shape[1:]),
+                        (stop - start,) + shape[1:],
                     )
-                else:
-                    launch.pending[key] = dd.digest_host(
-                        np.asarray(leaf)[start:stop]
+                )
+            if is_jax_array(leaf):
+                batch = batches.setdefault(
+                    self._device_group(leaf), _DigestBatch()
+                )
+                batch.specs.append((leaf, tuple(ranges)))
+                batch.rows.extend((logical_path, k) for k in keys)
+            else:
+                host = np.asarray(leaf)
+                for (start, stop), key in zip(ranges, keys):
+                    self._results[(logical_path, key)] = dd.digest_host(
+                        host[start:stop]
                     )
         else:
             key = (tuple(0 for _ in shape), shape)
+            keys.append(key)
             if is_jax_array(leaf):
-                launch.pending[key] = dd.digest_device_async(leaf)
+                batch = batches.setdefault(
+                    self._device_group(leaf), _DigestBatch()
+                )
+                batch.specs.append((leaf, None))
+                batch.rows.append((logical_path, key))
             else:
-                launch.pending[key] = dd.digest_host(np.asarray(leaf))
-        return launch
+                self._results[(logical_path, key)] = dd.digest_host(
+                    np.asarray(leaf)
+                )
+        self._layouts[logical_path] = keys
 
-    def _launch_sharded(self, leaf: Any) -> _LeafLaunch:
+    def _collect_sharded(
+        self,
+        logical_path: str,
+        leaf: Any,
+        batches: Dict[Tuple[int, ...], _DigestBatch],
+    ) -> None:
         from .io_preparer import effective_max_shard_size_bytes
         from .parallel.overlap import Box, subdivide_box
 
-        launch = _LeafLaunch()
         itemsize = np.dtype(leaf.dtype).itemsize
         max_shard = effective_max_shard_size_bytes(True)
+        keys: List[ChunkKey] = []
         for dev_shard in leaf.addressable_shards:
             if dev_shard.replica_id != 0:
                 continue
             box = Box.from_index(dev_shard.index, leaf.shape)
+            shard_keys: List[ChunkKey] = []
+            shard_ranges: List[Tuple[int, int]] = []
+            whole = True
             for piece in subdivide_box(box, max_shard, itemsize):
                 key = (tuple(piece.offsets), tuple(piece.sizes))
-                row_range = None
-                if piece != box:
-                    row0 = piece.offsets[0] - box.offsets[0]
-                    row_range = (row0, row0 + piece.sizes[0])
-                launch.pending[key] = dd.digest_device_async(
-                    dev_shard.data, row_range=row_range
+                shard_keys.append(key)
+                row0 = piece.offsets[0] - box.offsets[0]
+                shard_ranges.append((row0, row0 + piece.sizes[0]))
+                whole = whole and piece == box
+            batch = batches.setdefault(
+                self._device_group(dev_shard.data), _DigestBatch()
+            )
+            batch.specs.append(
+                (dev_shard.data, None if whole else tuple(shard_ranges))
+            )
+            batch.rows.extend((logical_path, k) for k in shard_keys)
+            keys.extend(shard_keys)
+        if keys:
+            self._layouts[logical_path] = keys
+
+    def _materialize_all(self) -> None:
+        """Block on every device group's digest future (first plan_for
+        call). A failed group degrades its leaves to full writes."""
+        if self._materialized:
+            return
+        self._materialized = True
+        for fut, rows in self._group_futs:
+            try:
+                values = dd.materialize_many(fut)
+            except Exception as e:  # noqa: BLE001 - digest is an optimization
+                logger.warning(
+                    "Digest materialization failed (%r); %d leaves will "
+                    "be written in full",
+                    e,
+                    len({p for p, _ in rows}),
                 )
-        return launch
+                for p, _ in rows:
+                    self._layouts.pop(p, None)
+                continue
+            for (path, key), row in zip(rows, values):
+                self._results[(path, key)] = (int(row[0]), int(row[1]))
+        self._group_futs = []
 
     # ------------------------------------------------------------------
     # cross-rank agreement
@@ -363,9 +456,12 @@ class IncrementalTakeContext:
         self._replicated_paths = set(replicated_paths)
         if pg_wrapper.get_world_size() == 1:
             return
+        # Materialize before gathering so late (materialize-time) digest
+        # failures are part of the agreement, not a divergence after it.
+        self._materialize_all()
         local = (
             self._ref_prefix is not None,
-            sorted(p for p in self._launches if p in replicated_paths),
+            sorted(p for p in self._layouts if p in replicated_paths),
         )
         gathered = pg_wrapper.all_gather_object(local)
         if not all(has_base for has_base, _ in gathered):
@@ -375,34 +471,27 @@ class IncrementalTakeContext:
         common = set(gathered[0][1])
         for _, launched in gathered[1:]:
             common &= set(launched)
-        for path in list(self._launches):
+        for path in list(self._layouts):
             if path in replicated_paths and path not in common:
-                del self._launches[path]
+                del self._layouts[path]
 
     # ------------------------------------------------------------------
     # pass 2: materialize + compare
     # ------------------------------------------------------------------
 
     def plan_for(self, logical_path: str) -> Optional[LeafIncrementalPlan]:
-        launch = self._launches.get(logical_path)
-        if launch is None:
+        if logical_path not in self._layouts:
+            return None
+        self._materialize_all()
+        keys = self._layouts.get(logical_path)
+        if keys is None:  # group failed during materialization
             return None
         digests: Dict[ChunkKey, str] = {}
-        try:
-            for key, fut in launch.pending.items():
-                value = fut if isinstance(fut, tuple) else dd.materialize(fut)
-                digests[key] = dd.format_digest(value)
-        except Exception as e:  # noqa: BLE001 - digest is an optimization
-            # Device errors surface at materialize time, not dispatch time;
-            # the fail-soft contract of launch() applies here too — the
-            # leaf is simply written in full, without digests.
-            logger.warning(
-                "Digest materialization failed for %r (%r); leaf will be "
-                "written in full",
-                logical_path,
-                e,
-            )
-            return None
+        for key in keys:
+            value = self._results.get((logical_path, key))
+            if value is None:
+                return None
+            digests[key] = dd.format_digest(value)
 
         refs: Dict[ChunkKey, Tuple[ArrayEntry, str]] = {}
         base_entry = self._base_available.get(logical_path)
@@ -495,20 +584,30 @@ class IncrementalTakeContext:
         base_table = None
         event_loop = asyncio.new_event_loop()
         try:
-            storage = url_to_storage_plugin(self._base_path)
             try:
-                base_table = load_checksum_tables(
-                    self._base_world_size, storage, event_loop
+                storage = url_to_storage_plugin(self._base_path)
+                try:
+                    base_table = load_checksum_tables(
+                        self._base_world_size, storage, event_loop
+                    )
+                finally:
+                    try:
+                        event_loop.run_until_complete(storage.close())
+                    except Exception as close_exc:  # noqa: BLE001
+                        # Close failures don't affect the already-loaded
+                        # tables — inheritance proceeds normally.
+                        logger.warning(
+                            "Error closing base storage plugin after "
+                            "checksum inheritance: %r",
+                            close_exc,
+                        )
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "Could not inherit checksum tables from base %s (%r); "
+                    "referenced blobs will restore UNVERIFIED",
+                    self._base_path,
+                    e,
                 )
-            finally:
-                event_loop.run_until_complete(storage.close())
-        except Exception as e:  # noqa: BLE001
-            logger.warning(
-                "Could not inherit checksum tables from base %s (%r); "
-                "referenced blobs will restore UNVERIFIED",
-                self._base_path,
-                e,
-            )
         finally:
             event_loop.close()
         if not base_table:

@@ -363,7 +363,7 @@ class Snapshot:
         (repeated read_object calls must not re-read every rank's table)."""
         if self._checksum_table_cache is False:
             self._checksum_table_cache = _get_checksum_table_impl(
-                self.metadata, storage, event_loop
+                self.metadata.world_size, storage, event_loop
             )
         return self._checksum_table_cache
 
@@ -450,11 +450,12 @@ class Snapshot:
 
         rng_key_and_state = _pop_rng_state(app_state)
         rng_key = rng_key_and_state[0] if rng_key_and_state else None
+        # The key list (and hence the barrier schedule) must be identical
+        # on every rank; the RNG key is rank-local knowledge, so it keeps
+        # its sorted slot here and only its *apply* is deferred (to last,
+        # after all barriers — RngState application is collective-free),
+        # exactly like the sync path.
         keys = _gather_keys(app_state, pg_wrapper)
-        # RNG applies last (same invariant as the sync path).
-        if rng_key in keys:
-            keys.remove(rng_key)
-            keys.append(rng_key)
 
         plans: Dict[str, _StatefulLoadPlan] = {}
         for key in keys:
@@ -477,6 +478,7 @@ class Snapshot:
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
             world_size=self.metadata.world_size,
+            rng_key=rng_key,
         )
 
     def _load_stateful(
@@ -845,12 +847,14 @@ class PendingRestore:
         memory_budget_bytes: int,
         rank: int,
         world_size: int,
+        rng_key: Optional[str] = None,
     ) -> None:
         import threading
 
         self.path = path
         self._keys = keys
         self._plans = plans
+        self._rng_key = rng_key
         self._pg = pg_wrapper
         self._memory_budget_bytes = memory_budget_bytes
         self._rank = rank
@@ -874,13 +878,9 @@ class PendingRestore:
                 from .batcher import batch_read_requests
 
                 read_reqs = batch_read_requests(read_reqs)
-            checksum_table = None
-            if not knobs.is_checksums_disabled():
-                from .integrity import load_checksum_tables
-
-                checksum_table = load_checksum_tables(
-                    self._world_size, storage, event_loop
-                )
+            checksum_table = _get_checksum_table_impl(
+                self._world_size, storage, event_loop
+            )
             sync_execute_read_reqs(
                 read_reqs=read_reqs,
                 storage=storage,
@@ -902,9 +902,20 @@ class PendingRestore:
     def wait(self) -> None:
         """Block until reads finish, then apply the state dicts. Must be
         called from the thread that owns collective ordering (the one
-        that called async_restore)."""
+        that called async_restore).
+
+        Failure semantics match the sync restore: a rank whose reads (or
+        applies) failed raises without completing the barrier schedule,
+        and its peers block in their next barrier until the store barrier
+        times out or the job runtime tears the world down — a failed
+        distributed restore is fatal to the job, not recoverable
+        per-rank."""
         self._thread.join()
         if self._exc_info is not None:
+            # State was never applied; the read buffers are useless.
+            # Release them before raising (the handle may be kept for
+            # diagnostics, and a retry will allocate its own).
+            self._plans = {}
             raise self._exc_info
         if self._applied:
             return
@@ -912,14 +923,21 @@ class PendingRestore:
         # may hold plans for different keys (per-rank statefuls, elastic
         # world-size changes), and a per-plan barrier count would diverge
         # and deadlock. Mirrors the sync path (restore(): barrier after
-        # every key, whether or not this rank loaded it).
+        # every key, whether or not this rank loaded it). The RNG plan is
+        # skipped here — its key is rank-local knowledge, so it must not
+        # perturb the shared schedule — and applied after all barriers
+        # (RngState application is collective-free), the sync path's
+        # restore-RNG-last invariant.
         for key in self._keys:
             plan = self._plans.get(key)
-            if plan is not None:
+            if plan is not None and key != self._rng_key:
                 plan.apply()
             # load_state_dict may run collectives; keep global order
             # (reference snapshot.py:466-476 barrier discipline).
             self._pg.barrier()
+        rng_plan = self._plans.get(self._rng_key) if self._rng_key else None
+        if rng_plan is not None:
+            rng_plan.apply()
         # Applied only if every plan succeeded: a raised apply leaves the
         # handle un-applied, so a retried wait() re-applies from the start
         # (deterministic) instead of silently succeeding half-restored.
@@ -1097,7 +1115,7 @@ def _gather_manifest(rank_manifest: Manifest, pg_wrapper: PGWrapper) -> Manifest
 
 
 def _get_checksum_table_impl(
-    metadata: SnapshotMetadata,
+    world_size: int,
     storage: StoragePlugin,
     event_loop: asyncio.AbstractEventLoop,
 ):
@@ -1107,7 +1125,7 @@ def _get_checksum_table_impl(
         return None
     from .integrity import load_checksum_tables
 
-    return load_checksum_tables(metadata.world_size, storage, event_loop)
+    return load_checksum_tables(world_size, storage, event_loop)
 
 
 def _maybe_write_checksum_table(
