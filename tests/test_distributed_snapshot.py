@@ -221,3 +221,36 @@ def PGWrapper_bcast(pg, value):
     from torchsnapshot_tpu.pg_wrapper import PGWrapper
 
     return PGWrapper(pg).broadcast_object(value)
+
+
+@multiprocess_test(nproc=2)
+def test_take_rng_on_one_rank_keeps_barrier_schedule(pg) -> None:
+    """An RngState present on only one rank must not reorder the gathered
+    key list at take time (the RNG capture happens out of band; its key
+    keeps its sorted barrier slot). Regression: rng_first used to move
+    the key to the front on the holding rank only."""
+    import jax
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "dist-take-rng-asym")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    state = {
+        "aa": ts.StateDict(v=pg.rank),
+        "zz": ts.StateDict(w=10 + pg.rank),
+    }
+    if pg.rank == 0:
+        state["mm_rng"] = ts.RngState(jax.random.key(5))
+    snap = ts.Snapshot.take(path, state, pg=pg)
+    md = snap.metadata
+    assert "0/mm_rng/keys" in md.manifest
+    assert "1/aa/v" in md.manifest
+
+    dest = {"aa": ts.StateDict(v=-1), "zz": ts.StateDict(w=-1)}
+    if pg.rank == 0:
+        dest["mm_rng"] = ts.RngState(jax.random.key(9))
+    ts.Snapshot(path, pg=pg).restore(dest)
+    assert dest["aa"]["v"] == pg.rank
+    assert dest["zz"]["w"] == 10 + pg.rank

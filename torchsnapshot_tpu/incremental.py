@@ -305,10 +305,9 @@ class IncrementalTakeContext:
 
     @staticmethod
     def _device_group(arr: Any) -> Tuple[int, ...]:
-        try:
-            return tuple(sorted(d.id for d in arr.devices()))
-        except Exception:  # noqa: BLE001 - uncommitted/odd arrays
-            return (-1,)
+        from .ops.device_pack import device_group_key
+
+        return device_group_key(arr)
 
     def _collect_leaf(
         self,

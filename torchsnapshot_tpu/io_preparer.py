@@ -127,11 +127,34 @@ class ArrayBufferStager(BufferStager):
         self.is_async_snapshot = is_async_snapshot
         self.slc = slc
         self.array_prepare_func = array_prepare_func
-        if is_jax_array(arr) and slc is None:
+        if is_jax_array(arr) and slc is None and not self._may_device_pack():
             try:
                 arr.copy_to_host_async()
             except Exception:
                 pass  # prefetch is best-effort; np.asarray below still works
+
+    def _may_device_pack(self) -> bool:
+        """True when this array will likely land in a device-packed slab
+        (batching + device-pack on, pack-capable dtype, below the slab
+        threshold): its bytes then leave the device inside the slab's
+        single packed transfer, and a per-member prefetch here would pay
+        that D2H twice. (Residual: an array that ends up *alone* in its
+        device group still stages individually without the prefetch —
+        unknowable at prepare time, and bounded at one cold transfer per
+        device.)"""
+        if (
+            self.array_prepare_func is not None
+            or not knobs.is_batching_enabled()
+            or not knobs.is_device_pack_enabled()
+        ):
+            return False
+        from .ops.device_pack import pack_supported
+
+        if not pack_supported(self.arr.dtype):
+            return False
+        return (
+            self.get_staging_cost_bytes() < knobs.get_slab_size_threshold_bytes()
+        )
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         # Tiny host-resident leaves (torchrec-style 1e5-leaf manifests are

@@ -24,6 +24,7 @@ _STAGING_THREADS_ENV = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _DISABLE_CHECKSUMS_ENV = "TORCHSNAPSHOT_TPU_DISABLE_CHECKSUMS"
 _S3_ENDPOINT_URL_ENV = "TORCHSNAPSHOT_TPU_S3_ENDPOINT"
 _INCREMENTAL_CHUNK_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_INCREMENTAL_CHUNK_BYTES"
+_DEVICE_PACK_ENV = "TORCHSNAPSHOT_TPU_DEVICE_PACK"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -91,6 +92,17 @@ def is_checksums_disabled() -> bool:
     """Blob CRC recording (take) and verification (restore) are on by
     default; presence of the env var disables both."""
     return _DISABLE_CHECKSUMS_ENV in os.environ
+
+
+def is_device_pack_enabled() -> bool:
+    """Opt-in: slab members resident on device are packed into one uint8
+    buffer by a fused XLA program and leave via a single D2H transfer
+    (the reference's GPU-slab analog). Pays when per-transfer overhead
+    dominates (very many tiny leaves, high per-call-latency hosts);
+    measured slower than prefetched per-member transfers on links that
+    pipeline small async copies well — hence off by default, like
+    batching itself."""
+    return _DEVICE_PACK_ENV in os.environ
 
 
 def get_incremental_chunk_size_bytes() -> int:
@@ -161,4 +173,10 @@ def override_incremental_chunk_size_bytes(
     nbytes: int,
 ) -> Generator[None, None, None]:
     with _override_env(_INCREMENTAL_CHUNK_SIZE_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_device_pack() -> Generator[None, None, None]:
+    with _override_env(_DEVICE_PACK_ENV, "1"):
         yield

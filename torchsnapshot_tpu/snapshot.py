@@ -216,13 +216,28 @@ class Snapshot:
         # RNG first: capturing other statefuls must not perturb what gets
         # saved as the RNG state (reference invariant snapshot.py:340-346).
         # With explicit jax keys nothing mutates behind our back, but
-        # .state_dict() of arbitrary statefuls may consume entropy.
+        # .state_dict() of arbitrary statefuls may consume entropy. The
+        # capture is collective-free and happens HERE, out of band; the
+        # RNG key keeps its *sorted* slot in the barriered loop below —
+        # which key is the RNG one is rank-local knowledge, so reordering
+        # the loop by it would diverge the barrier/collective schedule on
+        # ranks that lack (or name differently) the RngState.
         rng_key_and_state = _pop_rng_state(app_state)
+        rng_capture = None
+        if rng_key_and_state is not None:
+            rng_key, rng_stateful = rng_key_and_state
+            rng_capture = flatten(rng_stateful.state_dict(), prefix=rng_key)
         flattened_global: Dict[str, Any] = {}
         rank_manifest: Manifest = {}
 
-        keys = _gather_keys(app_state, pg_wrapper, rng_first=rng_key_and_state)
+        keys = _gather_keys(app_state, pg_wrapper)
         for key in keys:
+            if rng_key_and_state is not None and key == rng_key_and_state[0]:
+                container_entries, flattened = rng_capture
+                pg_wrapper.barrier()
+                rank_manifest.update(container_entries)
+                flattened_global.update(flattened)
+                continue
             stateful = app_state.get(key)
             if stateful is None:
                 pg_wrapper.barrier()
@@ -993,17 +1008,14 @@ def _pop_rng_state(app_state: AppState) -> Optional[Tuple[str, RngState]]:
 def _gather_keys(
     app_state: AppState,
     pg_wrapper: PGWrapper,
-    rng_first: Optional[Tuple[str, RngState]] = None,
 ) -> List[str]:
     """Sorted union of app-state keys across ranks (reference
-    snapshot.py:851-856); the RNG key, if any, is moved to the front."""
+    snapshot.py:851-856). Deliberately *never* reordered by rank-local
+    facts (e.g. which key holds the RngState): the list defines the
+    barrier/collective schedule and must be identical on every rank."""
     local_keys = list(app_state.keys())
     gathered = pg_wrapper.all_gather_object(local_keys)
-    keys = sorted({k for ks in gathered for k in ks})
-    if rng_first is not None and rng_first[0] in keys:
-        keys.remove(rng_first[0])
-        keys.insert(0, rng_first[0])
-    return keys
+    return sorted({k for ks in gathered for k in ks})
 
 
 def _coalesce_replicated(
