@@ -83,3 +83,56 @@ def test_1e5_leaf_read_object(tmp_path, big_tree) -> None:
         t_read = time.perf_counter() - t0
     np.testing.assert_array_equal(val, np.full((4,), 7123 % 97, np.float32))
     assert t_read < 30, f"read_object took {t_read:.1f}s"
+
+
+@pytest.mark.slow
+def test_1e5_leaf_incremental_take(tmp_path, big_tree) -> None:
+    """Digest-enabled takes must stay in the same time class at 1e5
+    leaves (host digests of tiny leaves are vectorized numpy, not
+    per-leaf device dispatches), and an unchanged-state incremental take
+    must skip essentially all data bytes while planning in seconds."""
+    p0 = str(tmp_path / "step_0")
+    p1 = str(tmp_path / "step_1")
+    with enable_batching():
+        t0 = time.perf_counter()
+        ts.Snapshot.take(
+            p0, {"emb": ts.PyTreeState(big_tree)}, record_digests=True
+        )
+        t_base = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        ts.Snapshot.take(
+            p1, {"emb": ts.PyTreeState(big_tree)}, incremental_base=p0
+        )
+        t_incr = time.perf_counter() - t0
+
+    # Data bytes: step 1 should hold (almost) none — every leaf refs the
+    # base. Only metadata/checksums remain.
+    data_bytes = 0
+    for dirpath, _, files in os.walk(p1):
+        for f in files:
+            if f.startswith(".snapshot_metadata") or "checksums" in dirpath:
+                continue
+            data_bytes += os.path.getsize(os.path.join(dirpath, f))
+    assert data_bytes == 0, f"{data_bytes} unexpected data bytes"
+
+    manifest = json.load(open(os.path.join(p1, ".snapshot_metadata")))[
+        "manifest"
+    ]
+    refs = sum(
+        1
+        for e in manifest.values()
+        if isinstance(e.get("location"), str)
+        and e["location"].startswith("../")
+    )
+    assert refs >= N_LEAVES
+
+    dst = {k: np.zeros((4,), np.float32) for k in big_tree}
+    wrapped = ts.PyTreeState(dst)
+    ts.Snapshot(p1).restore({"emb": wrapped})
+    np.testing.assert_array_equal(
+        wrapped.tree["table_5/row_500"], np.full((4,), 5500 % 97, np.float32)
+    )
+    # Same generous CI bounds as the plain take.
+    assert t_base < 90, f"digest-enabled base take took {t_base:.1f}s"
+    assert t_incr < 60, f"incremental take took {t_incr:.1f}s"

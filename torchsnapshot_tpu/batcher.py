@@ -209,19 +209,35 @@ class BatchedBufferStager(BufferStager):
             loop.run_in_executor(executor, self._pack_group_sync, items, view)
             for items in packed
         ]
-        for req, offset, size in rest:
-            buf = await req.buffer_stager.stage_buffer(executor)
-            # Large members copy with the multithreaded native memcpy;
-            # small ones aren't worth the thread spawn.
-            if size >= (8 << 20):
-                mv = memoryview(buf)
-                if mv.format != "B" or mv.ndim != 1:
-                    mv = mv.cast("B")
-                if len(mv) == size and _native.gather_memcpy(
-                    slab, [(mv, offset)], n_threads=4
-                ):
-                    continue
-            self._copy_member(view, buf, req, offset, size)
+        try:
+            for req, offset, size in rest:
+                buf = await req.buffer_stager.stage_buffer(executor)
+                # Large members copy with the multithreaded native memcpy;
+                # small ones aren't worth the thread spawn.
+                if size >= (8 << 20):
+                    mv = memoryview(buf)
+                    if mv.format != "B" or mv.ndim != 1:
+                        mv = mv.cast("B")
+                    if len(mv) == size and _native.gather_memcpy(
+                        slab, [(mv, offset)], n_threads=4
+                    ):
+                        continue
+                self._copy_member(view, buf, req, offset, size)
+        except BaseException:
+            # Pack threads hold the slab's exported memoryview and may
+            # still be writing into it: they MUST settle before the slab
+            # is abandoned (bytearray deallocation with exported views
+            # aborts the interpreter). Their own failures are secondary
+            # to the one already propagating.
+            for fut in pack_futures:
+                try:
+                    await fut
+                except Exception as pack_exc:  # noqa: BLE001
+                    logger.warning(
+                        "Device pack failed while aborting slab staging: %r",
+                        pack_exc,
+                    )
+            raise
         for fut in pack_futures:
             await fut
         return slab
@@ -231,6 +247,11 @@ class BatchedBufferStager(BufferStager):
             (req.buffer_stager.get_staging_cost_bytes() for req, _, _ in self.members),
             default=0,
         )
+        if knobs.is_device_pack_enabled():
+            # The pack path transiently holds a group's packed host buffer
+            # (up to ~total bytes) alongside the slab before the scatter;
+            # admit at the true peak so the scheduler's budget holds.
+            return 2 * self.total
         return self.total + peak_member
 
 

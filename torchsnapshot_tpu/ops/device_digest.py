@@ -56,12 +56,25 @@ DIGEST_PREFIX = "mlh64:"
 # ---------------------------------------------------------------------------
 
 
-def digest_supported(dtype: Any) -> bool:
-    """True when the dtype's memory image can be digested: fixed-width,
-    byte-aligned, non-complex. Complex dtypes are excluded (device bitcast
-    of interleaved re/im pairs is not uniformly available); sub-byte
-    dtypes (int4/uint4, packed bool planes) are excluded because their
-    lane view is framework-specific."""
+# Sub-byte dtypes report itemsize 1 through np.dtype but cannot be
+# bitcast to uint8 lanes on device. One list, shared with
+# ops/device_pack.py so pack and digest can never disagree on
+# device-eligibility.
+SUB_BYTE_DTYPE_NAMES: Tuple[str, ...] = (
+    "int4",
+    "uint4",
+    "int2",
+    "uint2",
+    "float4_e2m1fn",
+)
+
+
+def bitcastable_dtype(dtype: Any) -> bool:
+    """True when the dtype's memory image has a uint8-lane view usable on
+    device: fixed-width, byte-aligned, non-complex. Complex dtypes are
+    excluded (device bitcast of interleaved re/im pairs is not uniformly
+    available); sub-byte dtypes because their lane view is
+    framework-specific."""
     try:
         dt = np.dtype(dtype)
     except TypeError:
@@ -70,11 +83,15 @@ def digest_supported(dtype: Any) -> bool:
         return False
     if dt.kind == "c" or dt.hasobject:
         return False
-    # Sub-byte dtypes report itemsize 1 through np.dtype but cannot be
-    # bitcast to uint8 lanes on device; exclude them by name.
-    if dt.name in ("int4", "uint4", "int2", "uint2", "float4_e2m1fn"):
+    return dt.name not in SUB_BYTE_DTYPE_NAMES
+
+
+def digest_supported(dtype: Any) -> bool:
+    """Digestable = bitcastable with a power-of-two lane-splittable
+    itemsize."""
+    if not bitcastable_dtype(dtype):
         return False
-    return dt.itemsize in (1, 2, 4, 8)
+    return np.dtype(dtype).itemsize in (1, 2, 4, 8)
 
 
 def _lane_dtype(itemsize: int) -> np.dtype:

@@ -24,19 +24,12 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-# Sub-byte dtypes have no uint8 lane view; complex bitcasts aren't
-# uniformly available. Mirrors device_digest's exclusions.
-_UNPACKABLE_DTYPE_NAMES = ("int4", "uint4", "int2", "uint2", "float4_e2m1fn")
-
-
 def pack_supported(dtype: Any) -> bool:
-    try:
-        dt = np.dtype(dtype)
-    except TypeError:
-        return False
-    if dt.kind == "c" or dt.hasobject:
-        return False
-    return dt.name not in _UNPACKABLE_DTYPE_NAMES
+    """Packable = has a uint8-lane device view (the same eligibility rule
+    the digest module uses, shared so the two can never drift)."""
+    from .device_digest import bitcastable_dtype
+
+    return bitcastable_dtype(dtype)
 
 
 def _as_u8_flat(x):
