@@ -209,6 +209,13 @@ class BatchedBufferStager(BufferStager):
             loop.run_in_executor(executor, self._pack_group_sync, items, view)
             for items in packed
         ]
+        # Every pack future MUST settle before this method returns or
+        # raises, no matter which one fails first: the executor threads
+        # hold the slab's exported memoryview and may still be writing
+        # into it (bytearray deallocation with exported views aborts the
+        # interpreter). Collect the first failure — from the rest loop or
+        # any pack — settle everything, then raise it.
+        first_exc: Optional[BaseException] = None
         try:
             for req, offset, size in rest:
                 buf = await req.buffer_stager.stage_buffer(executor)
@@ -223,23 +230,21 @@ class BatchedBufferStager(BufferStager):
                     ):
                         continue
                 self._copy_member(view, buf, req, offset, size)
-        except BaseException:
-            # Pack threads hold the slab's exported memoryview and may
-            # still be writing into it: they MUST settle before the slab
-            # is abandoned (bytearray deallocation with exported views
-            # aborts the interpreter). Their own failures are secondary
-            # to the one already propagating.
-            for fut in pack_futures:
-                try:
-                    await fut
-                except Exception as pack_exc:  # noqa: BLE001
+        except BaseException as e:  # noqa: BLE001 - settle packs first
+            first_exc = e
+        for fut in pack_futures:
+            try:
+                await fut
+            except BaseException as pack_exc:  # noqa: BLE001
+                if first_exc is None:
+                    first_exc = pack_exc
+                else:
                     logger.warning(
                         "Device pack failed while aborting slab staging: %r",
                         pack_exc,
                     )
-            raise
-        for fut in pack_futures:
-            await fut
+        if first_exc is not None:
+            raise first_exc
         return slab
 
     def get_staging_cost_bytes(self) -> int:
@@ -247,12 +252,16 @@ class BatchedBufferStager(BufferStager):
             (req.buffer_stager.get_staging_cost_bytes() for req, _, _ in self.members),
             default=0,
         )
-        if knobs.is_device_pack_enabled():
-            # The pack path transiently holds a group's packed host buffer
-            # (up to ~total bytes) alongside the slab before the scatter;
-            # admit at the true peak so the scheduler's budget holds.
-            return 2 * self.total
-        return self.total + peak_member
+        # The pack path transiently holds each group's packed host buffer
+        # alongside the slab before the scatter, and groups run
+        # concurrently — admit at the true peak so the scheduler's budget
+        # holds. Computed from the actual split (a slab with no
+        # pack-eligible members costs the same as with the knob off).
+        packed, _ = self._split_device_groups()
+        pack_bytes = sum(
+            size for items in packed for _, _, size in items
+        )
+        return self.total + max(peak_member, pack_bytes)
 
 
 def batch_write_requests(
