@@ -81,3 +81,62 @@ def test_jax_coordination_store() -> None:
         _jax_coordination_worker, nproc=1, args=(coord_port,), port=store_port
     )
     assert isinstance(counters_ok, bool)
+
+
+def _jax_dist2_worker(pg, coord_port: int, root: str):
+    """A genuine 2-process jax.distributed job: the coordination service
+    carries ALL snapshot metadata traffic (key gathers, replication
+    verification, partitioning, manifest gather, commit barrier)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coord_port}",
+        num_processes=2,
+        process_id=pg.rank,
+    )
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.dist_store import jax_process_group
+
+    jpg = jax_process_group()
+    assert jpg.world_size == 2 and jpg.rank == pg.rank
+
+    state = {
+        "shared": ts.PyTreeState({"w": np.full((64, 4), 2.5, np.float32)}),
+        "mine": ts.StateDict(rank_val=40 + pg.rank),
+    }
+    snap = ts.Snapshot.take(
+        root, state, pg=jpg, replicated=["shared/**"]
+    )
+    md = snap.metadata
+    assert md.world_size == 2
+    assert md.manifest["0/shared/w"].replicated
+    assert "1/shared/w" not in md.manifest
+
+    dst = {
+        "shared": ts.PyTreeState({"w": np.zeros((64, 4), np.float32)}),
+        "mine": ts.StateDict(rank_val=-1),
+    }
+    ts.Snapshot(root, pg=jpg).restore(dst)
+    assert float(dst["shared"].tree["w"][3, 3]) == 2.5
+    assert dst["mine"]["rank_val"] == 40 + pg.rank
+    return True
+
+
+def test_two_process_jax_distributed_snapshot(tmp_path) -> None:
+    import socket
+
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(("127.0.0.1", 0))
+        s2.bind(("127.0.0.1", 0))
+        coord_port = s1.getsockname()[1]
+        store_port = s2.getsockname()[1]
+
+    results = run_multiprocess(
+        _jax_dist2_worker,
+        nproc=2,
+        args=(coord_port, str(tmp_path / "snap")),
+        port=store_port,
+    )
+    assert results == [True, True]

@@ -253,15 +253,16 @@ class BatchedBufferStager(BufferStager):
             default=0,
         )
         # The pack path transiently holds each group's packed host buffer
-        # alongside the slab before the scatter, and groups run
-        # concurrently — admit at the true peak so the scheduler's budget
-        # holds. Computed from the actual split (a slab with no
-        # pack-eligible members costs the same as with the knob off).
+        # alongside the slab before the scatter, groups run concurrently,
+        # AND the rest loop stages its members at the same time — admit
+        # at the sum so the scheduler's budget bounds the true peak.
+        # Computed from the actual split (a slab with no pack-eligible
+        # members costs the same as with the knob off).
         packed, _ = self._split_device_groups()
         pack_bytes = sum(
             size for items in packed for _, _, size in items
         )
-        return self.total + max(peak_member, pack_bytes)
+        return self.total + pack_bytes + peak_member
 
 
 def batch_write_requests(
