@@ -1,0 +1,179 @@
+"""Snapshot fsck: shallow existence/length audit, deep CRC audit,
+incremental-chain awareness (a GC'd base is caught before any restore)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.fsck import main as fsck_main, verify_snapshot
+from torchsnapshot_tpu.knobs import override_max_chunk_size_bytes
+
+
+def _take(tmp_path, name="snap", **kwargs):
+    state = {
+        "m": ts.PyTreeState(
+            {
+                "w": jnp.arange(256, dtype=jnp.float32).reshape(16, 16),
+                "b": np.arange(8, dtype=np.int32),
+            }
+        ),
+        "meta": ts.StateDict(step=7, blob={10, 20}),  # opaque pickled leaf
+    }
+    p = str(tmp_path / name)
+    ts.Snapshot.take(p, state, **kwargs)
+    return p
+
+
+def test_sound_snapshot_passes_both_levels(tmp_path):
+    p = _take(tmp_path)
+    shallow = verify_snapshot(p)
+    assert shallow.ok and shallow.blobs_checked >= 3
+    deep = verify_snapshot(p, deep=True)
+    assert deep.ok and deep.bytes_verified > 0
+
+
+def test_missing_blob_detected(tmp_path):
+    p = _take(tmp_path)
+    os.remove(os.path.join(p, "0", "m", "w"))
+    report = verify_snapshot(p)
+    assert not report.ok
+    assert any(
+        pr.kind == "missing" and pr.location == "0/m/w"
+        for pr in report.problems
+    )
+
+
+def test_truncated_blob_detected_shallow(tmp_path):
+    p = _take(tmp_path)
+    blob = os.path.join(p, "0", "m", "w")
+    with open(blob, "r+b") as f:
+        f.truncate(100)  # manifest implies 1024 bytes
+    report = verify_snapshot(p)
+    assert not report.ok
+    assert any(pr.kind == "truncated" for pr in report.problems)
+
+
+def test_bitrot_detected_deep_only(tmp_path):
+    p = _take(tmp_path)
+    blob = os.path.join(p, "0", "m", "w")
+    with open(blob, "r+b") as f:
+        f.seek(64)
+        f.write(b"\x00\x00\x00\x00" if open(blob, "rb").read()[64:68] != b"\x00\x00\x00\x00" else b"\xff\xff\xff\xff")
+    assert verify_snapshot(p).ok  # same length: shallow cannot see it
+    deep = verify_snapshot(p, deep=True)
+    assert not deep.ok
+    assert any(pr.kind == "checksum" for pr in deep.problems)
+
+
+def test_uncommitted_directory_fails(tmp_path):
+    p = _take(tmp_path)
+    os.remove(os.path.join(p, ".snapshot_metadata"))
+    report = verify_snapshot(p)
+    assert not report.ok
+    assert report.problems[0].kind == "missing"
+
+
+def test_incremental_chain_audited_through_refs(tmp_path):
+    w = jnp.arange(64, dtype=jnp.float32)
+    state = {"m": ts.PyTreeState({"w": w})}
+    p0 = str(tmp_path / "s0")
+    p1 = str(tmp_path / "s1")
+    ts.Snapshot.take(p0, state, record_digests=True)
+    ts.Snapshot.take(p1, state, incremental_base=p0)
+
+    assert verify_snapshot(p1, deep=True).ok
+
+    # Destroy the base blob: the incremental snapshot's audit must fail
+    # even though its own directory is untouched.
+    os.remove(os.path.join(p0, "0", "m", "w"))
+    report = verify_snapshot(p1)
+    assert not report.ok
+    assert any("../s0/0/m/w" == pr.location for pr in report.problems)
+
+
+def test_chunked_entries_checked_per_chunk(tmp_path):
+    with override_max_chunk_size_bytes(256):
+        big = jnp.asarray(
+            np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+        )
+        p = str(tmp_path / "snap")
+        ts.Snapshot.take(p, {"m": ts.PyTreeState({"big": big})})
+    report = verify_snapshot(p, deep=True)
+    assert report.ok and report.blobs_checked >= 4
+    # Remove one chunk only.
+    chunks = [
+        f for f in os.listdir(os.path.join(p, "0", "m")) if f.startswith("big")
+    ]
+    os.remove(os.path.join(p, "0", "m", sorted(chunks)[1]))
+    assert not verify_snapshot(p).ok
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    p = _take(tmp_path)
+    assert fsck_main([p]) == 0
+    assert "OK (shallow)" in capsys.readouterr().out
+    assert fsck_main([p, "--deep"]) == 0
+    assert "OK (deep)" in capsys.readouterr().out
+    os.remove(os.path.join(p, "0", "m", "w"))
+    assert fsck_main([p]) == 1
+    out = capsys.readouterr().out
+    assert "FSCK missing: 0/m/w" in out and "FAILED" in out
+
+
+def test_deep_streams_across_chunk_boundaries(tmp_path, monkeypatch):
+    """Deep CRC verification chains across ranged-read chunks (bounded
+    memory); a flip in the SECOND chunk is still caught."""
+    from torchsnapshot_tpu import fsck
+
+    monkeypatch.setattr(fsck, "_DEEP_CHUNK_BYTES", 256)
+    p = _take(tmp_path)  # w is 1024 bytes -> 4 chunks
+    assert verify_snapshot(p, deep=True).ok
+    blob = os.path.join(p, "0", "m", "w")
+    with open(blob, "r+b") as f:
+        f.seek(700)
+        f.write(b"\xaa")
+    deep = verify_snapshot(p, deep=True)
+    assert not deep.ok
+    assert any(pr.kind == "checksum" for pr in deep.problems)
+
+
+def test_deep_counts_crc_verified_blobs(tmp_path):
+    p = _take(tmp_path)
+    report = verify_snapshot(p, deep=True)
+    assert report.crcs_verified == report.blobs_checked
+    assert report.bytes_verified > 0
+
+
+def test_deep_without_tables_is_visibly_hollow(tmp_path, capsys):
+    from torchsnapshot_tpu.knobs import disable_checksums
+
+    with disable_checksums():
+        p = _take(tmp_path, name="nocrc")
+        report = verify_snapshot(p, deep=True)
+        assert report.ok and report.crcs_verified == 0
+        assert fsck_main([p, "--deep"]) == 0
+        out = capsys.readouterr().out
+        assert "WARNING: 0 blobs CRC-verified" in out
+
+
+def test_shallow_transient_error_is_unreadable_not_truncated(tmp_path, monkeypatch):
+    """A non-OSError storage failure must be reported as 'unreadable'
+    (retryable), never as snapshot damage."""
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    p = _take(tmp_path)
+    orig = FSStoragePlugin.read
+
+    async def flaky(self, read_io):
+        if read_io.path == "0/m/w":
+            raise RuntimeError("injected transient storage error")
+        return await orig(self, read_io)
+
+    monkeypatch.setattr(FSStoragePlugin, "read", flaky)
+    report = verify_snapshot(p)
+    assert not report.ok
+    [prob] = [pr for pr in report.problems if pr.location == "0/m/w"]
+    assert prob.kind == "unreadable"

@@ -9,6 +9,7 @@ write-load partitioning of replicated state, shard-level persistence of
 restore, atomic commit, and pluggable storage backends.
 """
 
+from .fsck import FsckReport, verify_snapshot
 from .knobs import (
     enable_batching,
     override_max_chunk_size_bytes,
@@ -26,8 +27,10 @@ from .version import __version__
 __all__ = [
     "AppState",
     "CheckpointManager",
+    "FsckReport",
     "PendingRestore",
     "PendingSnapshot",
+    "verify_snapshot",
     "PyTreeState",
     "Snapshot",
     "RngState",

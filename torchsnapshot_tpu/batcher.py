@@ -248,19 +248,20 @@ class BatchedBufferStager(BufferStager):
         return slab
 
     def get_staging_cost_bytes(self) -> int:
-        peak_member = max(
-            (req.buffer_stager.get_staging_cost_bytes() for req, _, _ in self.members),
-            default=0,
-        )
         # The pack path transiently holds each group's packed host buffer
         # alongside the slab before the scatter, groups run concurrently,
-        # AND the rest loop stages its members at the same time — admit
-        # at the sum so the scheduler's budget bounds the true peak.
-        # Computed from the actual split (a slab with no pack-eligible
-        # members costs the same as with the knob off).
-        packed, _ = self._split_device_groups()
+        # AND the rest loop stages one member at the same time — admit at
+        # the sum so the scheduler's budget bounds the true peak. The
+        # member term counts only non-packed members (a packed member's
+        # bytes are already inside pack_bytes). A slab with no
+        # pack-eligible members costs the same as with the knob off.
+        packed, rest = self._split_device_groups()
         pack_bytes = sum(
             size for items in packed for _, _, size in items
+        )
+        peak_member = max(
+            (req.buffer_stager.get_staging_cost_bytes() for req, _, _ in rest),
+            default=0,
         )
         return self.total + pack_bytes + peak_member
 
