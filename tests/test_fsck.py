@@ -177,3 +177,27 @@ def test_shallow_transient_error_is_unreadable_not_truncated(tmp_path, monkeypat
     assert not report.ok
     [prob] = [pr for pr in report.problems if pr.location == "0/m/w"]
     assert prob.kind == "unreadable"
+
+
+def test_memory_store_truncation_detected_shallow():
+    """Plugins that slice past EOF silently (the in-memory store) must
+    still surface truncation via the read-length check."""
+    import asyncio
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    url = "memory://fsck-trunc"
+    ts.Snapshot.take(
+        url, {"m": ts.PyTreeState({"w": np.arange(16, dtype=np.float32)})}
+    )
+
+    async def truncate():
+        plugin = MemoryStoragePlugin(name="fsck-trunc")
+        blob = plugin._blobs["0/m/w"]
+        plugin._blobs["0/m/w"] = blob[: len(blob) // 2]
+
+    asyncio.new_event_loop().run_until_complete(truncate())
+    report = verify_snapshot(url)
+    assert not report.ok
+    assert any(pr.kind == "truncated" for pr in report.problems)

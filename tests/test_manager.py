@@ -304,3 +304,112 @@ def test_both_index_slots_corrupt_raises(tmp_path) -> None:
     (tmp_path / INDEX_BACKUP_BLOB).write_text("{torn")
     with pytest.raises(RuntimeError, match="index unreadable"):
         mgr.all_steps()
+
+
+# ---------------------------------------------------------------------------
+# metric-based retention (keep_best_n)
+# ---------------------------------------------------------------------------
+
+
+def _mstate(v: float):
+    import jax.numpy as jnp
+
+    return {"m": ts.PyTreeState({"w": jnp.full((8,), float(v))})}
+
+
+def test_keep_best_n_retains_best_and_last(tmp_path):
+    mgr = ts.CheckpointManager(
+        str(tmp_path), keep_last_n=1, keep_best_n=2, best_mode="min"
+    )
+    losses = {0: 5.0, 1: 1.0, 2: 4.0, 3: 0.5, 4: 9.0}
+    for step, loss in losses.items():
+        mgr.save(step, _mstate(step), metric=loss)
+    # best two: steps 3 (0.5) and 1 (1.0); last one: step 4.
+    assert mgr.all_steps() == [1, 3, 4]
+    assert mgr.best_step() == 3
+
+    dest = _mstate(-1)
+    assert mgr.restore_best(dest) == 3
+    import numpy as np
+
+    assert float(np.asarray(dest["m"].tree["w"])[0]) == 3.0
+
+
+def test_keep_best_max_mode_and_ties(tmp_path):
+    mgr = ts.CheckpointManager(
+        str(tmp_path), keep_best_n=1, best_mode="max"
+    )
+    mgr.save(0, _mstate(0), metric=0.9)
+    mgr.save(1, _mstate(1), metric=0.9)  # tie: newest wins
+    mgr.save(2, _mstate(2), metric=0.1)
+    # step 2 survives only as the just-saved step of its own commit; the
+    # next save drops it.
+    mgr.save(3, _mstate(3), metric=0.2)
+    assert mgr.best_step() == 1
+    assert 1 in mgr.all_steps()
+    assert 0 not in mgr.all_steps()
+    assert 2 not in mgr.all_steps()
+
+
+def test_metricless_steps_protected_only_by_last_n(tmp_path):
+    mgr = ts.CheckpointManager(str(tmp_path), keep_last_n=2, keep_best_n=1)
+    mgr.save(0, _mstate(0), metric=1.0)
+    mgr.save(1, _mstate(1))  # no metric
+    mgr.save(2, _mstate(2))  # no metric
+    mgr.save(3, _mstate(3))  # no metric
+    # best: 0; last two: 2, 3; step 1 dropped.
+    assert mgr.all_steps() == [0, 2, 3]
+    assert mgr.best_step() == 0
+
+
+def test_best_step_none_without_metrics(tmp_path):
+    mgr = ts.CheckpointManager(str(tmp_path))
+    mgr.save(0, _mstate(0))
+    assert mgr.best_step() is None
+    assert mgr.restore_best(_mstate(-1)) is None
+
+
+def test_async_save_metric_recorded(tmp_path):
+    mgr = ts.CheckpointManager(str(tmp_path), keep_best_n=1)
+    mgr.async_save(0, _mstate(0), metric=3.0).wait()
+    mgr.async_save(1, _mstate(1), metric=2.0).wait()
+    assert mgr.best_step() == 1
+
+
+def test_best_retention_composes_with_incremental_pins(tmp_path):
+    """A best-kept step referencing an origin keeps the origin pinned."""
+    import jax.numpy as jnp
+
+    def st(t):
+        return {
+            "m": ts.PyTreeState(
+                {"frozen": jnp.arange(32.0), "t": jnp.full((4,), float(t))}
+            )
+        }
+
+    mgr = ts.CheckpointManager(
+        str(tmp_path), keep_last_n=1, keep_best_n=1, incremental=True
+    )
+    mgr.save(0, st(0), metric=5.0)
+    mgr.save(1, st(1), metric=0.1)  # the best; refs step 0's frozen blob
+    mgr.save(2, st(2), metric=7.0)
+    mgr.save(3, st(3), metric=8.0)
+    steps = mgr.all_steps()
+    assert 1 in steps and 3 in steps and 2 not in steps
+    # Restoring the best still works through the pinned origin.
+    dest = st(-1)
+    assert mgr.restore_best(dest) == 1
+    import numpy as np
+
+    np.testing.assert_array_equal(
+        np.asarray(dest["m"].tree["frozen"]), np.arange(32.0)
+    )
+
+
+def test_nonfinite_metric_rejected(tmp_path):
+    mgr = ts.CheckpointManager(str(tmp_path), keep_best_n=1)
+    with pytest.raises(ValueError, match="finite"):
+        mgr.save(0, _mstate(0), metric=float("nan"))
+    with pytest.raises(ValueError, match="finite"):
+        mgr.async_save(0, _mstate(0), metric=float("inf"))
+    assert mgr.all_steps() == []  # nothing committed

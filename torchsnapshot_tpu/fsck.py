@@ -144,7 +144,20 @@ async def _shallow_check(
         # discard sound checkpoints on a retryable 503.
         problems.append(FsckProblem(location, "unreadable", repr(e)))
         return 0
-    return memoryview(read_io.buf).nbytes
+    got = memoryview(read_io.buf).nbytes
+    want = min_bytes - max(0, min_bytes - 1)
+    if got < want:
+        # Plugins without short-read errors (e.g. the in-memory store
+        # slices past EOF silently) surface truncation here instead.
+        problems.append(
+            FsckProblem(
+                location,
+                "truncated",
+                f"byte {min_bytes - 1} absent ({got} of {want} bytes read)",
+            )
+        )
+        return 0
+    return got
 
 
 async def _deep_check(

@@ -95,15 +95,24 @@ class _PendingManagedSnapshot:
     """Wraps a PendingSnapshot so index update + retention run once the
     background commit succeeds."""
 
-    def __init__(self, manager: "CheckpointManager", step: int, pending: PendingSnapshot):
+    def __init__(
+        self,
+        manager: "CheckpointManager",
+        step: int,
+        pending: PendingSnapshot,
+        metric: Optional[float] = None,
+    ):
         self._manager = manager
         self._step = step
         self._pending = pending
+        self._metric = metric
 
     def wait(self) -> Snapshot:
         snapshot = self._pending.wait()  # raises on failed take: no index entry
         self._manager._commit_step(
-            self._step, refs=referenced_steps(self._pending._metadata.manifest)
+            self._step,
+            refs=referenced_steps(self._pending._metadata.manifest),
+            metric=self._metric,
         )
         return snapshot
 
@@ -118,11 +127,23 @@ class CheckpointManager:
         keep_last_n: Optional[int] = None,
         pg: Optional[Any] = None,
         incremental: bool = False,
+        keep_best_n: Optional[int] = None,
+        best_mode: str = "min",
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+        if keep_best_n is not None and keep_best_n < 1:
+            raise ValueError(f"keep_best_n must be >= 1, got {keep_best_n}")
+        if best_mode not in ("min", "max"):
+            raise ValueError(f"best_mode must be 'min' or 'max', got {best_mode}")
         self.root = root
         self.keep_last_n = keep_last_n
+        # Metric-driven retention: steps saved with a ``metric=`` keep the
+        # best ``keep_best_n`` scores (``best_mode``: lower- or
+        # higher-is-better) IN ADDITION to the newest ``keep_last_n`` —
+        # the "checkpoint the best eval loss" loop without hand-rolled GC.
+        self.keep_best_n = keep_best_n
+        self.best_mode = best_mode
         # Default for save()/async_save(): digest-enabled takes that
         # reference the previous committed step's unchanged chunks.
         self.incremental = incremental
@@ -165,34 +186,56 @@ class CheckpointManager:
         step: int,
         app_state: AppState,
         incremental: Optional[bool] = None,
+        metric: Optional[float] = None,
         **take_kwargs: Any,
     ) -> Snapshot:
         """Synchronous checkpoint of ``step``; updates the index and
         applies retention after the commit. ``incremental`` overrides the
-        manager-level default for this save."""
+        manager-level default for this save; ``metric`` records this
+        step's score for ``keep_best_n`` retention and ``best_step()``
+        (rank 0's value is authoritative)."""
+        self._validate_metric(metric)
         take_kwargs = self._incremental_take_kwargs(incremental, take_kwargs)
         snapshot = Snapshot.take(
             self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
         )
         self._commit_step(
-            step, refs=referenced_steps(snapshot.metadata.manifest)
+            step,
+            refs=referenced_steps(snapshot.metadata.manifest),
+            metric=metric,
         )
         return snapshot
+
+    @staticmethod
+    def _validate_metric(metric: Optional[float]) -> None:
+        """NaN/inf poison min()/sort comparisons, silently selecting a
+        diverged checkpoint as 'best' — reject them at the API boundary."""
+        if metric is None:
+            return
+        import math
+
+        if not math.isfinite(float(metric)):
+            raise ValueError(
+                f"metric must be finite, got {metric!r} (a diverged "
+                f"eval score must not enter best-checkpoint retention)"
+            )
 
     def async_save(
         self,
         step: int,
         app_state: AppState,
         incremental: Optional[bool] = None,
+        metric: Optional[float] = None,
         **take_kwargs: Any,
     ) -> _PendingManagedSnapshot:
         """Pipelined checkpoint; the index entry and retention pass happen
         in ``wait()`` after the background commit succeeds."""
+        self._validate_metric(metric)
         take_kwargs = self._incremental_take_kwargs(incremental, take_kwargs)
         pending = Snapshot.async_take(
             self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
         )
-        return _PendingManagedSnapshot(self, step, pending)
+        return _PendingManagedSnapshot(self, step, pending, metric=metric)
 
     # ------------------------------------------------------------------
     # resuming
@@ -206,6 +249,27 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None
+
+    def best_step(self) -> Optional[int]:
+        """The committed step with the best recorded metric (``best_mode``
+        ordering; newest wins ties), or None when no step has one."""
+        index = self._with_root_storage(self._read_index_full_async)
+        scored = [s for s in index["steps"] if str(s) in index["metrics"]]
+        if not scored:
+            return None
+        return min(
+            scored, key=lambda s: self._metric_sort_key(s, index["metrics"])
+        )
+
+    def restore_best(self, app_state: AppState) -> Optional[int]:
+        """Restore the best-metric committed step; returns it, or None if
+        no step carries a metric. Rank 0 resolves, everyone follows."""
+        step = self.best_step() if self._pg.get_rank() == 0 else None
+        step = self._pg.broadcast_object(step)
+        if step is None:
+            return None
+        self.restore(step, app_state)
+        return step
 
     def restore(self, step: int, app_state: AppState) -> None:
         Snapshot(self.step_path(step), pg=self._pg_arg).restore(app_state)
@@ -256,15 +320,50 @@ class CheckpointManager:
 
         return run_in_fresh_event_loop(body())
 
-    def _commit_step(self, step: int, refs: Optional[Set[int]] = None) -> None:
+    def _commit_step(
+        self,
+        step: int,
+        refs: Optional[Set[int]] = None,
+        metric: Optional[float] = None,
+    ) -> None:
         if self._pg.get_rank() != 0:
             return
         self._with_root_storage(
-            lambda storage: self._commit_step_async(step, storage, refs or set())
+            lambda storage: self._commit_step_async(
+                step, storage, refs or set(), metric
+            )
         )
 
+    def _retained(
+        self, steps: List[int], just_saved: int, metrics: Dict[str, float]
+    ) -> List[int]:
+        """Retention policy: newest ``keep_last_n`` ∪ best ``keep_best_n``
+        (by recorded metric) ∪ the just-saved step (never GC'd in its own
+        commit — a rollback may produce a numerically-old step)."""
+        if self.keep_last_n is None and self.keep_best_n is None:
+            return list(steps)
+        keep: Set[int] = {just_saved}
+        if self.keep_last_n is not None:
+            keep.update(steps[-self.keep_last_n :])
+        if self.keep_best_n is not None:
+            scored = [s for s in steps if str(s) in metrics]
+            scored.sort(key=lambda s: self._metric_sort_key(s, metrics))
+            keep.update(scored[: self.keep_best_n])
+        return [s for s in steps if s in keep]
+
+    def _metric_sort_key(self, step: int, metrics: Dict[str, float]):
+        """One ordering for retention AND best_step()/restore_best(), so
+        they can never disagree about which step is 'best': best metric
+        first (mode-signed), newest step wins ties."""
+        sign = 1.0 if self.best_mode == "min" else -1.0
+        return (sign * metrics[str(step)], -step)
+
     async def _commit_step_async(
-        self, step: int, storage: StoragePlugin, refs: Set[int]
+        self,
+        step: int,
+        storage: StoragePlugin,
+        refs: Set[int],
+        metric: Optional[float] = None,
     ) -> None:
         index = await self._read_index_full_async(storage)
         steps = [s for s in index["steps"] if s != step]
@@ -275,27 +374,16 @@ class CheckpointManager:
             refs_map[str(step)] = sorted(refs)
         else:
             refs_map.pop(str(step), None)
+        metrics: Dict[str, float] = dict(index["metrics"])
+        if metric is not None:
+            metrics[str(step)] = float(metric)
+        else:
+            metrics.pop(str(step), None)
         pinned: Set[int] = set(index["pinned"])
 
-        dropped: List[int] = []
-        if self.keep_last_n is not None and len(steps) > self.keep_last_n:
-            dropped = steps[: -self.keep_last_n]
-            steps = steps[-self.keep_last_n :]
-            if step in dropped:
-                # Never GC the checkpoint that was just written (a step
-                # counter reset / rollback produced a numerically-old step):
-                # keep it alongside the newest N and let the user sort out
-                # the numbering.
-                logger.warning(
-                    "Step %d is older than the %d retained steps %s; "
-                    "keeping it anyway (the just-saved checkpoint is never "
-                    "deleted)",
-                    step,
-                    self.keep_last_n,
-                    steps,
-                )
-                dropped.remove(step)
-                steps = sorted(steps + [step])
+        retained = self._retained(steps, step, metrics)
+        dropped = [s for s in steps if s not in retained]
+        steps = retained
 
         # Pin-or-delete: a dropped (or previously pinned) step whose blobs
         # a *retained* step's manifest still references must keep its
@@ -317,9 +405,11 @@ class CheckpointManager:
                 to_delete.append(p)
         for gone in to_delete:
             refs_map.pop(str(gone), None)
+            metrics.pop(str(gone), None)
 
         await self._write_index_async(
-            steps, storage, refs=refs_map, pinned=sorted(pinned)
+            steps, storage, refs=refs_map, pinned=sorted(pinned),
+            metrics=metrics,
         )
         for old in to_delete:
             try:
@@ -365,6 +455,10 @@ class CheckpointManager:
                         for k, vs in raw.get("refs", {}).items()
                     },
                     "pinned": sorted(int(p) for p in raw.get("pinned", [])),
+                    "metrics": {
+                        str(int(k)): float(v)
+                        for k, v in raw.get("metrics", {}).items()
+                    },
                 }
             except (ValueError, KeyError, TypeError) as e:
                 logger.warning(
@@ -389,7 +483,7 @@ class CheckpointManager:
                 f"(io_failed={io_failed!r}, corrupt={corrupt!r}); "
                 "refusing to treat the step list as empty"
             )
-        return {"steps": [], "refs": {}, "pinned": []}
+        return {"steps": [], "refs": {}, "pinned": [], "metrics": {}}
 
     async def _write_index_async(
         self,
@@ -397,12 +491,15 @@ class CheckpointManager:
         storage: StoragePlugin,
         refs: Optional[Dict[str, List[int]]] = None,
         pinned: Optional[List[int]] = None,
+        metrics: Optional[Dict[str, float]] = None,
     ) -> None:
         payload_obj: Dict[str, Any] = {"steps": steps}
         if refs:
             payload_obj["refs"] = refs
         if pinned:
             payload_obj["pinned"] = pinned
+        if metrics:
+            payload_obj["metrics"] = metrics
         payload = json.dumps(payload_obj).encode()
         # Backup FIRST, primary second. With this order a torn *primary*
         # write always leaves a valid new backup behind it, and a torn
