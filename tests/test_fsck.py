@@ -182,8 +182,6 @@ def test_shallow_transient_error_is_unreadable_not_truncated(tmp_path, monkeypat
 def test_memory_store_truncation_detected_shallow():
     """Plugins that slice past EOF silently (the in-memory store) must
     still surface truncation via the read-length check."""
-    import asyncio
-
     import torchsnapshot_tpu as ts
     from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
 
@@ -192,12 +190,9 @@ def test_memory_store_truncation_detected_shallow():
         url, {"m": ts.PyTreeState({"w": np.arange(16, dtype=np.float32)})}
     )
 
-    async def truncate():
-        plugin = MemoryStoragePlugin(name="fsck-trunc")
-        blob = plugin._blobs["0/m/w"]
-        plugin._blobs["0/m/w"] = blob[: len(blob) // 2]
-
-    asyncio.new_event_loop().run_until_complete(truncate())
+    plugin = MemoryStoragePlugin(name="fsck-trunc")
+    blob = plugin._blobs["0/m/w"]
+    plugin._blobs["0/m/w"] = blob[: len(blob) // 2]
     report = verify_snapshot(url)
     assert not report.ok
     assert any(pr.kind == "truncated" for pr in report.problems)

@@ -120,9 +120,9 @@ async def _shallow_check(
 ) -> int:
     """Existence + length via one ranged read of the final required byte
     (object-store HEAD-equivalent; no data transfer)."""
-    read_io = ReadIO(
-        path=location, byte_range=(max(0, min_bytes - 1), min_bytes)
-    )
+    start = max(0, min_bytes - 1)
+    want = min_bytes - start
+    read_io = ReadIO(path=location, byte_range=(start, min_bytes))
     try:
         await storage.read(read_io)
     except FileNotFoundError:
@@ -145,7 +145,6 @@ async def _shallow_check(
         problems.append(FsckProblem(location, "unreadable", repr(e)))
         return 0
     got = memoryview(read_io.buf).nbytes
-    want = min_bytes - max(0, min_bytes - 1)
     if got < want:
         # Plugins without short-read errors (e.g. the in-memory store
         # slices past EOF silently) surface truncation here instead.

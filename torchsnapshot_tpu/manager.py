@@ -342,13 +342,25 @@ class CheckpointManager:
         commit — a rollback may produce a numerically-old step)."""
         if self.keep_last_n is None and self.keep_best_n is None:
             return list(steps)
-        keep: Set[int] = {just_saved}
+        keep: Set[int] = set()
         if self.keep_last_n is not None:
             keep.update(steps[-self.keep_last_n :])
         if self.keep_best_n is not None:
             scored = [s for s in steps if str(s) in metrics]
             scored.sort(key=lambda s: self._metric_sort_key(s, metrics))
             keep.update(scored[: self.keep_best_n])
+        if just_saved not in keep:
+            # A step-counter reset/rollback produced a numerically-old (or
+            # metric-poor) step: keep it anyway, loudly — operators need
+            # the signal that the index now mixes numbering epochs.
+            logger.warning(
+                "Just-saved step %d falls outside the retention policy "
+                "(retained: %s); keeping it anyway — the just-saved "
+                "checkpoint is never deleted",
+                just_saved,
+                sorted(keep),
+            )
+            keep.add(just_saved)
         return [s for s in steps if s in keep]
 
     def _metric_sort_key(self, step: int, metrics: Dict[str, float]):
