@@ -53,6 +53,11 @@ from .serialization import (
 from .utils.tracing import trace_annotation
 
 
+# Sentinel: assembly was registered into a placement batch and will land
+# via its deferred callback, not the immediate return value.
+_DEFERRED = object()
+
+
 def _shard_location(logical_path: str, box: Box) -> str:
     """Storage path for one shard box: ``sharded/{path}_{offsets}``
     (reference uses a ``sharded/`` prefix too, io_preparer.py:849-855)."""
@@ -257,17 +262,35 @@ class ShardedArrayIOPreparer:
             # single-device by construction, so it has exactly one box.
             if not getattr(current_leaf, "_committed", True) and len(boxes) == 1:
 
-                def assemble_uncommitted(filled: Dict[Box, np.ndarray]) -> Any:
+                def assemble_uncommitted(
+                    filled: Dict[Box, np.ndarray], batch=None, on_done=None
+                ) -> Any:
                     import jax.numpy as jnp
 
                     return jnp.asarray(next(iter(filled.values())))
 
                 return boxes, assemble_uncommitted, True
 
-            def assemble(filled: Dict[Box, np.ndarray]) -> Any:
+            def assemble(
+                filled: Dict[Box, np.ndarray], batch=None, on_done=None
+            ) -> Any:
                 # One batched H2D dispatch for all shards (a per-device
-                # device_put loop pays per-call dispatch latency 8x over).
+                # device_put loop pays per-call dispatch latency 8x over);
+                # with a shared ``batch`` the shards ride the restore-wide
+                # dispatch instead, and assembly defers until it runs.
                 devices = list(device_to_box)
+                if batch is not None and on_done is not None:
+                    slots = [
+                        batch.put(filled[device_to_box[d]], d) for d in devices
+                    ]
+                    batch.defer(
+                        lambda: on_done(
+                            jax.make_array_from_single_device_arrays(
+                                shape, sharding, [s.value for s in slots]
+                            )
+                        )
+                    )
+                    return _DEFERRED
                 arrays = jax.device_put(
                     [filled[device_to_box[d]] for d in devices], devices
                 )
@@ -291,7 +314,11 @@ class ShardedArrayIOPreparer:
             full = np.empty(shape, dtype=np_dtype)
             owned = True
         full_box = Box(tuple(0 for _ in shape), shape)
-        return {full_box: full}, (lambda filled: filled[full_box]), owned
+        return (
+            {full_box: full},
+            (lambda filled, batch=None, on_done=None: filled[full_box]),
+            owned,
+        )
 
     @staticmethod
     def prepare_read_into(
@@ -330,8 +357,13 @@ class ShardedArrayIOPreparer:
                 )
             )
 
-        def finalize() -> None:
-            restored[path] = assemble(boxes)
+        def finalize(batch=None) -> None:
+            def on_done(arr: Any) -> None:
+                restored[path] = arr
+
+            out = assemble(boxes, batch, on_done)
+            if out is not _DEFERRED:
+                restored[path] = out
 
         return read_reqs, finalize
 

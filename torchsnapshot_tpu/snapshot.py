@@ -600,11 +600,23 @@ class Snapshot:
             if convert is None:
                 restored[path] = dst
             else:
-                postprocess.append(
-                    lambda path=path, dst=dst, convert=convert: restored.__setitem__(
-                        path, convert(dst)
-                    )
-                )
+
+                def _pp(
+                    batch: Optional["_PlacementBatch"],
+                    path: str = path,
+                    dst: np.ndarray = dst,
+                    convert: Callable[..., Any] = convert,
+                ) -> None:
+                    out = convert(dst, batch)
+                    if isinstance(out, _PlacementSlot):
+                        assert batch is not None
+                        batch.defer(
+                            lambda: restored.__setitem__(path, out.value)
+                        )
+                    else:
+                        restored[path] = out
+
+                postprocess.append(_pp)
 
         return _StatefulLoadPlan(
             key=key,
@@ -707,6 +719,53 @@ class Snapshot:
             event_loop.close()
 
 
+class _PlacementSlot:
+    """Future for one array's device placement inside a _PlacementBatch."""
+
+    __slots__ = ("_batch", "_idx")
+
+    def __init__(self, batch: "_PlacementBatch", idx: int) -> None:
+        self._batch = batch
+        self._idx = idx
+
+    @property
+    def value(self) -> Any:
+        return self._batch._results[self._idx]
+
+
+class _PlacementBatch:
+    """Batches every restore-time H2D placement into ONE ``jax.device_put``
+    dispatch. Per-leaf device_put calls pay per-dispatch latency once per
+    leaf (hundreds of calls for a real model's cold restore); jax's
+    batched device_put moves the same bytes in a single dispatch.
+    ``put`` registers (host array, target sharding/device) and returns a
+    slot; ``defer`` registers work that reads slots; ``run`` executes the
+    batched transfer then the deferred work."""
+
+    def __init__(self) -> None:
+        self._values: List[Any] = []
+        self._targets: List[Any] = []
+        self._deferred: List[Callable[[], None]] = []
+        self._results: List[Any] = []
+
+    def put(self, value: Any, target: Any) -> _PlacementSlot:
+        self._values.append(value)
+        self._targets.append(target)
+        return _PlacementSlot(self, len(self._values) - 1)
+
+    def defer(self, fn: Callable[[], None]) -> None:
+        self._deferred.append(fn)
+
+    def run(self) -> None:
+        if self._values:
+            import jax
+
+            self._results = jax.device_put(self._values, self._targets)
+        for fn in self._deferred:
+            fn()
+        self._values, self._targets, self._deferred = [], [], []
+
+
 class _StatefulLoadPlan:
     """Planned restore of one stateful: read requests plus the deferred
     work that turns completed reads into application state."""
@@ -717,7 +776,7 @@ class _StatefulLoadPlan:
         stateful: Stateful,
         container_entries: Manifest,
         restored: Dict[str, Any],
-        postprocess: List[Callable[[], None]],
+        postprocess: List[Callable[[Optional[_PlacementBatch]], None]],
         read_reqs: List[Any],
     ) -> None:
         self.key = key
@@ -727,12 +786,20 @@ class _StatefulLoadPlan:
         self.postprocess = postprocess
         self.read_reqs = read_reqs
 
-    def finish_reads(self) -> None:
+    def finish_reads(self, batch: Optional[_PlacementBatch] = None) -> None:
         """Run deferred conversions (np buffers -> device arrays on their
         original shardings). Safe off the main thread: conversions only
-        ``device_put`` addressable data — no collectives."""
+        ``device_put`` addressable data — no collectives. With a shared
+        ``batch`` the placements only register here; the caller runs the
+        batch (one dispatch spanning many plans). Without one, a local
+        batch runs immediately."""
+        own = batch is None
+        if batch is None:
+            batch = _PlacementBatch()
         for fn in self.postprocess:
-            fn()
+            fn(batch)
+        if own:
+            batch.run()
 
     def apply(self) -> None:
         """Hand the restored state dict to the application. May run
@@ -904,8 +971,13 @@ class PendingRestore:
                 event_loop=event_loop,
                 checksum_table=checksum_table,
             )
+            # One restore-wide batched device_put spanning every plan's
+            # placements (per-leaf dispatch latency × hundreds of leaves
+            # is real cold-start time).
+            placement = _PlacementBatch()
             for plan in self._plans.values():
-                plan.finish_reads()
+                plan.finish_reads(placement)
+            placement.run()
             event_loop.run_until_complete(storage.close())
         except BaseException as e:  # noqa: BLE001 - must propagate via wait()
             self._exc_info = e
@@ -1196,12 +1268,18 @@ def _restore_destination(
         # differently-placed arrays.
         committed = getattr(current_leaf, "_committed", True)
 
-        def convert(host: np.ndarray) -> Any:
+        def convert(
+            host: np.ndarray, batch: Optional["_PlacementBatch"] = None
+        ) -> Any:
             if not committed:
                 import jax.numpy as jnp
 
                 return jnp.asarray(host)
-            return jax.device_put(host, sharding)
+            if batch is None:
+                return jax.device_put(host, sharding)
+            # Registered into the restore-wide batched device_put; the
+            # caller resolves the slot after batch.run().
+            return batch.put(host, sharding)
 
         return dst, convert, True
     return dst, None, True
