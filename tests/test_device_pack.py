@@ -165,6 +165,66 @@ def test_pack_failure_falls_back(tmp_path, monkeypatch):
     assert_tree_eq(dest["m"].tree, tree)
 
 
+def test_pack_fallback_skips_already_scattered_members(monkeypatch):
+    """A mid-scatter failure falls back per-member but must skip members
+    whose bytes already landed in the slab (their arr was cleared) —
+    re-staging them would hit np.asarray(None)."""
+    from torchsnapshot_tpu import batcher
+    from torchsnapshot_tpu.io_preparer import ArrayBufferStager
+    from torchsnapshot_tpu.io_types import WriteReq
+    from torchsnapshot_tpu.ops import device_pack
+
+    def boom(specs):
+        raise RuntimeError("injected pack failure")
+
+    monkeypatch.setattr(device_pack, "pack_async", boom)
+
+    a = jnp.asarray(_np_array((4, 4), "float32", seed=0))
+    b = jnp.asarray(_np_array((4, 4), "float32", seed=1))
+    sa = ArrayBufferStager(a, is_async_snapshot=False)
+    sb = ArrayBufferStager(b, is_async_snapshot=False)
+    size = a.nbytes
+    items = [
+        (WriteReq(path="x", buffer_stager=sa), 0, size),
+        (WriteReq(path="y", buffer_stager=sb), size, size),
+    ]
+    stager = batcher.BatchedBufferStager(items)
+    # Simulate a scatter that already copied member 'a' into the slab.
+    sa.arr = None
+    slab = bytearray(2 * size)
+    stager._pack_group_sync(items, memoryview(slab))
+    assert bytes(slab[size:]) == np.asarray(b).tobytes()
+    assert bytes(slab[:size]) == bytes(size)  # a's region left alone
+
+
+def test_batched_stager_cost_stable_across_staging():
+    """The staging cost is fixed at construction: staging clears
+    stager.arr, and a post-staging re-read (budget release/adjust paths)
+    must see the admission-time value, not a recomputation over mutated
+    state."""
+    import asyncio
+
+    from torchsnapshot_tpu import batcher
+    from torchsnapshot_tpu.io_preparer import ArrayBufferStager
+    from torchsnapshot_tpu.io_types import WriteReq
+
+    arrs = [jnp.asarray(_np_array((8, 8), "float32", seed=i)) for i in range(2)]
+    size = arrs[0].nbytes
+    items = [
+        (
+            WriteReq(path=f"p{i}", buffer_stager=ArrayBufferStager(a, False)),
+            i * size,
+            size,
+        )
+        for i, a in enumerate(arrs)
+    ]
+    stager = batcher.BatchedBufferStager(items)
+    cost_before = stager.get_staging_cost_bytes()
+    buf = asyncio.run(stager.stage_buffer())
+    assert len(buf) == 2 * size
+    assert stager.get_staging_cost_bytes() == cost_before
+
+
 def test_device_pack_off_by_default(tmp_path, monkeypatch):
     """Without the knob, batching stages members individually (no pack)."""
     from torchsnapshot_tpu.ops import device_pack

@@ -56,6 +56,26 @@ def test_relative_ref_prefix():
     assert relative_ref_prefix("/r/a", "/r/a") is None
 
 
+def test_relative_ref_prefix_mixed_relative_absolute(tmp_path, monkeypatch):
+    """fs roots are anchored to absolute form before relpath: mixing a
+    relative take path with an absolute base (or vice versa) must yield
+    the same prefix as the all-absolute spelling, not one that depends on
+    the cwd at take time."""
+    monkeypatch.chdir(tmp_path)
+    want = relative_ref_prefix(
+        str(tmp_path / "r" / "step_1"), str(tmp_path / "r" / "step_0")
+    )
+    assert want == "../step_0"
+    assert relative_ref_prefix("r/step_1", str(tmp_path / "r" / "step_0")) == want
+    assert relative_ref_prefix(str(tmp_path / "r" / "step_1"), "r/step_0") == want
+    assert relative_ref_prefix("r/step_1", "r/step_0") == want
+    # Same-root detection also survives mixed spellings.
+    assert relative_ref_prefix("r/a", str(tmp_path / "r" / "a")) is None
+    # A bare '/' root rstrips to empty: still declined (never cwd-anchored).
+    assert relative_ref_prefix("/", str(tmp_path / "r" / "step_0")) is None
+    assert relative_ref_prefix(str(tmp_path / "r" / "step_1"), "/") is None
+
+
 def test_dense_unchanged_is_not_rewritten(tmp_path):
     w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
     b = jnp.ones((8,), jnp.float32)

@@ -362,6 +362,18 @@ def test_metricless_steps_protected_only_by_last_n(tmp_path):
     assert mgr.best_step() == 0
 
 
+def test_keep_best_alone_never_gcs_unscored_steps(tmp_path):
+    """With keep_best_n and no keep_last_n, only scored steps compete for
+    deletion — enabling metric retention must not GC metric-less saves."""
+    mgr = ts.CheckpointManager(str(tmp_path), keep_best_n=1)
+    mgr.save(0, _mstate(0))  # unscored
+    mgr.save(1, _mstate(1), metric=2.0)
+    mgr.save(2, _mstate(2))  # unscored
+    mgr.save(3, _mstate(3), metric=1.0)  # new best: step 1 drops
+    assert mgr.all_steps() == [0, 2, 3]
+    assert mgr.best_step() == 3
+
+
 def test_best_step_none_without_metrics(tmp_path):
     mgr = ts.CheckpointManager(str(tmp_path))
     mgr.save(0, _mstate(0))

@@ -103,6 +103,20 @@ class BatchedBufferStager(BufferStager):
         # (req, offset, size) triples; offsets pre-assigned at planning.
         self.members = members
         self.total = sum(size for _, _, size in members)
+        # The group split (and the staging cost derived from it) is fixed
+        # here: it depends on knob state and on stager.arr fields that
+        # staging itself mutates, so admission and any later budget
+        # arithmetic must see one consistent value.
+        self._packed, self._rest = self._split_device_groups()
+        pack_bytes = sum(size for items in self._packed for _, _, size in items)
+        peak_member = max(
+            (
+                req.buffer_stager.get_staging_cost_bytes()
+                for req, _, _ in self._rest
+            ),
+            default=0,
+        )
+        self._staging_cost = self.total + pack_bytes + peak_member
 
     # Per-dispatch member cap: an N-ary concat program's trace/compile
     # time grows with N, and one compile per distinct slab layout must
@@ -183,6 +197,10 @@ class BatchedBufferStager(BufferStager):
                 len(items),
             )
             for req, offset, size in items:
+                # arr is cleared only after a member's bytes landed in the
+                # slab; a mid-scatter failure must not re-stage those.
+                if req.buffer_stager.arr is None:
+                    continue
                 buf = req.buffer_stager._stage_sync()
                 self._copy_member(view, buf, req, offset, size)
 
@@ -204,7 +222,7 @@ class BatchedBufferStager(BufferStager):
         slab = bytearray(self.total)
         view = memoryview(slab)
         loop = asyncio.get_running_loop()
-        packed, rest = self._split_device_groups()
+        packed, rest = self._packed, self._rest
         pack_futures = [
             loop.run_in_executor(executor, self._pack_group_sync, items, view)
             for items in packed
@@ -255,15 +273,9 @@ class BatchedBufferStager(BufferStager):
         # member term counts only non-packed members (a packed member's
         # bytes are already inside pack_bytes). A slab with no
         # pack-eligible members costs the same as with the knob off.
-        packed, rest = self._split_device_groups()
-        pack_bytes = sum(
-            size for items in packed for _, _, size in items
-        )
-        peak_member = max(
-            (req.buffer_stager.get_staging_cost_bytes() for req, _, _ in rest),
-            default=0,
-        )
-        return self.total + pack_bytes + peak_member
+        # Computed once in __init__: staging mutates the fields it
+        # depends on.
+        return self._staging_cost
 
 
 def batch_write_requests(

@@ -39,6 +39,7 @@ Interplay with the rest of the take pipeline:
 from __future__ import annotations
 
 import logging
+import os
 import posixpath
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -84,7 +85,15 @@ def relative_ref_prefix(new_path: str, base_path: str) -> Optional[str]:
         return None
     new_root = new_root.rstrip("/")
     base_root = base_root.rstrip("/")
-    if not new_root or not base_root or new_root == base_root:
+    if not new_root or not base_root:
+        return None
+    if new_scheme == "fs":
+        # relpath between a relative and an absolute fs path resolves
+        # through the process cwd at *take* time; the resulting ref would
+        # not survive a restore from a different cwd. Anchor both.
+        new_root = os.path.abspath(new_root)
+        base_root = os.path.abspath(base_root)
+    if new_root == base_root:
         return None
     if new_scheme in ("s3", "gs"):
         # Object keys resolve lexically within one bucket only: a ref

@@ -142,6 +142,8 @@ class CheckpointManager:
         # best ``keep_best_n`` scores (``best_mode``: lower- or
         # higher-is-better) IN ADDITION to the newest ``keep_last_n`` —
         # the "checkpoint the best eval loss" loop without hand-rolled GC.
+        # When only keep_best_n is set, unscored steps are never GC'd
+        # (see _retained).
         self.keep_best_n = keep_best_n
         self.best_mode = best_mode
         # Default for save()/async_save(): digest-enabled takes that
@@ -339,7 +341,12 @@ class CheckpointManager:
     ) -> List[int]:
         """Retention policy: newest ``keep_last_n`` ∪ best ``keep_best_n``
         (by recorded metric) ∪ the just-saved step (never GC'd in its own
-        commit — a rollback may produce a numerically-old step)."""
+        commit — a rollback may produce a numerically-old step).
+
+        With ``keep_best_n`` alone (``keep_last_n=None``), only *scored*
+        steps compete for deletion: unscored steps are all retained, so
+        enabling metric retention never silently GCs checkpoints that
+        were saved without a metric."""
         if self.keep_last_n is None and self.keep_best_n is None:
             return list(steps)
         keep: Set[int] = set()
@@ -349,6 +356,8 @@ class CheckpointManager:
             scored = [s for s in steps if str(s) in metrics]
             scored.sort(key=lambda s: self._metric_sort_key(s, metrics))
             keep.update(scored[: self.keep_best_n])
+            if self.keep_last_n is None:
+                keep.update(s for s in steps if str(s) not in metrics)
         if just_saved not in keep:
             # A step-counter reset/rollback produced a numerically-old (or
             # metric-poor) step: keep it anyway, loudly — operators need
