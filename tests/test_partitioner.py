@@ -145,6 +145,44 @@ def test_chunked_replicated_chunks_spread_across_ranks() -> None:
     assert rep_counts[0] > 0 and rep_counts[1] > 0
 
 
+def test_four_rank_uneven_loads_balance() -> None:
+    """4-rank bin-packing with uneven pre-loads (reference
+    tests/test_partitioner.py:103-119): every replicated path lands on
+    exactly one rank, and the heavily pre-loaded rank receives the least
+    replicated volume."""
+    replicated = {f"r{i}": 8 + 4 * i for i in range(12)}
+    kept_by_rank = _run_partition(4, [256, 2, 2, 2], replicated)
+    seen: Dict[str, int] = {}
+    rep_bytes = []
+    for rank, kept in enumerate(kept_by_rank):
+        total = 0
+        for req in kept:
+            if req.path.startswith("replicated/"):
+                assert req.path not in seen, "path assigned to two ranks"
+                seen[req.path] = rank
+                total += req.buffer_stager.get_staging_cost_bytes()
+        rep_bytes.append(total)
+    assert sorted(seen) == sorted(f"replicated/{k}" for k in replicated)
+    # Greedy argmin balances per rank: the 360 replicated rows split
+    # ~evenly over the three light ranks (~120 each), never catching up
+    # to rank 0's 256-row pre-load — so rank 0 receives nothing.
+    assert rep_bytes[0] == 0
+    assert all(b > 0 for b in rep_bytes[1:])
+
+
+def test_four_rank_chunked_subpartition_spreads_all_ranks() -> None:
+    """A sub-partitionable chunked replicated entry spreads chunk-wise
+    over all 4 ranks when base loads are equal."""
+    with knobs.override_max_chunk_size_bytes(256 * 16):  # 4 rows per chunk
+        kept_by_rank = _run_partition(4, [1, 1, 1, 1], {"big": 64})  # 16 chunks
+    rep_counts = [
+        sum(1 for r in kept if r.path.startswith("replicated/"))
+        for kept in kept_by_rank
+    ]
+    assert sum(rep_counts) == 16
+    assert all(c > 0 for c in rep_counts), rep_counts
+
+
 # ---------------------------------------------------------------------------
 # consolidate_replicated_entries
 # ---------------------------------------------------------------------------
