@@ -1,0 +1,15 @@
+"""BENCH.md must quote the driver-recorded signal of record — the local
+enforcement of the CI docs-consistency lane (committed-number drift like
+round 2's 0.92-vs-0.646 efficiency headline fails here)."""
+
+import importlib.util
+import pathlib
+
+
+def test_bench_docs_match_signal_of_record(capsys):
+    tools = pathlib.Path(__file__).parent.parent / "tools" / "check_bench_docs.py"
+    spec = importlib.util.spec_from_file_location("check_bench_docs", tools)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main()
+    assert rc == 0, capsys.readouterr().out

@@ -13,8 +13,6 @@ import numpy as np
 import pytest
 
 import torchsnapshot_tpu as ts
-from torchsnapshot_tpu.manifest import SnapshotMetadata
-from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
 from torchsnapshot_tpu.test_utils import multiprocess_test
 
 

@@ -5,7 +5,6 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import torchsnapshot_tpu as ts
 from torchsnapshot_tpu.fsck import main as fsck_main, verify_snapshot

@@ -152,7 +152,6 @@ def test_sharded_blobs_are_checksummed(tmp_path) -> None:
 
 
 def test_load_checksum_tables_merges_ranks(tmp_path) -> None:
-    from torchsnapshot_tpu.event_loop import run_in_fresh_event_loop
     from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
 
     (tmp_path / "checksums").mkdir()

@@ -8,7 +8,7 @@ process-global and irreversible, so the exercise runs in a spawned worker
 (the harness pins workers to the CPU backend).
 """
 
-from torchsnapshot_tpu.test_utils import get_free_port, run_multiprocess
+from torchsnapshot_tpu.test_utils import run_multiprocess
 
 
 def _jax_coordination_worker(pg, port: int):

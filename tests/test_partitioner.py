@@ -11,7 +11,6 @@ from __future__ import annotations
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Tuple
 
-import numpy as np
 import pytest
 
 from torchsnapshot_tpu import knobs

@@ -6,7 +6,7 @@ here the scheduler is tested directly with instrumented stagers/plugins.
 """
 
 import asyncio
-from typing import Dict, List, Optional
+from typing import Dict
 
 import pytest
 
@@ -21,8 +21,6 @@ from torchsnapshot_tpu.io_types import (
 )
 from torchsnapshot_tpu.knobs import override_per_rank_memory_budget_bytes
 from torchsnapshot_tpu.scheduler import (
-    execute_read_reqs,
-    execute_write_reqs,
     get_process_memory_budget_bytes,
     sync_execute_read_reqs,
     sync_execute_write_reqs,

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Docs-consistency check: BENCH.md must quote the signal of record.
+
+The committed benchmark narrative drifting from the driver-captured
+numbers (round 2 shipped a hand-typed 0.92 pipeline efficiency while
+``BENCH_r02.json`` recorded 0.646) is exactly the class of error this
+check exists to catch. BENCH.md carries a fenced JSON block between
+``BENCH_SIGNAL_OF_RECORD`` markers that must equal the ``parsed`` record
+of the newest ``BENCH_r*.json`` in the repo root. Stdlib-only; run from
+anywhere:
+
+    python tools/check_bench_docs.py
+"""
+
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+BLOCK_RE = re.compile(
+    r"BENCH_SIGNAL_OF_RECORD[^\n]*-->\s*```json\s*(\{.*?\})\s*```",
+    re.DOTALL,
+)
+
+
+def newest_record():
+    rounds = []
+    for path in ROOT.glob("BENCH_r*.json"):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", path.name)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    if not rounds:
+        return None, None
+    _, path = max(rounds)
+    data = json.loads(path.read_text())
+    return data.get("parsed", data), path
+
+
+def main() -> int:
+    record, record_path = newest_record()
+    if record is None:
+        print("check_bench_docs: no BENCH_r*.json found; nothing to check")
+        return 0
+    bench_md = ROOT / "BENCH.md"
+    if not bench_md.exists():
+        print("check_bench_docs: BENCH.md missing")
+        return 1
+    m = BLOCK_RE.search(bench_md.read_text())
+    if not m:
+        print(
+            "check_bench_docs: BENCH.md has no BENCH_SIGNAL_OF_RECORD block "
+            f"(must quote {record_path.name} verbatim)"
+        )
+        return 1
+    try:
+        quoted = json.loads(m.group(1))
+    except json.JSONDecodeError as e:
+        print(f"check_bench_docs: signal-of-record block is not valid JSON: {e}")
+        return 1
+    if quoted != record:
+        print(
+            f"check_bench_docs: BENCH.md signal-of-record block does not "
+            f"match {record_path.name}:"
+        )
+        for key in sorted(set(quoted) | set(record)):
+            a, b = quoted.get(key), record.get(key)
+            if a != b:
+                print(f"  {key}: BENCH.md has {a!r}, record has {b!r}")
+        return 1
+    print(
+        f"check_bench_docs: BENCH.md matches the signal of record "
+        f"({record_path.name})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

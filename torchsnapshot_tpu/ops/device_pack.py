@@ -22,7 +22,6 @@ from __future__ import annotations
 import functools
 from typing import Any, List, Optional, Tuple
 
-import numpy as np
 
 def pack_supported(dtype: Any) -> bool:
     """Packable = has a uint8-lane device view (the same eligibility rule

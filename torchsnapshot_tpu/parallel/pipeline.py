@@ -29,7 +29,6 @@ expressed as an explicit per-device program:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax

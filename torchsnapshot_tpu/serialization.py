@@ -17,7 +17,7 @@ from __future__ import annotations
 import pickle
 import sys
 from enum import Enum
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Sequence
 
 import ml_dtypes
 import numpy as np

@@ -40,7 +40,6 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import knobs
 from .io_types import BufferConsumer, BufferType, ReadReq, WriteReq
 from .manifest import ArrayEntry, Shard, ShardedArrayEntry
 from .parallel.overlap import Box, Overlap, box_overlap, subdivide_box

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Optional, Tuple
 
 from .. import knobs
 from ..io_types import ReadIO, StoragePlugin, WriteIO

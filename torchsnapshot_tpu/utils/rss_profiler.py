@@ -10,7 +10,6 @@ that the scheduler's memory budget actually bounds host memory
 from __future__ import annotations
 
 import threading
-import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Generator, List
