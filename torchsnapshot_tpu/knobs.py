@@ -25,11 +25,13 @@ _DISABLE_CHECKSUMS_ENV = "TORCHSNAPSHOT_TPU_DISABLE_CHECKSUMS"
 _S3_ENDPOINT_URL_ENV = "TORCHSNAPSHOT_TPU_S3_ENDPOINT"
 _INCREMENTAL_CHUNK_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_INCREMENTAL_CHUNK_BYTES"
 _DEVICE_PACK_ENV = "TORCHSNAPSHOT_TPU_DEVICE_PACK"
+_RESTORE_FLUSH_BYTES_ENV = "TORCHSNAPSHOT_TPU_RESTORE_PLACEMENT_FLUSH_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES: int = 128 * 1024 * 1024
 _DEFAULT_INCREMENTAL_CHUNK_SIZE_BYTES: int = 16 * 1024 * 1024
+_DEFAULT_RESTORE_FLUSH_BYTES: int = 128 * 1024 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -116,6 +118,16 @@ def get_incremental_chunk_size_bytes() -> int:
     )
 
 
+def get_restore_placement_flush_bytes() -> int:
+    """Streaming-restore flush granularity: once this many bytes of leaves
+    have completed their reads, their device placements flush as one
+    batched ``jax.device_put`` while remaining reads continue. Smaller =
+    more read/H2D overlap but more dispatches (per-dispatch latency is
+    what the batching amortizes); 0 = place everything in one batch after
+    all reads (the pre-streaming behavior)."""
+    return _get_int_env(_RESTORE_FLUSH_BYTES_ENV, _DEFAULT_RESTORE_FLUSH_BYTES)
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
     prev = os.environ.get(name)
@@ -179,4 +191,12 @@ def override_incremental_chunk_size_bytes(
 @contextlib.contextmanager
 def enable_device_pack() -> Generator[None, None, None]:
     with _override_env(_DEVICE_PACK_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_restore_placement_flush_bytes(
+    nbytes: int,
+) -> Generator[None, None, None]:
+    with _override_env(_RESTORE_FLUSH_BYTES_ENV, str(nbytes)):
         yield

@@ -26,8 +26,8 @@ import asyncio
 import logging
 import math
 import time
-from concurrent.futures import Executor, ThreadPoolExecutor
-from typing import Awaitable, Callable, List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional
 
 import psutil
 
@@ -339,9 +339,15 @@ async def execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     checksum_table: Optional[ChecksumTable] = None,
+    on_req_complete: Optional[Callable[[ReadReq], None]] = None,
 ) -> None:
     """Read pipeline: storage read -> deserialize/copy, budgeted by each
-    request's consuming cost (reference scheduler.py:357-444)."""
+    request's consuming cost (reference scheduler.py:357-444).
+
+    ``on_req_complete`` fires on the event loop after a request's bytes
+    are verified and consumed — the hook streaming restore placement
+    hangs device_put flushes on while other reads are still in flight.
+    """
     budget = MemoryBudget(memory_budget_bytes)
     stats = _PipelineStats()
     stats.pending = len(read_reqs)
@@ -415,6 +421,8 @@ async def execute_read_reqs(
             stats.done += 1
             stats.bytes_moved += buf.nbytes
             del buf, read_io
+            if on_req_complete is not None:
+                on_req_complete(req)
             reporter.maybe_report()
         finally:
             await budget.release(cost)
@@ -446,6 +454,7 @@ def sync_execute_read_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
     checksum_table: Optional[ChecksumTable] = None,
+    on_req_complete: Optional[Callable[[ReadReq], None]] = None,
 ) -> None:
     event_loop.run_until_complete(
         execute_read_reqs(
@@ -454,5 +463,6 @@ def sync_execute_read_reqs(
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
             checksum_table=checksum_table,
+            on_req_complete=on_req_complete,
         )
     )

@@ -46,7 +46,6 @@ from .io_types import StoragePlugin, WriteIO, WriteReq
 from .manifest import (
     ArrayEntry,
     ChunkedArrayEntry,
-    Entry,
     Manifest,
     ObjectEntry,
     PrimitiveEntry,
@@ -520,6 +519,10 @@ class Snapshot:
             from .batcher import batch_read_requests
 
             read_reqs = batch_read_requests(read_reqs)
+        # Streaming placement: completed leaves device_put while the
+        # remaining reads are still in flight.
+        placer = _StreamingPlacer()
+        placer.register_plan(plan)
         sync_execute_read_reqs(
             read_reqs=read_reqs,
             storage=storage,
@@ -527,7 +530,9 @@ class Snapshot:
             rank=rank,
             event_loop=event_loop,
             checksum_table=checksum_table,
+            on_req_complete=placer.on_req_complete,
         )
+        placer.flush()
         plan.finish_reads()
         plan.apply()
 
@@ -561,9 +566,11 @@ class Snapshot:
         read_reqs = []
         restored: Dict[str, Any] = {}
         container_entries: Manifest = {}
-        # Deferred conversions run after reads complete: np buffer -> the
-        # leaf flavor the application currently holds (jax device array).
-        postprocess: List[Callable[[], None]] = []
+        # Per-leaf groups of (reads, deferred conversion): the conversion
+        # (np buffer -> the leaf flavor the application currently holds,
+        # e.g. a jax device array) may run as soon as the group's reads
+        # complete — streaming placement — or all together after.
+        groups: List[_LeafGroup] = []
 
         for path, entry in entries.items():
             if is_container_entry(entry):
@@ -592,11 +599,12 @@ class Snapshot:
                 )
                 read_reqs.extend(reqs)
                 if finalize is not None:
-                    postprocess.append(finalize)
+                    groups.append(_LeafGroup(reqs, finalize))
                 continue
             assert isinstance(entry, (ArrayEntry, ChunkedArrayEntry))
             dst, convert, owned = _restore_destination(entry, current_leaf)
-            read_reqs.extend(prepare_read(entry, obj_out=dst, dest_owned=owned))
+            reqs = prepare_read(entry, obj_out=dst, dest_owned=owned)
+            read_reqs.extend(reqs)
             if convert is None:
                 restored[path] = dst
             else:
@@ -616,14 +624,14 @@ class Snapshot:
                     else:
                         restored[path] = out
 
-                postprocess.append(_pp)
+                groups.append(_LeafGroup(reqs, _pp))
 
         return _StatefulLoadPlan(
             key=key,
             stateful=stateful,
             container_entries=container_entries,
             restored=restored,
-            postprocess=postprocess,
+            groups=groups,
             read_reqs=read_reqs,
         )
 
@@ -766,6 +774,96 @@ class _PlacementBatch:
         self._values, self._targets, self._deferred = [], [], []
 
 
+class _LeafGroup:
+    """One leaf's read requests plus the deferred conversion that turns
+    their completed buffers into the application's leaf flavor. ``done``
+    flips once the conversion ran (streamed or final batch) so it can
+    never run twice."""
+
+    __slots__ = ("reqs", "fn", "nbytes", "remaining", "done")
+
+    def __init__(
+        self,
+        reqs: List[Any],
+        fn: Callable[[Optional["_PlacementBatch"]], None],
+    ) -> None:
+        self.reqs = reqs
+        self.fn = fn
+        self.nbytes = sum(
+            r.buffer_consumer.get_consuming_cost_bytes() for r in reqs
+        )
+        self.remaining = len(reqs)
+        self.done = False
+
+
+class _StreamingPlacer:
+    """Rolling restore-time H2D placement: a leaf's conversion runs as
+    soon as ALL of its reads complete, batched into one ``jax.device_put``
+    dispatch per ~``flush_bytes`` of restored data. Storage reads and
+    device transfers then overlap instead of serializing (all reads
+    first, one placement after) — the transfer of early leaves hides
+    behind the remaining reads. ``flush_bytes <= 0`` disables streaming
+    (everything places in the caller's final batch).
+
+    Single-threaded by construction: completion callbacks, flushes, and
+    ``finalize`` all run on the scheduler's event-loop thread.
+    """
+
+    def __init__(self, flush_bytes: Optional[int] = None) -> None:
+        self.flush_bytes = (
+            knobs.get_restore_placement_flush_bytes()
+            if flush_bytes is None
+            else flush_bytes
+        )
+        self._by_req: Dict[int, _LeafGroup] = {}
+        self._pending: List[_LeafGroup] = []
+        self._pending_bytes = 0
+
+    def register_plan(self, plan: "_StatefulLoadPlan") -> None:
+        if self.flush_bytes <= 0:
+            return
+        for group in plan.groups:
+            if group.remaining == 0:
+                self._ready(group)
+            else:
+                for req in group.reqs:
+                    self._by_req[id(req)] = group
+
+    def on_req_complete(self, req: Any) -> None:
+        """Scheduler hook. Batched spanning reads complete their member
+        requests (the planned objects live inside the merged consumer)."""
+        from .batcher import BatchedBufferConsumer
+
+        consumer = req.buffer_consumer
+        if isinstance(consumer, BatchedBufferConsumer):
+            for member in consumer.members:
+                self.on_req_complete(member)
+            return
+        group = self._by_req.pop(id(req), None)
+        if group is None:
+            return
+        group.remaining -= 1
+        if group.remaining == 0:
+            self._ready(group)
+
+    def _ready(self, group: _LeafGroup) -> None:
+        self._pending.append(group)
+        self._pending_bytes += group.nbytes
+        if self._pending_bytes >= self.flush_bytes:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        batch = _PlacementBatch()
+        for group in self._pending:
+            group.fn(batch)
+            group.done = True
+        self._pending = []
+        self._pending_bytes = 0
+        batch.run()
+
+
 class _StatefulLoadPlan:
     """Planned restore of one stateful: read requests plus the deferred
     work that turns completed reads into application state."""
@@ -776,28 +874,30 @@ class _StatefulLoadPlan:
         stateful: Stateful,
         container_entries: Manifest,
         restored: Dict[str, Any],
-        postprocess: List[Callable[[Optional[_PlacementBatch]], None]],
+        groups: List[_LeafGroup],
         read_reqs: List[Any],
     ) -> None:
         self.key = key
         self.stateful = stateful
         self.container_entries = container_entries
         self.restored = restored
-        self.postprocess = postprocess
+        self.groups = groups
         self.read_reqs = read_reqs
 
     def finish_reads(self, batch: Optional[_PlacementBatch] = None) -> None:
         """Run deferred conversions (np buffers -> device arrays on their
-        original shardings). Safe off the main thread: conversions only
-        ``device_put`` addressable data — no collectives. With a shared
-        ``batch`` the placements only register here; the caller runs the
-        batch (one dispatch spanning many plans). Without one, a local
-        batch runs immediately."""
+        original shardings) not already streamed. Safe off the main
+        thread: conversions only ``device_put`` addressable data — no
+        collectives. With a shared ``batch`` the placements only register
+        here; the caller runs the batch (one dispatch spanning many
+        plans). Without one, a local batch runs immediately."""
         own = batch is None
         if batch is None:
             batch = _PlacementBatch()
-        for fn in self.postprocess:
-            fn(batch)
+        for group in self.groups:
+            if not group.done:
+                group.fn(batch)
+                group.done = True
         if own:
             batch.run()
 
@@ -963,6 +1063,12 @@ class PendingRestore:
             checksum_table = _get_checksum_table_impl(
                 self._world_size, storage, event_loop
             )
+            # Streaming placement across every plan: leaves whose reads
+            # completed device_put in rolling batches while later reads
+            # are still draining.
+            placer = _StreamingPlacer()
+            for plan in self._plans.values():
+                placer.register_plan(plan)
             sync_execute_read_reqs(
                 read_reqs=read_reqs,
                 storage=storage,
@@ -970,10 +1076,13 @@ class PendingRestore:
                 rank=self._rank,
                 event_loop=event_loop,
                 checksum_table=checksum_table,
+                on_req_complete=placer.on_req_complete,
             )
-            # One restore-wide batched device_put spanning every plan's
-            # placements (per-leaf dispatch latency × hundreds of leaves
-            # is real cold-start time).
+            placer.flush()
+            # Whatever didn't stream (flush disabled, zero-read leaves)
+            # places in one final batched device_put spanning all plans
+            # (per-leaf dispatch latency × hundreds of leaves is real
+            # cold-start time).
             placement = _PlacementBatch()
             for plan in self._plans.values():
                 plan.finish_reads(placement)
