@@ -1,0 +1,151 @@
+"""Protocol-overhead benchmark: what the distributed protocol costs as
+rank count grows, separated from storage I/O (reference scaling evidence:
+benchmarks/ddp/main.py:48-68 + the published 1->8->32-GPU table).
+
+Two measurements per rank count N (1/2/4 spawned processes on the CPU
+backend, TCPStore rendezvous):
+
+- **per-rank bytes written** of an N-GiB fully-replicated state: the
+  write-load partitioner must hand each rank ~1/N of the bytes (the
+  mechanism behind the reference's aggregate-throughput scaling column —
+  on one box aggregate GB/s can't scale, but the per-rank write load
+  halving at 2 ranks is the same property, observable here).
+- **protocol wall time** of a take whose payload is negligible (many
+  tiny leaves): all six metadata rounds (key gather, replication
+  verification, partitioning, manifest gather, budget gather, commit
+  barrier) plus planning, with I/O amortized to ~0. Must stay ~flat in
+  N.
+
+Prints ONE JSON line; ``bench.py`` shells out to this on the CPU backend
+and merges the result into the driver-recorded metric line.
+
+    JAX_PLATFORMS=cpu python benchmarks/replicated_save/protocol_overhead.py \
+        [--gb 0.25] [--nprocs 1 2 4]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from benchmarks.common import jax  # noqa: E402  (pins JAX_PLATFORMS=cpu)
+
+
+def _worker(pg, work_dir: str, gb: float, tiny_leaves: int):
+    """One rank: replicated take with byte counting, then a tiny-payload
+    take timing the protocol itself."""
+    from unittest import mock
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    counters = {"bytes": 0}
+
+    class CountingFSStoragePlugin(FSStoragePlugin):
+        async def write(self, write_io):
+            counters["bytes"] += len(write_io.buf)
+            await super().write(write_io)
+
+    patch = mock.patch(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
+        side_effect=lambda url: CountingFSStoragePlugin(
+            root=url.split("://")[-1]
+        ),
+    )
+
+    # Replicated payload: identical on every rank by construction.
+    block = 32 * 1024 * 1024
+    n_blocks = max(1, int(gb * (1 << 30)) // block)
+    state = {
+        f"w{i}": jnp.asarray(
+            np.full((block // 4,), float(i), np.float32)
+        )
+        for i in range(n_blocks)
+    }
+    jax.block_until_ready(state)
+    with patch:
+        t0 = time.perf_counter()
+        ts.Snapshot.take(
+            os.path.join(work_dir, "payload"),
+            {"m": ts.PyTreeState(state)},
+            pg=pg,
+            replicated=["**"],
+        )
+        payload_s = time.perf_counter() - t0
+    payload_bytes = counters["bytes"]
+    del state
+
+    # Protocol-dominated take: many tiny replicated leaves, ~zero I/O.
+    tiny = {
+        f"t{i}": np.full((16,), float(i), np.float32)
+        for i in range(tiny_leaves)
+    }
+    counters["bytes"] = 0
+    with patch:
+        t0 = time.perf_counter()
+        ts.Snapshot.take(
+            os.path.join(work_dir, "tiny"),
+            {"m": ts.PyTreeState(tiny)},
+            pg=pg,
+            replicated=["**"],
+        )
+        protocol_s = time.perf_counter() - t0
+    return {
+        "payload_bytes_written": payload_bytes,
+        "payload_s": payload_s,
+        "protocol_s": protocol_s,
+    }
+
+
+def run(nproc: int, gb: float, tiny_leaves: int) -> dict:
+    work_dir = tempfile.mkdtemp(prefix=f"ts_proto_{nproc}_")
+    try:
+        if nproc == 1:
+            results = [_worker(None, work_dir, gb, tiny_leaves)]
+        else:
+            from torchsnapshot_tpu.test_utils import run_multiprocess
+
+            results = run_multiprocess(
+                _worker,
+                nproc,
+                args=(work_dir, gb, tiny_leaves),
+                timeout=600.0,
+            )
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return {
+        "nproc": nproc,
+        "per_rank_mib_written": [
+            round(r["payload_bytes_written"] / (1 << 20), 1) for r in results
+        ],
+        "payload_s": round(max(r["payload_s"] for r in results), 2),
+        "protocol_s": round(max(r["protocol_s"] for r in results), 2),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--gb", type=float, default=0.25)
+    p.add_argument("--tiny-leaves", type=int, default=256)
+    p.add_argument("--nprocs", type=int, nargs="+", default=[1, 2, 4])
+    args = p.parse_args()
+    rows = [run(n, args.gb, args.tiny_leaves) for n in args.nprocs]
+    for row in rows:
+        print(
+            f"protocol_overhead: nproc={row['nproc']} "
+            f"per-rank MiB written={row['per_rank_mib_written']} "
+            f"payload={row['payload_s']}s protocol={row['protocol_s']}s",
+            file=sys.stderr,
+        )
+    print(json.dumps({"gb": args.gb, "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()
