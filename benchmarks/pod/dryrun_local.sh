@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Local 2-process dry run of the pod launch recipe: the exact env-driven
+# rendezvous a generic multi-host deployment uses, on CPU devices.
+#
+#   benchmarks/pod/dryrun_local.sh [extra main.py args]
+#
+# Each process gets 4 virtual CPU devices; the global mesh spans 8
+# devices across the 2 processes, so shardings, collectives, the
+# coordination-service store, and the commit protocol all cross process
+# boundaries exactly as on a pod.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+PORT=${TS_DRYRUN_PORT:-$(python - <<'EOF'
+import socket
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    print(s.getsockname()[1])
+EOF
+)}
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=4 ${XLA_FLAGS:-}"
+ARGS=${*:---d-model 64 --layers 2 --vocab 128}
+
+pids=()
+for i in 0 1; do
+    TS_COORDINATOR=127.0.0.1:$PORT TS_NUM_PROCESSES=2 TS_PROCESS_ID=$i \
+        python benchmarks/pod/main.py $ARGS &
+    pids+=($!)
+done
+rc=0
+for pid in "${pids[@]}"; do
+    wait "$pid" || rc=$?
+done
+exit $rc

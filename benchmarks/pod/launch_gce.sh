@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Launch the pod benchmark on a GCE TPU pod slice (e.g. v4-32).
+#
+# The reference's SLURM launchers (benchmarks/ddp/run.slurm) allocate N
+# nodes and srun the benchmark; the TPU equivalent runs one process per
+# worker VM via `gcloud ... ssh --worker=all`. jax.distributed.initialize()
+# inside main.py discovers the coordinator/topology from TPU metadata —
+# no rendezvous flags needed.
+#
+# Usage:
+#   TPU_NAME=my-v4-32 ZONE=us-central2-b PROJECT=my-project \
+#       benchmarks/pod/launch_gce.sh [--d-model 4096 --layers 32 \
+#       --dir gs://my-bucket/ckpt --async-take]
+#
+# A v4-32 slice is 4 worker VMs x 4 chips; --dir must be a path every
+# host can reach (a gs:// bucket) unless you only want per-host FS I/O.
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the TPU pod slice name}"
+: "${ZONE:?set ZONE (e.g. us-central2-b)}"
+PROJECT_FLAG=${PROJECT:+--project="$PROJECT"}
+REPO_DIR=${REPO_DIR:-"\$HOME/torchsnapshot_tpu"}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+    --zone="$ZONE" $PROJECT_FLAG \
+    --worker=all \
+    --command="cd $REPO_DIR && python benchmarks/pod/main.py $*"
