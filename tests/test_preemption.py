@@ -1,0 +1,223 @@
+"""Preemption-aware checkpointing: signal capture, whole-world step
+agreement (sound with drifted rank steps), rendezvous timeout, and the
+save-on-evict -> resume flow. No reference counterpart (it relies on
+torchelastic restarts); the TPU analog is orbax's preemption sync."""
+
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.dist_store import InProcessStore, ProcessGroup
+from torchsnapshot_tpu.preemption import PreemptionSaver
+from torchsnapshot_tpu.test_utils import multiprocess_test
+
+
+def test_single_process_signal_triggers_next_should_save():
+    saver = PreemptionSaver(signals=(signal.SIGUSR1,))
+    try:
+        assert not saver.should_save(0)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert saver.preempted
+        assert saver.should_save(1)
+        assert not saver.should_save(2)  # one save, not a save loop
+    finally:
+        saver.uninstall()
+
+
+def test_request_save_without_signals():
+    saver = PreemptionSaver(signals=())
+    assert not saver.should_save(0)
+    saver.request_save()
+    assert saver.should_save(1)
+
+
+def test_chained_handler_still_runs():
+    hits = []
+    prev = signal.signal(signal.SIGUSR2, lambda s, f: hits.append(s))
+    try:
+        saver = PreemptionSaver(signals=(signal.SIGUSR2,))
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            assert saver.preempted
+            assert hits == [signal.SIGUSR2]
+        finally:
+            saver.uninstall()
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+
+
+def test_agreement_with_drifted_ranks_in_process():
+    """Two ranks drifted by several steps agree on one target step: the
+    rendezvous takes max(published)+1, so the laggard catches up instead
+    of the leader saving in the past."""
+    store = InProcessStore()
+    s0 = PreemptionSaver(ProcessGroup(store, 0, 2), signals=())
+    s1 = PreemptionSaver(ProcessGroup(store, 1, 2), signals=())
+
+    # Rank 1 is signaled at step 7 while rank 0's host loop is at step 4
+    # (async dispatch drift). Pre-seed rank 0's rendezvous entry so the
+    # blocking agreement completes instantly in one process; rank 0 is
+    # also signaled (the single-rank-signaled propagation path runs in
+    # the 2-process e2e test below, via the background poller).
+    s1.request_save()
+    s0.request_save()
+    store.set("__preemption//step/0", b"4")  # default session "" in the key
+    assert not s1.should_save(7)  # agreement runs; target = max(4,7)+1 = 8
+    assert s1._target_step == 8
+    # Rank 0's own rendezvous (at step 4, matching the seed) agrees.
+    saves_0 = [step for step in range(4, 10) if s0.should_save(step)]
+    assert saves_0 == [8]
+    assert s0._target_step == 8
+    # Rank 1 reaches the same target.
+    saves_1 = [step for step in range(8, 10) if s1.should_save(step)]
+    assert saves_1 == [8]
+
+
+def test_done_peer_abandons_rendezvous_fast():
+    """A peer that finished training (close()) makes the rendezvous
+    abandon immediately instead of waiting out the full timeout."""
+    import time
+
+    store = InProcessStore()
+    s0 = PreemptionSaver(ProcessGroup(store, 0, 2), signals=())
+    s1 = PreemptionSaver(
+        ProcessGroup(store, 1, 2), signals=(), rendezvous_timeout=30.0
+    )
+    s0.close()  # rank 0's loop ended before any notice
+    s1.request_save()
+    t0 = time.monotonic()
+    assert not s1.should_save(5)
+    assert time.monotonic() - t0 < 5.0  # done marker, not the 30s timeout
+    assert s1._gave_up
+
+
+def test_rendezvous_timeout_gives_up_loudly():
+    """A missing peer must abort the coordinated save (a lone save would
+    deadlock inside the distributed take), permanently."""
+    store = InProcessStore()
+    saver = PreemptionSaver(
+        ProcessGroup(store, 0, 2), signals=(), rendezvous_timeout=0.3
+    )
+    saver.request_save()
+    assert not saver.should_save(3)  # peer never publishes
+    assert saver._gave_up
+    assert not saver.should_save(4)
+
+
+def test_timeout_publishes_abandoned_marker_peers_give_up():
+    """A timed-out rank leaves its step key behind; a late peer must NOT
+    complete the rendezvous against it and save alone — the abandoned
+    marker makes it give up symmetrically."""
+    store = InProcessStore()
+    s0 = PreemptionSaver(
+        ProcessGroup(store, 0, 2), signals=(), rendezvous_timeout=0.2
+    )
+    s0.request_save()
+    assert not s0.should_save(3)  # times out; publishes abandoned + step/0
+    # Rank 1 arrives late: flag set, both step keys would be visible —
+    # but the abandoned marker forces it to give up symmetrically.
+    s1 = PreemptionSaver(ProcessGroup(store, 1, 2), signals=())
+    s1.request_save()
+    assert not s1.should_save(5)
+    assert s1._gave_up
+
+
+def test_pending_save_when_target_past_loop_end():
+    """Agreed target beyond the final step: every rank exits the loop
+    unsaved and pending_save() fires once on each."""
+    store = InProcessStore()
+    s0 = PreemptionSaver(ProcessGroup(store, 0, 2), signals=())
+    s1 = PreemptionSaver(ProcessGroup(store, 1, 2), signals=())
+    last_step = 9
+    s1.request_save()
+    s0.request_save()
+    store.set("__preemption//step/0", str(last_step).encode())
+    assert not s1.should_save(last_step)  # target = 10 > last step
+    assert s1._target_step == last_step + 1
+    assert not s0.should_save(last_step)  # same agreement on rank 0
+    assert s0._target_step == last_step + 1
+    # Loops end; both ranks save the final step via pending_save.
+    assert s0.pending_save() and s1.pending_save()
+    assert not s0.pending_save()  # one-shot
+
+
+def test_session_namespacing_isolates_stale_state():
+    """A fresh saver lifetime over the same store must not observe a
+    previous session's flag/step keys."""
+    store = InProcessStore()
+    # Leftovers from a previous incarnation ("run1").
+    store.set("__preemption/run1/flag", b"1")
+    store.set("__preemption/run1/step/0", b"7")
+    store.set("__preemption/run1/step/1", b"7")
+
+    fresh = PreemptionSaver(
+        ProcessGroup(store, 0, 2), signals=(), session="run2",
+        rendezvous_timeout=0.2,
+    )
+    assert not fresh.should_save(0)  # run1's flag is invisible to run2
+    assert fresh._target_step is None and not fresh._gave_up
+
+    # The same keys ARE visible to a saver of the matching session.
+    stale = PreemptionSaver(
+        ProcessGroup(store, 0, 2), signals=(), session="run1"
+    )
+    stale.request_save()
+    assert not stale.should_save(7)  # completes run1's rendezvous: target 8
+    assert stale._target_step == 8
+
+
+def _preempt_e2e_worker(pg, root: str):
+    """Rank 1 is 'evicted' mid-loop; both ranks must save the SAME step
+    through the manager and the checkpoint must resume correctly. The
+    exact agreed step depends on when rank 0's poll observes the flag
+    (step 3 or 4 here) — sameness is the invariant, not the number."""
+    import time
+
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+    mgr = ts.CheckpointManager(root, pg=pg)
+    saver = PreemptionSaver(pg, signals=(), poll_interval=0.1)
+    saved_at = None
+    state = {"w": jnp.zeros((8,)), "step": -1}
+    for step in range(200):
+        # Real steps take wall time on every rank; without pacing, an
+        # unflagged rank blasts through its whole loop before the flag
+        # even lands (the end-of-training edge close() exists for).
+        time.sleep(0.02)
+        state = {"w": state["w"] + 1.0, "step": step}
+        if pg.rank == 1 and step == 2:
+            saver.request_save()  # eviction notice on ONE rank only
+        if saver.should_save(step):
+            mgr.save(
+                step,
+                {"train": ts.PyTreeState(state), "prog": ts.StateDict(r=pg.rank)},
+            )
+            saved_at = step
+            break
+    saver.close()
+    assert saved_at is not None, "world never agreed on a save step"
+
+    dest = {
+        "train": ts.PyTreeState({"w": jnp.zeros((8,)), "step": 0}),
+        "prog": ts.StateDict(r=-1),
+    }
+    assert mgr.restore_latest(dest) == saved_at
+    np.testing.assert_array_equal(
+        np.asarray(dest["train"].tree["w"]), np.full((8,), float(saved_at + 1))
+    )
+    assert dest["prog"]["r"] == pg.rank
+    return saved_at
+
+
+def test_preemption_save_and_resume_two_ranks(tmp_path) -> None:
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    saved = run_multiprocess(
+        _preempt_e2e_worker, nproc=2, args=(str(tmp_path / "preempt"),)
+    )
+    assert saved[0] == saved[1], saved  # the invariant: one agreed step
+    assert saved[0] is not None and saved[0] >= 3, saved

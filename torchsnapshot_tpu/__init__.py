@@ -18,6 +18,7 @@ from .knobs import (
     override_slab_size_threshold_bytes,
 )
 from .manager import CheckpointManager
+from .preemption import PreemptionSaver
 from .rng_state import RngState, RNGState
 from .snapshot import PendingRestore, PendingSnapshot, Snapshot
 from .state_dict import PyTreeState, StateDict
@@ -30,6 +31,7 @@ __all__ = [
     "FsckReport",
     "PendingRestore",
     "PendingSnapshot",
+    "PreemptionSaver",
     "verify_snapshot",
     "PyTreeState",
     "Snapshot",

@@ -1,0 +1,345 @@
+"""Preemption-aware checkpointing: save once, consistently, when any host
+receives an eviction signal.
+
+TPU pods are preempted routinely (spot capacity, maintenance events),
+and the eviction signal (SIGTERM, typically with a short grace window)
+may land on only SOME hosts. A rank that checkpoints alone deadlocks its
+peers inside the distributed take; ranks that checkpoint at different
+steps commit garbage. :class:`PreemptionSaver` turns the signal into a
+whole-world agreement to save at one specific step:
+
+    mgr = CheckpointManager(root, pg=pg)
+    saver = PreemptionSaver(pg=pg)          # installs SIGTERM handler
+    for step in range(start, total):
+        state, loss = train_step(state, batch)
+        if saver.should_save(step):          # cheap store poll per step
+            mgr.save(step, app_state)
+            if saver.exit_after_save:
+                break
+    else:
+        if saver.pending_save():             # eviction raced the loop end
+            mgr.save(total - 1, app_state)
+    saver.close()   # peers racing an eviction notice abandon fast
+
+No reference counterpart (the reference relies on torchelastic restarts,
+test_utils.py:193-202 — state since the last periodic snapshot is simply
+lost). The TPU incumbent's analog is orbax's preemption checkpointing
+over jax's PreemptionSyncManager; this implementation needs only the
+snapshot store (TCPStore or the JAX coordination service), so it works
+in every deployment the checkpointer itself works in.
+
+Agreement protocol (sound under JAX's async dispatch, where host loops
+drift relative to device collectives, so "collectives order the ranks"
+arguments do NOT hold):
+
+1. *Flag* (cheap steady-state): a signaled rank sets one store key;
+   every rank polls it once per ``should_save`` call.
+2. *Rendezvous* (once, after a rank observes the flag): each rank
+   publishes its own current step and blocks until all ``world_size``
+   ranks have published. Every rank then computes the same
+   ``target = max(published) + 1``. Ranks' published steps are frozen
+   while they wait, so no rank is past the target when it resumes.
+3. Each rank returns True from ``should_save`` exactly at
+   ``step >= target`` — the same step everywhere, because steps advance
+   by one per loop iteration on every rank.
+
+If the rendezvous does not complete within ``rendezvous_timeout``
+(a peer already died), the saver gives up loudly and never triggers —
+a rank must not enter a distributed take its peers will never join.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Any, List, Optional
+
+from .pg_wrapper import PGWrapper
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+_PREFIX = "__preemption"
+
+
+class PreemptionSaver:
+    """Coordinates one consistent whole-world save on an eviction signal.
+
+    Args:
+        pg: process group (as accepted by :class:`PGWrapper`); ``None``
+            for single-process training.
+        signals: signal numbers that mean "preemption imminent"
+            (default: ``SIGTERM``). Pass ``()`` to install no handler
+            and drive :meth:`request_save` manually (e.g. from a cloud
+            metadata watcher thread).
+        exit_after_save: advisory flag echoed back as
+            ``saver.exit_after_save`` for the training loop.
+        chain: when True (default), a previously-installed Python-level
+            handler for the same signal is invoked after ours.
+        rendezvous_timeout: seconds to wait for every rank to join the
+            step agreement before giving up (default 120).
+    """
+
+    def __init__(
+        self,
+        pg: Optional[Any] = None,
+        signals: tuple = (signal.SIGTERM,),
+        exit_after_save: bool = True,
+        chain: bool = True,
+        rendezvous_timeout: float = 120.0,
+        session: str = "",
+        poll_interval: float = 1.0,
+    ) -> None:
+        self._pg = PGWrapper(pg)
+        # Store keys are namespaced per session: saver lifetimes sharing
+        # one persistent store (restarted loops, tests over one
+        # coordinator) must not observe each other's stale flag/step
+        # keys. Pass a distinct, rank-consistent session per lifetime
+        # (e.g. the resume step) when the store outlives the saver.
+        self._session = session
+        self.exit_after_save = exit_after_save
+        self.rendezvous_timeout = rendezvous_timeout
+        self.poll_interval = poll_interval
+        self._flagged = threading.Event()
+        self._remote_flagged = threading.Event()
+        self._stop_poller = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self._flag_published = False
+        self._target_step: Optional[int] = None
+        self._saved = False
+        self._gave_up = False
+        self._chain = chain
+        self._prev_handlers: List[tuple] = []
+        for sig in signals:
+            prev = signal.signal(sig, self._on_signal)
+            self._prev_handlers.append((sig, prev))
+        if signals:
+            logger.info(
+                "PreemptionSaver armed on %s (rank %d/%d)",
+                [signal.Signals(s).name for s in signals],
+                self._pg.get_rank(),
+                self._pg.get_world_size(),
+            )
+
+    def _key(self, suffix: str) -> str:
+        return f"{_PREFIX}/{self._session}/{suffix}"
+
+    def _ensure_poller(self, store) -> None:
+        """Background flag watcher: the training loop's should_save does
+        no store RPC in the steady state — one daemon thread per rank
+        polls the flag key every ``poll_interval`` seconds and flips a
+        local Event (store clients serialize requests internally, so the
+        poller and a later rendezvous never interleave corruptly)."""
+        if self._poller is not None:
+            return
+
+        def poll() -> None:
+            while not self._stop_poller.wait(self.poll_interval):
+                try:
+                    if store.try_get(self._key("flag")) is not None:
+                        self._remote_flagged.set()
+                        return
+                except Exception:  # noqa: BLE001 - store teardown race
+                    return
+
+        self._poller = threading.Thread(
+            target=poll, name="preemption-flag-poll", daemon=True
+        )
+        self._poller.start()
+
+    # -- signal side (async-signal-safe: only sets an Event) -------------
+
+    def _on_signal(self, signum, frame) -> None:
+        self._flagged.set()
+        if self._chain:
+            for sig, prev in self._prev_handlers:
+                if sig == signum and callable(prev):
+                    prev(signum, frame)
+
+    def request_save(self) -> None:
+        """Programmatic preemption notice (metadata watchers, tests)."""
+        self._flagged.set()
+
+    @property
+    def preempted(self) -> bool:
+        """True once a signal/request has been observed locally."""
+        return self._flagged.is_set()
+
+    def uninstall(self) -> None:
+        """Restore previously-installed signal handlers."""
+        for sig, prev in self._prev_handlers:
+            signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
+        self._prev_handlers = []
+
+    def close(self) -> None:
+        """Call when the training loop exits normally (no more
+        ``should_save`` calls coming). Publishes a done marker so a peer
+        whose eviction notice raced the end of training abandons its
+        rendezvous immediately instead of waiting out the timeout, and
+        restores the signal handlers."""
+        self._stop_poller.set()
+        if self._poller is not None:
+            self._poller.join(timeout=self.poll_interval + 1.0)
+        store = self._pg.store
+        if store is not None and self._pg.get_world_size() > 1:
+            try:
+                store.set(self._key(f"done/{self._pg.get_rank()}"), b"1")
+            except Exception:  # noqa: BLE001 - teardown path
+                logger.debug("preemption done-marker publish failed")
+        self.uninstall()
+
+    # -- training-loop side ----------------------------------------------
+
+    def should_save(self, step: int) -> bool:
+        """Call once per training step with that step's number.
+
+        Returns True on the one step at which every rank must save
+        (``step`` itself on single-process worlds)."""
+        if self._saved or self._gave_up:
+            return False
+        store = self._pg.store
+        if store is None or self._pg.get_world_size() <= 1:
+            if self._flagged.is_set():
+                self._saved = True
+                return True
+            return False
+
+        if self._target_step is None:
+            # Steady state: NO store RPC on the training loop — the
+            # background poller watches the flag; a locally-signaled
+            # rank publishes it once.
+            self._ensure_poller(store)
+            if self._flagged.is_set() and not self._flag_published:
+                store.set(self._key("flag"), b"1")
+                self._flag_published = True
+                self._remote_flagged.set()
+                logger.warning(
+                    "rank %d received preemption notice at step %d",
+                    self._pg.get_rank(),
+                    step,
+                )
+            if not self._remote_flagged.is_set():
+                return False
+            self._target_step = self._agree_on_target(step)
+            if self._target_step is None:
+                self._give_up(store)
+                return False
+            logger.warning(
+                "preemption agreed: world saves at step %d",
+                self._target_step,
+            )
+        if step >= self._target_step:
+            if self._peer_abandoned_after_grace(store):
+                self._give_up(store)
+                return False
+            self._saved = True
+            return True
+        return False
+
+    def _peer_abandoned_after_grace(self, store) -> bool:
+        """Final symmetry check before triggering a save: a peer may have
+        timed out of the rendezvous just as ours completed, and saving
+        without it would be a lone save (permanent block inside the
+        distributed take). The grace sleep outlasts the gap between a
+        peer's deadline expiry and its abandoned-marker publish — cheap
+        against the checkpoint we are about to write."""
+        time.sleep(0.25)
+        return store.try_get(self._key("abandoned")) is not None
+
+    def pending_save(self) -> bool:
+        """One-shot check for an agreed save the loop never reached.
+
+        The agreed target can exceed the loop's final step (eviction
+        landing while the leading rank runs its last steps). Every rank
+        exits the loop unsaved in that case — call this after the loop
+        and save at the final step if it returns True. Symmetric: a
+        completed rendezvous means every rank holds the same target (a
+        timed-out or abandoned rendezvous gives up on every rank), so
+        either all ranks see True here or none do::
+
+            for step in range(total):
+                ...
+                if saver.should_save(step):
+                    mgr.save(step, app_state); break
+            else:
+                if saver.pending_save():
+                    mgr.save(total - 1, app_state)
+            saver.close()
+        """
+        if (
+            self._saved
+            or self._gave_up
+            or (self._target_step is None and not self._flagged.is_set())
+        ):
+            return False
+        if self._pg.store is None or self._pg.get_world_size() <= 1:
+            self._saved = True
+            return True
+        if self._target_step is None:
+            return False  # flagged but never agreed: peers may be done
+        if self._peer_abandoned_after_grace(self._pg.store):
+            self._give_up(self._pg.store)
+            return False
+        self._saved = True
+        return True
+
+    def _give_up(self, store) -> None:
+        """Abandon the coordinated save — and tell peers, so a rank whose
+        rendezvous would otherwise complete against this rank's stale
+        step key cannot save alone (the asymmetric-deadlock case)."""
+        self._gave_up = True
+        try:
+            store.set(self._key("abandoned"), b"1")
+        except Exception:  # noqa: BLE001 - already giving up
+            logger.debug("preemption abandoned-marker publish failed")
+        logger.error(
+            "preemption rendezvous abandoned (timeout %.0fs or a peer "
+            "finished training); coordinated save will not happen — "
+            "periodic checkpoints are the fallback",
+            self.rendezvous_timeout,
+        )
+
+    def _agree_on_target(self, step: int) -> Optional[int]:
+        """Blocking max-step rendezvous; identical result on every rank,
+        or None when it must be abandoned (timeout, a finished peer, or
+        a peer that already abandoned)."""
+        store = self._pg.store
+        rank = self._pg.get_rank()
+        world = self._pg.get_world_size()
+        store.set(self._key(f"step/{rank}"), str(step).encode())
+        deadline = time.monotonic() + self.rendezvous_timeout
+        steps: List[Optional[bytes]] = [None] * world
+        # done/abandoned are coarse conditions (a finished or timed-out
+        # peer aborts the save either way): check them ~1/s, not per
+        # 50ms tick — O(world) coordinator RPCs per tick otherwise,
+        # during the grace window when coordinator latency matters most.
+        next_abort_check = 0.0
+        while time.monotonic() < deadline:
+            check_abort = time.monotonic() >= next_abort_check
+            if check_abort:
+                next_abort_check = time.monotonic() + 1.0
+                if store.try_get(self._key("abandoned")) is not None:
+                    logger.error("a peer abandoned the preemption rendezvous")
+                    return None
+            missing = False
+            for r in range(world):
+                if steps[r] is None:
+                    steps[r] = store.try_get(self._key(f"step/{r}"))
+                    if steps[r] is None:
+                        missing = True
+                        # A peer that finished training will never join;
+                        # abandon now, not at the timeout.
+                        if check_abort and store.try_get(
+                            self._key(f"done/{r}")
+                        ) is not None:
+                            logger.error(
+                                "rank %d finished training before joining "
+                                "the preemption rendezvous",
+                                r,
+                            )
+                            return None
+            if not missing:
+                return max(int(s.decode()) for s in steps) + 1
+            time.sleep(0.05)
+        return None
