@@ -121,7 +121,22 @@ def _jax_dist2_worker(pg, coord_port: int, root: str):
     ts.Snapshot(root, pg=jpg).restore(dst)
     assert float(dst["shared"].tree["w"][3, 3]) == 2.5
     assert dst["mine"]["rank_val"] == 40 + pg.rank
-    return True
+
+    # Preemption agreement over the SAME coordination service (the pod
+    # path): an eviction notice on rank 1 only; both ranks must save the
+    # same step through the manager.
+    from torchsnapshot_tpu.test_utils import drive_preemption_loop
+
+    mgr = ts.CheckpointManager(root + "_mgr", pg=jpg)
+    saver = ts.PreemptionSaver(jpg, signals=(), poll_interval=0.1)
+    saved_at = drive_preemption_loop(
+        jpg,
+        saver,
+        lambda step: mgr.save(step, {"s": ts.StateDict(step=step)}),
+        evict_rank=1,
+    )
+    assert saved_at is not None
+    return saved_at
 
 
 def test_two_process_jax_distributed_snapshot(tmp_path) -> None:
@@ -139,4 +154,6 @@ def test_two_process_jax_distributed_snapshot(tmp_path) -> None:
         args=(coord_port, str(tmp_path / "snap")),
         port=store_port,
     )
-    assert results == [True, True]
+    # Both ranks agreed on one preemption-save step over the
+    # coordination service.
+    assert results[0] == results[1] and results[0] is not None, results

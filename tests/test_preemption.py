@@ -174,31 +174,22 @@ def _preempt_e2e_worker(pg, root: str):
     through the manager and the checkpoint must resume correctly. The
     exact agreed step depends on when rank 0's poll observes the flag
     (step 3 or 4 here) — sameness is the invariant, not the number."""
-    import time
-
     from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.test_utils import drive_preemption_loop
 
     PGWrapper(pg).barrier()
     mgr = ts.CheckpointManager(root, pg=pg)
     saver = PreemptionSaver(pg, signals=(), poll_interval=0.1)
-    saved_at = None
-    state = {"w": jnp.zeros((8,)), "step": -1}
-    for step in range(200):
-        # Real steps take wall time on every rank; without pacing, an
-        # unflagged rank blasts through its whole loop before the flag
-        # even lands (the end-of-training edge close() exists for).
-        time.sleep(0.02)
-        state = {"w": state["w"] + 1.0, "step": step}
-        if pg.rank == 1 and step == 2:
-            saver.request_save()  # eviction notice on ONE rank only
-        if saver.should_save(step):
-            mgr.save(
-                step,
-                {"train": ts.PyTreeState(state), "prog": ts.StateDict(r=pg.rank)},
-            )
-            saved_at = step
-            break
-    saver.close()
+
+    def save(step: int) -> None:
+        # Step ``s`` has applied s+1 increments to the zero-initialized w.
+        state = {"w": jnp.full((8,), float(step + 1)), "step": step}
+        mgr.save(
+            step,
+            {"train": ts.PyTreeState(state), "prog": ts.StateDict(r=pg.rank)},
+        )
+
+    saved_at = drive_preemption_loop(pg, saver, save, evict_rank=1)
     assert saved_at is not None, "world never agreed on a save step"
 
     dest = {

@@ -182,6 +182,35 @@ def multiprocess_test(nproc: int):
     return deco
 
 
+def drive_preemption_loop(
+    pg,
+    saver,
+    save_fn: Callable[[int], None],
+    evict_rank: int,
+    evict_step: int = 2,
+    steps: int = 200,
+    pace_s: float = 0.02,
+) -> Optional[int]:
+    """Shared preemption-agreement exercise: run a paced step loop, inject
+    an eviction notice on one rank, save via ``save_fn(step)`` at the
+    agreed step; returns it (None if no agreement fired). The pacing is
+    load-bearing — real steps take wall time on every rank; without it an
+    unflagged rank exhausts its loop before the flag even lands."""
+    import time
+
+    saved_at: Optional[int] = None
+    for step in range(steps):
+        time.sleep(pace_s)
+        if pg.rank == evict_rank and step == evict_step:
+            saver.request_save()
+        if saver.should_save(step):
+            save_fn(step)
+            saved_at = step
+            break
+    saver.close()
+    return saved_at
+
+
 # ---------------------------------------------------------------------------
 # Equality / random-data helpers
 # ---------------------------------------------------------------------------
