@@ -306,3 +306,56 @@ def test_verify_range_checksum_unit() -> None:
     _vc(blob, interim, "p")  # no raise
     with pytest.raises(ChecksumError, match="page 1"):
         _vc(bytes(whole_bad), interim, "p")
+
+
+def test_fused_write_checksum_matches_two_step(tmp_path) -> None:
+    """FSStoragePlugin.write_with_checksum produces byte-identical table
+    entries to compute-then-write, across page boundaries, and the bytes
+    on disk are the same."""
+    import asyncio
+
+    from torchsnapshot_tpu.integrity import PAGE_SIZE, compute_checksum_entry
+    from torchsnapshot_tpu.io_types import WriteIO
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    if plugin._native is False:
+        import pytest
+
+        pytest.skip("native runtime unavailable")
+    rng = __import__("numpy").random.default_rng(0)
+    sizes = [
+        0,
+        1,
+        PAGE_SIZE - 1,
+        PAGE_SIZE,
+        PAGE_SIZE + 1,
+        2 * PAGE_SIZE,
+        2 * PAGE_SIZE + 12345,
+    ]
+
+    async def run() -> None:
+        for i, size in enumerate(sizes):
+            buf = rng.integers(0, 256, size, dtype="uint8").tobytes()
+            entry = await plugin.write_with_checksum(
+                WriteIO(path=f"blob{i}", buf=buf)
+            )
+            assert entry == compute_checksum_entry(buf), size
+            assert (tmp_path / f"blob{i}").read_bytes() == buf
+
+    asyncio.run(run())
+
+
+def test_fused_write_checksum_declines_without_native(tmp_path) -> None:
+    """A plugin whose native runtime is unavailable declines the fused
+    path (returns None) so the scheduler falls back to two-step."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_types import WriteIO
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    plugin._native = False
+    assert asyncio.run(
+        plugin.write_with_checksum(WriteIO(path="x", buf=b"abc"))
+    ) is None

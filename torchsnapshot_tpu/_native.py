@@ -142,6 +142,15 @@ def _declare(l: ctypes.CDLL) -> None:
     l.ts_gather_memcpy.restype = None
     l.ts_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
     l.ts_crc32c.restype = ctypes.c_uint32
+    l.ts_write_file_crc.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int,
+    ]
+    l.ts_write_file_crc.restype = ctypes.c_int
 
 
 def _raise_errno(rc: int, path: str) -> None:
@@ -244,3 +253,29 @@ def crc32c(buf, seed: int = 0) -> Optional[int]:
         return None
     mv = memoryview(buf).cast("B")
     return int(l.ts_crc32c(_addr_of(mv), mv.nbytes, seed & 0xFFFFFFFF))
+
+
+def write_file_crc(
+    path: str, buf, page_size: int, do_fsync: bool = False
+) -> Optional[List[int]]:
+    """Fused write + integrity pass: writes ``buf`` to a fresh file and
+    returns the CRC32-C of each ``page_size`` page (computed while the
+    page is cache-hot from the write — one memory pass instead of two).
+    None when native is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    mv = memoryview(buf).cast("B")
+    n_pages = (mv.nbytes + page_size - 1) // page_size
+    out = (ctypes.c_uint32 * max(1, n_pages))()
+    rc = l.ts_write_file_crc(
+        path.encode(),
+        _addr_of(mv),
+        mv.nbytes,
+        page_size,
+        out,
+        1 if do_fsync else 0,
+    )
+    if rc != 0:
+        _raise_errno(rc, path)
+    return [int(out[i]) for i in range(n_pages)]

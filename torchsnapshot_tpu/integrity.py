@@ -152,8 +152,18 @@ def compute_checksum_entry(buf: BufferType) -> Tuple:
         _crc_of(mv[off : off + PAGE_SIZE], alg)
         for off in range(0, nbytes, PAGE_SIZE)
     ]
-    # Whole-blob digest folded from the page digests in O(1) per page
-    # (GF(2) shift operators) — each byte is CRC'd exactly once.
+    return entry_from_page_crcs(pages, nbytes, alg)
+
+
+def entry_from_page_crcs(pages: list, nbytes: int, alg: str = "crc32c") -> Tuple:
+    """Table entry from per-page digests (the shared tail of both the
+    two-step path, :func:`compute_checksum_entry`, and the fused native
+    write+CRC path): the whole-blob digest is folded from the page
+    digests in O(1) per page (GF(2) shift operators) — each byte is
+    CRC'd exactly once, wherever the pages came from."""
+    if nbytes <= PAGE_SIZE:
+        return (alg, pages[0] if pages else _crc_of(memoryview(b""), alg), nbytes)
+    assert len(pages) == (nbytes + PAGE_SIZE - 1) // PAGE_SIZE
     full_op = _crc_shift_operator(PAGE_SIZE, alg)
     tail = nbytes - (len(pages) - 1) * PAGE_SIZE
     tail_op = full_op if tail == PAGE_SIZE else _crc_shift_operator(tail, alg)

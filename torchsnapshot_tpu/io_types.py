@@ -101,6 +101,15 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...
 
+    async def write_with_checksum(self, write_io: WriteIO):
+        """Optional fused write + integrity pass: persist ``write_io`` AND
+        return its checksum-table entry (``integrity.ChecksumTable``
+        value), computed in the same pass over the bytes. Return ``None``
+        to decline — the scheduler then computes the checksum separately
+        and calls :meth:`write` (the default for every plugin without a
+        native fused path)."""
+        return None
+
     @abc.abstractmethod
     async def read(self, read_io: ReadIO) -> None: ...
 

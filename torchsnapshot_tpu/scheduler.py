@@ -251,11 +251,27 @@ async def execute_write_reqs(
     io_tasks: List[asyncio.Task] = []
     record_checksums = not knobs.is_checksums_disabled()
     checksums: ChecksumTable = {}
+    # Sticky runtime-decline: a plugin that overrides write_with_checksum
+    # but declines (native runtime unavailable) declines for the whole
+    # run — remember it so later writes keep checksum compute OFF the
+    # bounded I/O slots.
+    fused_declined = False
 
     async def write_one(req: WriteReq, buf) -> None:
+        nonlocal fused_declined
         buf_len = len(buf)
         try:
-            if record_checksums:
+            # Fused write+checksum (one cache-hot memory pass) when the
+            # plugin overrides it; otherwise checksum first (off the I/O
+            # slot), then write.
+            entry = None
+            fused = (
+                record_checksums
+                and not fused_declined
+                and type(storage).write_with_checksum
+                is not StoragePlugin.write_with_checksum
+            )
+            if record_checksums and not fused:
                 checksums[req.path] = await asyncio.get_running_loop(
                 ).run_in_executor(executor, compute_checksum_entry, buf)
             async with io_slots:
@@ -265,7 +281,20 @@ async def execute_write_reqs(
                     # I/O spans are emitted inside the storage plugin's
                     # executor work (fs.py): wrapping the await here would
                     # record suspension time of interleaved tasks, not I/O.
-                    await storage.write(WriteIO(path=req.path, buf=buf))
+                    write_io = WriteIO(path=req.path, buf=buf)
+                    if fused:
+                        entry = await storage.write_with_checksum(write_io)
+                        if entry is not None:
+                            checksums[req.path] = entry
+                    if entry is None:
+                        if fused:
+                            # Plugin declined at runtime (native lib
+                            # unavailable): two-step fallback, and stay
+                            # two-step for the rest of the run.
+                            fused_declined = True
+                            checksums[req.path] = await asyncio.get_running_loop(
+                            ).run_in_executor(executor, compute_checksum_entry, buf)
+                        await storage.write(write_io)
                 finally:
                     stats.io -= 1
         finally:

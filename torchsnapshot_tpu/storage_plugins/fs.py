@@ -59,6 +59,32 @@ class FSStoragePlugin(StoragePlugin):
         async with aiofiles.open(full_path, "wb") as f:
             await f.write(write_io.buf)
 
+    async def write_with_checksum(self, write_io: WriteIO):
+        """Fused write + integrity pass (one cache-hot memory pass, one
+        executor hop): returns the checksum-table entry, or None when the
+        native runtime is unavailable (the scheduler then runs the
+        two-step compute-then-write path)."""
+        if not self._native:
+            return None
+        from ..integrity import PAGE_SIZE, entry_from_page_crcs
+
+        full_path = self._full_path(write_io.path)
+        await self._ensure_parent_dir(full_path)
+        loop = asyncio.get_running_loop()
+
+        def _write_crc():
+            with trace_annotation("ts:write"):
+                pages = _native.write_file_crc(
+                    full_path, write_io.buf, PAGE_SIZE
+                )
+            if pages is None:
+                return None
+            return entry_from_page_crcs(
+                pages, memoryview(write_io.buf).cast("B").nbytes
+            )
+
+        return await loop.run_in_executor(None, _write_crc)
+
     async def read(self, read_io: ReadIO) -> None:
         full_path = self._full_path(read_io.path)
         if self._native:
