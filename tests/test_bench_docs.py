@@ -1,15 +1,25 @@
-"""BENCH.md must quote the driver-recorded signal of record — the local
-enforcement of the CI docs-consistency lane (committed-number drift like
-round 2's 0.92-vs-0.646 efficiency headline fails here)."""
+"""Docs-consistency lanes, enforced locally too: BENCH.md must quote the
+driver-recorded signal of record (committed-number drift like round 2's
+0.92-vs-0.646 efficiency headline fails here), and every relative doc
+link must resolve."""
 
 import importlib.util
 import pathlib
 
 
-def test_bench_docs_match_signal_of_record(capsys):
-    tools = pathlib.Path(__file__).parent.parent / "tools" / "check_bench_docs.py"
-    spec = importlib.util.spec_from_file_location("check_bench_docs", tools)
+def _run_tool(name: str) -> int:
+    tools = pathlib.Path(__file__).parent.parent / "tools" / name
+    spec = importlib.util.spec_from_file_location(name[:-3], tools)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    rc = mod.main()
+    return mod.main()
+
+
+def test_bench_docs_match_signal_of_record(capsys):
+    rc = _run_tool("check_bench_docs.py")
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_doc_links_resolve(capsys):
+    rc = _run_tool("check_doc_links.py")
     assert rc == 0, capsys.readouterr().out

@@ -169,11 +169,11 @@ def test_session_namespacing_isolates_stale_state():
     assert stale._target_step == 8
 
 
-def _preempt_e2e_worker(pg, root: str):
-    """Rank 1 is 'evicted' mid-loop; both ranks must save the SAME step
-    through the manager and the checkpoint must resume correctly. The
-    exact agreed step depends on when rank 0's poll observes the flag
-    (step 3 or 4 here) — sameness is the invariant, not the number."""
+def _preempt_e2e_worker(pg, root: str, evict_rank: int = 1):
+    """One rank is 'evicted' mid-loop; every rank must save the SAME
+    step through the manager and the checkpoint must resume correctly.
+    The exact agreed step depends on when the other ranks' polls observe
+    the flag — sameness is the invariant, not the number."""
     from torchsnapshot_tpu.pg_wrapper import PGWrapper
     from torchsnapshot_tpu.test_utils import drive_preemption_loop
 
@@ -189,7 +189,7 @@ def _preempt_e2e_worker(pg, root: str):
             {"train": ts.PyTreeState(state), "prog": ts.StateDict(r=pg.rank)},
         )
 
-    saved_at = drive_preemption_loop(pg, saver, save, evict_rank=1)
+    saved_at = drive_preemption_loop(pg, saver, save, evict_rank=evict_rank)
     assert saved_at is not None, "world never agreed on a save step"
 
     dest = {
@@ -212,3 +212,18 @@ def test_preemption_save_and_resume_two_ranks(tmp_path) -> None:
     )
     assert saved[0] == saved[1], saved  # the invariant: one agreed step
     assert saved[0] is not None and saved[0] >= 3, saved
+
+
+def test_preemption_four_ranks_one_agreed_step(tmp_path) -> None:
+    """Pod-shaped world: 4 ranks, notice on rank 2 only — every rank
+    saves the same step and the checkpoint resumes on all of them."""
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    saved = run_multiprocess(
+        _preempt_e2e_worker,
+        nproc=4,
+        args=(str(tmp_path / "preempt4"),),
+        kwargs={"evict_rank": 2},
+        timeout=300.0,
+    )
+    assert len(set(saved)) == 1 and saved[0] is not None, saved

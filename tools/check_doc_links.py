@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Docs link check: every relative markdown link in README.md and docs/
+must point at an existing file (the docs-build lane's cheap core —
+reference ships a sphinx docs build; these docs are plain markdown).
+Stdlib-only.
+
+    python tools/check_doc_links.py
+"""
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+LINK_RE = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
+
+
+def check(md: Path) -> list:
+    errors = []
+    for target in LINK_RE.findall(md.read_text()):
+        if target.startswith(("http://", "https://", "mailto:", "#")):
+            continue
+        path = target.split("#", 1)[0]
+        if not path:
+            continue
+        resolved = (md.parent / path).resolve()
+        if not resolved.exists():
+            errors.append(f"{md.relative_to(ROOT)}: broken link -> {target}")
+    return errors
+
+
+def main() -> int:
+    files = [ROOT / "README.md", *sorted((ROOT / "docs").rglob("*.md"))]
+    files += [p for p in (ROOT / "benchmarks").rglob("*.md")]
+    errors = []
+    for md in files:
+        if md.exists():
+            errors.extend(check(md))
+    for e in errors:
+        print(e)
+    if not errors:
+        print(f"check_doc_links: {len(files)} files, all relative links resolve")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
