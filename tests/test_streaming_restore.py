@@ -22,11 +22,21 @@ EVENTS = []
 
 
 class RecordingFSStoragePlugin(FSStoragePlugin):
+    async def _record(self, path):
+        if path.startswith("0/"):
+            EVENTS.append(("read", path))
+        await asyncio.sleep(0.02)  # keep later reads in flight past flushes
+
     async def read(self, read_io):
         await super().read(read_io)
-        if read_io.path.startswith("0/"):
-            EVENTS.append(("read", read_io.path))
-        await asyncio.sleep(0.02)  # keep later reads in flight past flushes
+        await self._record(read_io.path)
+
+    async def read_with_checksum(self, read_io):
+        # Whole-blob reads take the fused read+CRC path; record those too.
+        pages = await super().read_with_checksum(read_io)
+        if pages is not None:
+            await self._record(read_io.path)
+        return pages
 
 
 def _patch_plugin(cls):

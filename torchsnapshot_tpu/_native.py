@@ -151,6 +151,15 @@ def _declare(l: ctypes.CDLL) -> None:
         ctypes.c_int,
     ]
     l.ts_write_file_crc.restype = ctypes.c_int
+    l.ts_pread_crc.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    l.ts_pread_crc.restype = ctypes.c_int
 
 
 def _raise_errno(rc: int, path: str) -> None:
@@ -253,6 +262,28 @@ def crc32c(buf, seed: int = 0) -> Optional[int]:
         return None
     mv = memoryview(buf).cast("B")
     return int(l.ts_crc32c(_addr_of(mv), mv.nbytes, seed & 0xFFFFFFFF))
+
+
+def pread_into_crc(
+    path: str, out, page_size: int, offset: int = 0
+) -> Optional[List[int]]:
+    """Fused read + integrity pass: fills ``out`` and returns the CRC32-C
+    of each ``page_size`` page, computed while the page is cache-hot from
+    the read. None when native is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    mv = memoryview(out).cast("B")
+    if mv.readonly:
+        raise ValueError("pread_into_crc requires a writable buffer")
+    n_pages = (mv.nbytes + page_size - 1) // page_size
+    crcs = (ctypes.c_uint32 * max(1, n_pages))()
+    rc = l.ts_pread_crc(
+        path.encode(), _addr_of(mv), mv.nbytes, offset, page_size, crcs
+    )
+    if rc != 0:
+        _raise_errno(rc, path)
+    return [int(crcs[i]) for i in range(n_pages)]
 
 
 def write_file_crc(

@@ -208,6 +208,42 @@ def verify_checksum(buf: BufferType, expected: Tuple, path: str) -> None:
         )
 
 
+def verify_page_crcs(
+    pages: list, nbytes: int, expected: Tuple, path: str
+) -> bool:
+    """Verify a whole blob from per-page digests computed during its read
+    (the fused native read+CRC path) — no second pass over the bytes.
+    Pure GF(2) arithmetic: O(pages), independent of blob size.
+
+    Returns True when verification ran (raising :class:`ChecksumError`
+    on mismatch); False when the entry cannot be checked from these
+    pages (non-crc32c table, or an interim-format entry recorded with a
+    different page size) — the caller then verifies the buffer itself."""
+    alg, crc, exp_nbytes = expected[0], expected[1], expected[2]
+    if nbytes != exp_nbytes:
+        raise ChecksumError(
+            f"{path}: size mismatch (expected {exp_nbytes} bytes, "
+            f"read {nbytes})"
+        )
+    if alg != "crc32c":
+        return False  # pages are crc32c; a foreign-alg table needs the bytes
+    if crc is None:
+        # Interim paged format (no whole digest): page lists compare only
+        # at matching granularity.
+        if len(expected) >= 5 and expected[3] == PAGE_SIZE:
+            if list(expected[4]) != list(pages):
+                raise ChecksumError(f"{path}: crc32c page digests mismatch")
+            return True
+        return False
+    folded = entry_from_page_crcs(pages, nbytes, alg)
+    if folded[1] != crc:
+        raise ChecksumError(
+            f"{path}: {alg} mismatch (expected {crc:#010x}, "
+            f"got {folded[1]:#010x})"
+        )
+    return True
+
+
 def verify_range_checksum(
     buf: BufferType, expected: Tuple, byte_range: Tuple[int, int], path: str
 ) -> bool:

@@ -113,6 +113,14 @@ class StoragePlugin(abc.ABC):
     @abc.abstractmethod
     async def read(self, read_io: ReadIO) -> None: ...
 
+    async def read_with_checksum(self, read_io: ReadIO):
+        """Optional fused whole-blob read + integrity pass: fill
+        ``read_io.buf`` AND return the CRC32-C of each integrity page
+        (``integrity.PAGE_SIZE``), computed in the same pass. Return
+        ``None`` (having read nothing) to decline — the scheduler then
+        calls :meth:`read` and verifies separately."""
+        return None
+
     @abc.abstractmethod
     async def delete(self, path: str) -> None: ...
 

@@ -251,4 +251,31 @@ int ts_write_file_crc(const char* path, const void* buf, uint64_t len,
   return rc;
 }
 
+// Fused read + integrity pass, the mirror of ts_write_file_crc: read
+// `len` bytes at `offset` while computing each `page_size` page's
+// CRC32-C (seed 0, the integrity table's page format) cache-hot.
+int ts_pread_crc(const char* path, void* buf, uint64_t len, uint64_t offset,
+                 uint64_t page_size, uint32_t* out_page_crcs) {
+  if (page_size == 0) return -EINVAL;
+  int fd = ::open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return -errno;
+  const bool hw = crc32c_hw_available();
+  char* p = static_cast<char*>(buf);
+  uint64_t done = 0;
+  int rc = 0;
+  uint64_t page = 0;
+  while (done < len) {
+    uint64_t n = len - done < page_size ? len - done : page_size;
+    rc = read_all(fd, p + done, n, offset + done);
+    if (rc != 0) break;
+    const unsigned char* q = reinterpret_cast<const unsigned char*>(p + done);
+    uint32_t crc = 0xFFFFFFFFu;
+    crc = hw ? crc32c_hw(q, n, crc) : crc32c_sw(q, n, crc);
+    out_page_crcs[page++] = ~crc;
+    done += n;
+  }
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
 }  // extern "C"

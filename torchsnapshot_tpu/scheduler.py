@@ -36,6 +36,7 @@ from .integrity import (
     ChecksumTable,
     compute_checksum_entry,
     verify_checksum,
+    verify_page_crcs,
     verify_range_checksum,
 )
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
@@ -387,12 +388,26 @@ async def execute_read_reqs(
     )
     io_slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
     verify_skipped = [0]
+    # Sticky runtime-decline for the fused read+CRC path (mirrors the
+    # write pipeline's flag): once a plugin declines, later reads skip
+    # the attempt. Plugins that never overrode the hook start declined.
+    fused_read_declined = (
+        type(storage).read_with_checksum
+        is StoragePlugin.read_with_checksum
+    )
 
     async def read_one(req: ReadReq) -> None:
+        nonlocal fused_read_declined
         cost = req.buffer_consumer.get_consuming_cost_bytes()
         await budget.acquire(cost)
         stats.pending -= 1
         try:
+            entry = (
+                checksum_table.get(req.path)
+                if checksum_table is not None
+                else None
+            )
+            fused_pages = None
             async with io_slots:
                 stats.io += 1
                 read_io = ReadIO(
@@ -401,7 +416,19 @@ async def execute_read_reqs(
                     dest=req.buffer_consumer.direct_destination(),
                 )
                 try:
-                    await storage.read(read_io)
+                    # Fused read+verify source: one cache-hot pass
+                    # computes the page digests during the disk read.
+                    if (
+                        entry is not None
+                        and entry[0] == "crc32c"
+                        and req.byte_range is None
+                        and not fused_read_declined
+                    ):
+                        fused_pages = await storage.read_with_checksum(read_io)
+                        if fused_pages is None:
+                            fused_read_declined = True
+                    if fused_pages is None:
+                        await storage.read(read_io)
                 finally:
                     stats.io -= 1
             buf = read_io.buf
@@ -416,14 +443,25 @@ async def execute_read_reqs(
             # 'checksums on' is never silently hollow. Runs before the
             # value is handed to the application either way (direct reads
             # land in framework-owned buffers only).
-            if checksum_table is not None and req.path in checksum_table:
+            if entry is not None:
                 loop_ = asyncio.get_running_loop()
-                if req.byte_range is None:
+                verified_from_pages = False
+                if fused_pages is not None:
+                    # Pure GF(2) fold over the pages read — O(pages),
+                    # no second pass over the bytes, no executor hop.
+                    # False = this entry needs the bytes (foreign alg /
+                    # mismatched interim granularity): verify below.
+                    verified_from_pages = verify_page_crcs(
+                        fused_pages, memoryview(buf).nbytes, entry, req.path
+                    )
+                if verified_from_pages:
+                    pass
+                elif req.byte_range is None:
                     await loop_.run_in_executor(
                         executor,
                         verify_checksum,
                         buf,
-                        checksum_table[req.path],
+                        entry,
                         req.path,
                     )
                 else:
@@ -431,7 +469,7 @@ async def execute_read_reqs(
                         executor,
                         verify_range_checksum,
                         buf,
-                        checksum_table[req.path],
+                        entry,
                         req.byte_range,
                         req.path,
                     )

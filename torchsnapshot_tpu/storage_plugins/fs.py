@@ -118,6 +118,40 @@ class FSStoragePlugin(StoragePlugin):
                     )
         read_io.buf = memoryview(data)
 
+    async def read_with_checksum(self, read_io: ReadIO):
+        """Fused whole-blob read + integrity pass: fills ``read_io.buf``
+        and returns the CRC32-C of each integrity page, computed while
+        the page is cache-hot from the read. None (nothing read) when the
+        native runtime is unavailable or the read is ranged — the
+        scheduler then plain-reads and verifies separately."""
+        if not self._native or read_io.byte_range is not None:
+            return None
+        from ..integrity import PAGE_SIZE
+
+        full_path = self._full_path(read_io.path)
+        loop = asyncio.get_running_loop()
+
+        def _read_crc():
+            with trace_annotation("ts:read"):
+                length = _native.file_size(full_path)
+                if length is None:
+                    return None
+                if read_io.dest is not None and read_io.dest.nbytes == length:
+                    out = read_io.dest
+                else:
+                    out = bytearray(length)
+                pages = _native.pread_into_crc(full_path, out, PAGE_SIZE)
+                if pages is None:
+                    return None
+                return out, pages
+
+        res = await loop.run_in_executor(None, _read_crc)
+        if res is None:
+            return None
+        out, pages = res
+        read_io.buf = out if out is read_io.dest else memoryview(out)
+        return pages
+
     def _native_read(self, full_path: str, read_io: ReadIO):
         """Read via the native lib; None if it became unavailable."""
         with trace_annotation("ts:read"):
