@@ -12,7 +12,6 @@ import numpy as np
 import torchsnapshot_tpu as ts
 from torchsnapshot_tpu.dist_store import InProcessStore, ProcessGroup
 from torchsnapshot_tpu.preemption import PreemptionSaver
-from torchsnapshot_tpu.test_utils import multiprocess_test
 
 
 def test_single_process_signal_triggers_next_should_save():
