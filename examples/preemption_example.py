@@ -1,0 +1,100 @@
+"""Preemption-aware training: a SIGTERM mid-run becomes one consistent
+checkpoint, and the next run resumes from it.
+
+Single process (a timer delivers a real SIGTERM to this process):
+
+    python examples/preemption_example.py --work-dir /tmp/ts_preempt_example
+    python examples/preemption_example.py --work-dir /tmp/ts_preempt_example  # resumes
+
+Two processes (the notice lands on rank 1 ONLY; the whole world still
+saves the same step — the agreement docs/preemption.md describes):
+
+    python examples/preemption_example.py --nproc 2
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import torchsnapshot_tpu as ts  # noqa: E402
+
+TOTAL_STEPS = 500
+
+
+def train(pg, work_dir: str, evict_rank: int, evict_after_s: float):
+    rank = getattr(pg, "rank", 0)
+    mgr = ts.CheckpointManager(work_dir, pg=pg)
+    saver = ts.PreemptionSaver(
+        pg=pg, signals=(signal.SIGTERM,), poll_interval=0.1
+    )
+    if rank == evict_rank:
+        # Stand-in for the cloud's eviction notice: a real SIGTERM to
+        # this process, mid-training.
+        threading.Timer(
+            evict_after_s, lambda: os.kill(os.getpid(), signal.SIGTERM)
+        ).start()
+
+    state = {"w": jnp.zeros((128,)), "lr": 1e-3}
+    app_state = lambda step: {  # noqa: E731
+        "train": ts.PyTreeState(state),
+        "progress": ts.StateDict(step=step),
+    }
+    start = mgr.restore_latest(
+        {"train": ts.PyTreeState(state), "progress": ts.StateDict(step=-1)}
+    )
+    first = 0 if start is None else start + 1
+    if rank == 0:
+        print(f"starting at step {first}" + (" (resumed)" if start else ""))
+
+    for step in range(first, TOTAL_STEPS):
+        time.sleep(0.02)  # the "train step"
+        state = {"w": state["w"] + 1.0, "lr": state["lr"]}
+        if saver.should_save(step):
+            mgr.save(step, app_state(step))
+            if rank == 0:
+                print(f"preemption save committed at step {step}; exiting")
+            saver.close()
+            return step
+    else:
+        if saver.pending_save():
+            mgr.save(TOTAL_STEPS - 1, app_state(TOTAL_STEPS - 1))
+    saver.close()
+    if rank == 0:
+        print("training finished without preemption")
+    return None
+
+
+def _worker(pg, work_dir: str):
+    return train(pg, work_dir, evict_rank=1, evict_after_s=1.0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--work-dir", default="/tmp/ts_preempt_example")
+    p.add_argument("--nproc", type=int, default=1)
+    args = p.parse_args()
+
+    if args.nproc == 1:
+        train(None, args.work_dir, evict_rank=0, evict_after_s=1.0)
+        return
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    saved = run_multiprocess(_worker, args.nproc, args=(args.work_dir,))
+    assert len(set(saved)) == 1, saved
+    print(f"all {args.nproc} ranks saved the same step: {saved[0]}")
+
+
+if __name__ == "__main__":
+    main()

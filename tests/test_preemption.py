@@ -63,7 +63,10 @@ def test_agreement_with_drifted_ranks_in_process():
     # the 2-process e2e test below, via the background poller).
     s1.request_save()
     s0.request_save()
+    # Seeding a fake rank entry means also joining the counter the
+    # rendezvous waits on (one RPC per tick instead of per-rank polls).
     store.set("__preemption//step/0", b"4")  # default session "" in the key
+    store.add("__preemption//step_count", 1)
     assert not s1.should_save(7)  # agreement runs; target = max(4,7)+1 = 8
     assert s1._target_step == 8
     # Rank 0's own rendezvous (at step 4, matching the seed) agrees.
@@ -134,6 +137,7 @@ def test_pending_save_when_target_past_loop_end():
     s1.request_save()
     s0.request_save()
     store.set("__preemption//step/0", str(last_step).encode())
+    store.add("__preemption//step_count", 1)
     assert not s1.should_save(last_step)  # target = 10 > last step
     assert s1._target_step == last_step + 1
     assert not s0.should_save(last_step)  # same agreement on rank 0
@@ -151,6 +155,7 @@ def test_session_namespacing_isolates_stale_state():
     store.set("__preemption/run1/flag", b"1")
     store.set("__preemption/run1/step/0", b"7")
     store.set("__preemption/run1/step/1", b"7")
+    store.add("__preemption/run1/step_count", 2)
 
     fresh = PreemptionSaver(
         ProcessGroup(store, 0, 2), signals=(), session="run2",

@@ -105,9 +105,11 @@ class StoragePlugin(abc.ABC):
         """Optional fused write + integrity pass: persist ``write_io`` AND
         return its checksum-table entry (``integrity.ChecksumTable``
         value), computed in the same pass over the bytes. Return ``None``
-        to decline — the scheduler then computes the checksum separately
-        and calls :meth:`write` (the default for every plugin without a
-        native fused path)."""
+        to decline — having written NOTHING: the scheduler then computes
+        the checksum separately and calls :meth:`write` (the default for
+        every plugin without a native fused path). Declining is STICKY
+        for the rest of the pipeline run (it signals a capability, e.g.
+        "no native runtime here", not a per-request choice)."""
         return None
 
     @abc.abstractmethod
@@ -118,7 +120,9 @@ class StoragePlugin(abc.ABC):
         ``read_io.buf`` AND return the CRC32-C of each integrity page
         (``integrity.PAGE_SIZE``), computed in the same pass. Return
         ``None`` (having read nothing) to decline — the scheduler then
-        calls :meth:`read` and verifies separately."""
+        calls :meth:`read` and verifies separately. Declining is STICKY
+        for the rest of the pipeline run (a capability signal, not a
+        per-request choice); ranged reads never reach this hook."""
         return None
 
     @abc.abstractmethod

@@ -135,13 +135,27 @@ class PreemptionSaver:
             return
 
         def poll() -> None:
+            failures = 0
             while not self._stop_poller.wait(self.poll_interval):
                 try:
                     if store.try_get(self._key("flag")) is not None:
                         self._remote_flagged.set()
                         return
-                except Exception:  # noqa: BLE001 - store teardown race
-                    return
+                    failures = 0
+                except Exception as e:  # noqa: BLE001 - transient store hiccup
+                    failures += 1
+                    if failures >= 5:
+                        logger.error(
+                            "preemption flag poller giving up after %d "
+                            "consecutive store failures (%r): this rank "
+                            "will not observe remote eviction notices",
+                            failures,
+                            e,
+                        )
+                        return
+                    logger.warning(
+                        "preemption flag poll failed (%r); retrying", e
+                    )
 
         self._poller = threading.Thread(
             target=poll, name="preemption-flag-poll", daemon=True
@@ -243,8 +257,12 @@ class PreemptionSaver:
         without it would be a lone save (permanent block inside the
         distributed take). The grace sleep outlasts the gap between a
         peer's deadline expiry and its abandoned-marker publish — cheap
-        against the checkpoint we are about to write."""
-        time.sleep(0.25)
+        against the checkpoint we are about to write. Residual window: a
+        peer whose marker *publish itself* stalls longer than the grace
+        (store unreachable during the eviction) can still be missed;
+        timeout-based agreement cannot close that without a third phase,
+        and a store that broken would fail the save anyway."""
+        time.sleep(1.0)
         return store.try_get(self._key("abandoned")) is not None
 
     def pending_save(self) -> bool:
@@ -308,38 +326,37 @@ class PreemptionSaver:
         rank = self._pg.get_rank()
         world = self._pg.get_world_size()
         store.set(self._key(f"step/{rank}"), str(step).encode())
+        joined = store.add(self._key("step_count"), 1)
         deadline = time.monotonic() + self.rendezvous_timeout
-        steps: List[Optional[bytes]] = [None] * world
-        # done/abandoned are coarse conditions (a finished or timed-out
-        # peer aborts the save either way): check them ~1/s, not per
-        # 50ms tick — O(world) coordinator RPCs per tick otherwise,
-        # during the grace window when coordinator latency matters most.
+        # Steady wait costs ONE coordinator RPC per 50ms tick (the join
+        # counter); per-rank step keys are read once, after the counter
+        # says everyone published. done/abandoned are coarse conditions
+        # (a finished or timed-out peer aborts the save either way):
+        # checked ~1/s.
         next_abort_check = 0.0
         while time.monotonic() < deadline:
-            check_abort = time.monotonic() >= next_abort_check
-            if check_abort:
+            if time.monotonic() >= next_abort_check:
                 next_abort_check = time.monotonic() + 1.0
                 if store.try_get(self._key("abandoned")) is not None:
                     logger.error("a peer abandoned the preemption rendezvous")
                     return None
-            missing = False
-            for r in range(world):
-                if steps[r] is None:
-                    steps[r] = store.try_get(self._key(f"step/{r}"))
-                    if steps[r] is None:
-                        missing = True
+                for r in range(world):
+                    if store.try_get(self._key(f"done/{r}")) is not None:
                         # A peer that finished training will never join;
                         # abandon now, not at the timeout.
-                        if check_abort and store.try_get(
-                            self._key(f"done/{r}")
-                        ) is not None:
-                            logger.error(
-                                "rank %d finished training before joining "
-                                "the preemption rendezvous",
-                                r,
-                            )
-                            return None
-            if not missing:
-                return max(int(s.decode()) for s in steps) + 1
+                        logger.error(
+                            "rank %d finished training before joining "
+                            "the preemption rendezvous",
+                            r,
+                        )
+                        return None
+            if joined < world:
+                joined = store.add(self._key("step_count"), 0)
+            if joined >= world:
+                steps: List[Optional[bytes]] = [
+                    store.try_get(self._key(f"step/{r}")) for r in range(world)
+                ]
+                if all(s is not None for s in steps):
+                    return max(int(s.decode()) for s in steps) + 1
             time.sleep(0.05)
         return None
