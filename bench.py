@@ -3,38 +3,56 @@ local FS, the analog of the reference's DDP benchmark
 (benchmarks/ddp/README.md: 20 GB model, 1 node x 1 GPU -> ~13.91 s,
 ~1.4 GB/s on local FS — BASELINE.md).
 
-Prints ONE JSON line with the three north stars (BASELINE.md):
+Prints ONE JSON line with the north stars (BASELINE.md):
 
-- save GB/s: median of 3 timed takes with [min, max] range (the dev
+- save GB/s: median of 5 timed takes with [min, max] range (the dev
   tunnel's D2H fluctuates 2-4x between runs; a single trial can't
   support a committed ratio), and pipeline_efficiency = median of the
-  per-trial take/probe ratios, where each take is paired with a
-  temporally-adjacent PATTERN-MATCHED attainable-D2H probe (same stream
-  count and transfer size) so intra-run link drift cancels per pair. A
-  value > 1 means the link sped up between probe and take (the probe is
-  a lower bound of attainable).
+  per-trial take/probe ratios, where each take is BRACKETED by
+  temporally-adjacent PATTERN-MATCHED attainable-D2H probes (same
+  stream count and transfer size, one before and one after) and
+  divided by the better of the two — each probe is a lower bound of
+  attainable, so the bracket's max is the tightest attainable estimate
+  for that trial's time window. ``link_unstable`` is set when adjacent
+  probes disagree by >1.5x (the link drifted faster than the bracket
+  can cancel); the raw probe/take series ship in the record either way.
 - restore GB/s: median of 3 timed restores into device-committed
   destinations (storage reads + H2D placement), checksums on.
 - async-take stall: wall time until async_take returns (staging done,
-  training would resume) vs total time to durable commit.
+  training would resume) vs time to durable commit — on this tunneled
+  chip plus, fail-soft, ``cpu_mesh_stall_ms``: the same split for the
+  sharded-transformer workload on an 8-device CPU mesh, where staging
+  is NOT the D2H and the stall is the real overlap story.
+- orbax head-to-head (fail-soft): interleaved A/B on the CPU mesh,
+  ``orbax_save_ratio`` / ``orbax_restore_ratio`` = orbax median / ours
+  (>1 = we are faster), our checksums ON.
 
 Context fields: incremental unchanged-state save, and the CPU-backend
 protocol-overhead scaling rows (per-rank bytes written must halve at 2
 ranks; protocol wall stays ~flat — benchmarks/replicated_save/
 protocol_overhead.py), both fail-soft.
 
+After measuring, the result is also written into BENCH.md's
+BENCH_SIGNAL_OF_RECORD block (single source of truth — the committed
+doc cannot drift from the newest record; ``tools/check_bench_docs.py``
+verifies). ``python bench.py --sync-docs`` rewrites the block from the
+newest ``BENCH_r*.json`` without running any benchmark.
+
 Size configurable via TS_BENCH_GB (default 4; 1 on tunneled links).
-TS_BENCH_SKIP_PROTOCOL=1 skips the subprocess leg.
+TS_BENCH_TRIALS overrides the take-trial count.
+TS_BENCH_SKIP_PROTOCOL=1 skips all subprocess legs.
 """
 
 import json
 import os
+import re
 import shutil
 import statistics
 import subprocess
 import sys
 import tempfile
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -116,32 +134,121 @@ def _median_range(samples):
     ]
 
 
-def protocol_overhead_rows():
-    """CPU-backend multi-process protocol scaling (fail-soft)."""
+def _cpu_mesh_env() -> dict:
+    """Env for a CPU-backend subprocess leg: 8 virtual devices so the
+    leg exercises real GSPMD shardings regardless of this host's chip."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TS_BENCH_GB", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+        env["XLA_FLAGS"] = flags
+    return env
+
+
+def _subprocess_json(label: str, script_parts, args, timeout: float):
+    """Run a benchmark script on the CPU backend; parse its final stdout
+    line as JSON. Fail-soft: every leg is a context metric — a broken leg
+    logs and returns None instead of killing the headline record."""
     if os.environ.get("TS_BENCH_SKIP_PROTOCOL") == "1":
         return None
     script = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "benchmarks",
-        "replicated_save",
-        "protocol_overhead.py",
+        os.path.dirname(os.path.abspath(__file__)), *script_parts
     )
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("TS_BENCH_GB", None)
     try:
         proc = subprocess.run(
-            [sys.executable, script, "--gb", "0.125"],
-            env=env,
+            [sys.executable, script, *args],
+            env=_cpu_mesh_env(),
             capture_output=True,
             text=True,
-            timeout=900,
+            timeout=timeout,
         )
         if proc.returncode != 0:
             raise RuntimeError(proc.stderr.strip()[-500:])
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001 - context metric only
-        _log(f"bench: protocol-overhead leg failed: {e!r}")
+        _log(f"bench: {label} leg failed: {e!r}")
         return None
+
+
+def protocol_overhead_rows():
+    """CPU-backend multi-process protocol scaling (fail-soft)."""
+    return _subprocess_json(
+        "protocol-overhead",
+        ("benchmarks", "replicated_save", "protocol_overhead.py"),
+        ["--gb", "0.125"],
+        timeout=900,
+    )
+
+
+def cpu_mesh_stall_row():
+    """North star: async-take stall on the sharded-transformer workload,
+    8-device CPU mesh — the regime where staging is NOT the device link
+    and the stall measures the pipeline's real overlap (fail-soft)."""
+    return _subprocess_json(
+        "cpu-mesh-stall",
+        ("benchmarks", "sharded_transformer", "main.py"),
+        ["--d-model", "512", "--layers", "8", "--async-take", "--json"],
+        timeout=900,
+    )
+
+
+def orbax_row():
+    """North star: head-to-head vs the TPU incumbent, interleaved A/B on
+    the CPU mesh, our checksums ON (fail-soft)."""
+    return _subprocess_json(
+        "orbax-compare",
+        ("benchmarks", "orbax_compare", "main.py"),
+        ["--gb", "1", "--trials", "3", "--json"],
+        timeout=1800,
+    )
+
+
+DOC_BLOCK_RE = re.compile(
+    r"<!-- BENCH_SIGNAL_OF_RECORD.*?-->\s*```json\s*\{.*?\}\s*```",
+    re.DOTALL,
+)
+
+
+def write_signal_of_record(record: dict) -> None:
+    """Rewrite BENCH.md's signal-of-record block in place (single source
+    of truth: the block is generated from the measured record, never
+    hand-maintained; tools/check_bench_docs.py verifies it against the
+    newest driver-captured BENCH_r*.json)."""
+    bench_md = Path(__file__).resolve().parent / "BENCH.md"
+    try:
+        text = bench_md.read_text()
+        block = (
+            "<!-- BENCH_SIGNAL_OF_RECORD: generated by bench.py; verified "
+            "against the newest BENCH_r*.json -->\n```json\n"
+            + json.dumps(record, indent=2)
+            + "\n```"
+        )
+        new_text, n = DOC_BLOCK_RE.subn(lambda _: block, text, count=1)
+        if n != 1:
+            raise RuntimeError("no BENCH_SIGNAL_OF_RECORD block found")
+        if new_text != text:
+            bench_md.write_text(new_text)
+            _log("bench: BENCH.md signal-of-record block updated")
+    except Exception as e:  # noqa: BLE001 - docs update must not kill output
+        _log(f"bench: BENCH.md update failed: {e!r}")
+
+
+def sync_docs() -> int:
+    """--sync-docs: regenerate BENCH.md's block from the newest
+    BENCH_r*.json (no benchmarking). The record is located by the
+    *verifier's* own ``newest_record`` so the writer and the checker can
+    never disagree about which record is the signal of record."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+    from check_bench_docs import newest_record
+
+    record, path = newest_record()
+    if record is None:
+        _log("bench: no BENCH_r*.json found; nothing to sync")
+        return 1
+    write_signal_of_record(record)
+    _log(f"bench: synced BENCH.md from {path.name}")
+    return 0
 
 
 def main() -> None:
@@ -175,41 +282,53 @@ def main() -> None:
         warm = {"x": jnp.ones((1024, 1024), jnp.bfloat16)}
         ts.Snapshot.take(os.path.join(workdir, "warm"), {"s": ts.PyTreeState(warm)})
 
-        # Headline: median of 3 PLAIN takes — comparable to the reference
+        # Headline: median of N PLAIN takes — comparable to the reference
         # baseline and earlier rounds (no digest recording in the timed
         # path). Every trial snapshots a FRESH state: jax caches host
         # copies per array, and re-taking cached arrays would time a
-        # memcpy instead of the device link. On tunneled links each take
-        # is paired with a PATTERN-MATCHED ceiling probe (same stream
-        # count and transfer size as the take's leaves, interleaved in
-        # time): the link drifts minute-to-minute, so an efficiency ratio
-        # is only meaningful against the attainable rate measured around
-        # each trial with the same transfer shape.
+        # memcpy instead of the device link. On tunneled links every take
+        # is BRACKETED by PATTERN-MATCHED ceiling probes (same stream
+        # count and transfer size as the take's leaves): the link drifts
+        # 2x+ minute-to-minute, so each trial's efficiency is achieved /
+        # max(probe_before, probe_after) — probes are lower bounds of
+        # attainable, and the bracket's max is the tightest estimate for
+        # that trial's time window. The probe after take i doubles as the
+        # probe before take i+1.
+        trials = int(
+            os.environ.get("TS_BENCH_TRIALS", "5" if tunneled else "3")
+        )
         dest_template = {k: (v.shape, v.dtype) for k, v in state.items()}
         take_times = []
-        matched_ceilings = []
+        matched_probes = []
         trial_state = state
         state = None  # one state on device at a time: 1x HBM, not 2x
         n_blocks = max(1, total_bytes // (16384 * 8192 * 2))
         probe_streams = min(4, n_blocks)
-        for i in range(3):
-            if tunneled:
-                mc = probe_d2h(probe_streams, chunk_mib=256)
-                matched_ceilings.append(mc)
-                _log(
-                    f"bench: matched ceiling probe {i} "
-                    f"({probe_streams}x256 MiB): {mc:.3f} GB/s"
-                )
+
+        def matched_probe(tag: str) -> None:
+            mc = probe_d2h(probe_streams, chunk_mib=256)
+            matched_probes.append(mc)
+            _log(
+                f"bench: matched ceiling probe {tag} "
+                f"({probe_streams}x256 MiB): {mc:.3f} GB/s"
+            )
+
+        if tunneled:
+            matched_probe("before take 0")
+        for i in range(trials):
             path = os.path.join(workdir, f"snap{i}")
             t0 = time.perf_counter()
             ts.Snapshot.take(path, {"state": ts.PyTreeState(trial_state)})
             take_times.append(time.perf_counter() - t0)
             _log(f"bench: take {i}: {take_times[-1]:.2f} s")
-            if i < 2:
+            if tunneled:
+                matched_probe(f"after take {i}")
+            if i < trials - 1:
                 shutil.rmtree(path, ignore_errors=True)
                 trial_state = None
                 trial_state = make_state(total_bytes, seed=i + 1)
-        state = trial_state  # snap2's source; later phases reuse it
+        state = trial_state  # last snap's source; later phases reuse it
+        last_snap = os.path.join(workdir, f"snap{trials - 1}")
         save_med_s = statistics.median(take_times)
         save_gbps, save_range = _median_range([gib / t for t in take_times])
 
@@ -221,7 +340,7 @@ def main() -> None:
         restore_times = []
         try:
             dev = jax.devices()[0]
-            snap = ts.Snapshot(os.path.join(workdir, "snap2"))
+            snap = ts.Snapshot(last_snap)
             for i in range(3):
                 dest = ts.PyTreeState(
                     {
@@ -301,25 +420,40 @@ def main() -> None:
     # available).
     ceiling_after = max(probe_d2h(1), probe_ceiling(tunneled))
     ceiling = max(ceiling_before, ceiling_after)
-    if matched_ceilings:
-        # Median of per-trial ratios: each take divided by its own
-        # temporally-adjacent matched probe, so intra-run link drift
-        # (observed 2.6x within one run) cancels per pair. A ratio > 1
-        # means the link sped up between probe and take — the probe is a
-        # lower bound of attainable, and the pipeline is not the limit.
-        denom = statistics.median(matched_ceilings)
+    link_unstable = False
+    if matched_probes:
+        # Per-trial ratio: take i divided by the better of its bracketing
+        # probes (probe i before, probe i+1 after). Probes are lower
+        # bounds of attainable, so the bracket's max is the tightest
+        # attainable estimate covering that trial's time window; pairing
+        # in time cancels intra-run link drift (observed 2.6x within one
+        # run). A ratio > 1 means the link outran both probes during the
+        # take — the pipeline is not the limit there.
+        denom = statistics.median(matched_probes)
+        brackets = [
+            max(matched_probes[i], matched_probes[i + 1])
+            for i in range(len(take_times))
+        ]
         ratios = [
-            (gib / t) / c for t, c in zip(take_times, matched_ceilings) if c > 0
+            (gib / t) / b for t, b in zip(take_times, brackets) if b > 0
         ]
         efficiency = statistics.median(ratios) if ratios else 0.0
+        link_unstable = any(
+            max(a, b) / min(a, b) > 1.5
+            for a, b in zip(matched_probes, matched_probes[1:])
+            if min(a, b) > 0
+        )
         _log(
-            f"bench: matched-pattern ceiling median {denom:.3f} GB/s, "
-            f"per-trial efficiency ratios "
-            f"{[round(r, 2) for r in ratios]} (generic probes: before "
+            f"bench: matched-probe series "
+            f"{[round(c, 3) for c in matched_probes]} GB/s "
+            f"(median {denom:.3f}), per-trial bracketed efficiency ratios "
+            f"{[round(r, 2) for r in ratios]}, link_unstable="
+            f"{link_unstable} (generic probes: before "
             f"{ceiling_before:.3f} / after {ceiling_after:.3f})"
         )
     else:
         denom = ceiling
+        ratios = []
         efficiency = save_gbps / denom if denom > 0 else 0.0
         _log(
             f"bench: ceiling before {ceiling_before:.3f} / after "
@@ -343,9 +477,12 @@ def main() -> None:
         ],
         "d2h_single_gbps": round(d2h_single, 3),
         "size_gib": round(gib, 2),
+        "take_times_s": [round(t, 2) for t in take_times],
     }
-    if matched_ceilings:
-        result["d2h_matched_probes"] = [round(c, 3) for c in matched_ceilings]
+    if matched_probes:
+        result["d2h_matched_probes"] = [round(c, 3) for c in matched_probes]
+        result["efficiency_ratios"] = [round(r, 3) for r in ratios]
+        result["link_unstable"] = link_unstable
     if restore_times:
         med, rng = _median_range([gib / t for t in restore_times])
         result["restore_gbps"] = med
@@ -359,8 +496,47 @@ def main() -> None:
     proto = protocol_overhead_rows()
     if proto is not None:
         result["protocol_overhead"] = proto
+    mesh_row = cpu_mesh_stall_row()
+    if mesh_row is not None and "stall_ms" in mesh_row:
+        result["cpu_mesh_stall_ms"] = mesh_row["stall_ms"]
+        result["cpu_mesh_save_total_s"] = mesh_row.get("save_total_s")
+        result["cpu_mesh_state_gib"] = mesh_row.get("state_gib")
+        _log(
+            f"bench: cpu-mesh async stall {mesh_row['stall_ms']} ms of "
+            f"{mesh_row.get('save_total_s')} s total "
+            f"({mesh_row.get('state_gib')} GiB sharded train state)"
+        )
+    orbax = orbax_row()
+    if orbax is not None:
+        result["orbax_save_ratio"] = orbax.get("orbax_save_ratio")
+        result["orbax_restore_ratio"] = orbax.get("orbax_restore_ratio")
+        result["orbax"] = orbax
+        _log(
+            f"bench: orbax head-to-head (1 GiB, CPU mesh, checksums on): "
+            f"save ratio {orbax.get('orbax_save_ratio')}x, restore ratio "
+            f"{orbax.get('orbax_restore_ratio')}x (orbax/ours, >1 = ours "
+            f"faster)"
+        )
+    # Regenerate BENCH.md's block only for a *default-config* run (what
+    # the driver executes): a smoke run with TS_BENCH_* overrides must
+    # not clobber the committed signal of record with numbers that will
+    # never appear in a BENCH_r*.json (use --sync-docs to restore it).
+    overrides = [
+        k
+        for k in ("TS_BENCH_GB", "TS_BENCH_TRIALS", "TS_BENCH_SKIP_PROTOCOL")
+        if os.environ.get(k)
+    ]
+    if overrides:
+        _log(
+            f"bench: {'/'.join(overrides)} set — leaving BENCH.md's "
+            f"signal-of-record block untouched (non-default run)"
+        )
+    else:
+        write_signal_of_record(result)
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    if "--sync-docs" in sys.argv[1:]:
+        sys.exit(sync_docs())
     main()

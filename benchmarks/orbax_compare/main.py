@@ -10,8 +10,10 @@ same sharded pytree with both systems and report wall time each way.
 """
 
 import argparse
+import json
 import os
 import shutil
+import statistics
 import sys
 import tempfile
 import time
@@ -116,10 +118,117 @@ def bench_orbax(path: str, state, dest) -> None:
     )
 
 
+def run_json(gb: float, trials: int) -> dict:
+    """Interleaved A/B trials, medians, and orbax/ts ratios (>1 = this
+    framework is faster). One JSON-able dict; checksums stay ON for our
+    restore (the default), which orbax's restore has no counterpart for.
+
+    Fairness guards: each system saves a FRESH state every trial (jax
+    caches an array's host copy after its first D2H — sharing one state
+    would hand whichever system saves second a memcpy instead of the
+    device link), the save order alternates per trial (neither system
+    systematically pays first-touch costs), and ``os.sync()`` runs before
+    every timed restore (background writeback from the preceding save
+    otherwise inflates restore timings up to 10x on a one-core box).
+    """
+    import orbax.checkpoint as ocp
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    total = int(gb * (1 << 30))
+    dest = make_state(mesh, total, seed=999)
+    nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(dest))
+    restore_args = ocp.args.PyTreeRestore(
+        restore_args=jax.tree_util.tree_map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding), dest
+        )
+    )
+
+    ts_saves, ts_restores, ob_saves, ob_restores = [], [], [], []
+    work_dir = tempfile.mkdtemp(prefix="ts_bench_orbax_")
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            for t in range(trials):
+                ts_state = make_state(mesh, total, seed=2 * t)
+                ob_state = make_state(mesh, total, seed=2 * t + 1)
+                ts_path = os.path.join(work_dir, f"ts{t}")
+                ob_path = os.path.join(work_dir, f"ob{t}")
+
+                def save_ts():
+                    t0 = time.perf_counter()
+                    ts.Snapshot.take(ts_path, {"m": ts.PyTreeState(ts_state)})
+                    ts_saves.append(time.perf_counter() - t0)
+
+                def save_ob():
+                    t0 = time.perf_counter()
+                    ckptr.save(ob_path, ob_state)
+                    ob_saves.append(time.perf_counter() - t0)
+
+                for save in [save_ts, save_ob] if t % 2 == 0 else [save_ob, save_ts]:
+                    save()
+
+                dest_state = ts.PyTreeState(dest)
+                os.sync()
+                t0 = time.perf_counter()
+                ts.Snapshot(ts_path).restore({"m": dest_state})
+                jax.block_until_ready(dest_state.tree)
+                ts_restores.append(time.perf_counter() - t0)
+                np.testing.assert_array_equal(
+                    np.asarray(dest_state.tree["w0"]),
+                    np.asarray(ts_state["w0"]),
+                )
+
+                os.sync()
+                t0 = time.perf_counter()
+                restored = ckptr.restore(ob_path, args=restore_args)
+                jax.block_until_ready(restored)
+                ob_restores.append(time.perf_counter() - t0)
+                np.testing.assert_array_equal(
+                    np.asarray(restored["w0"]), np.asarray(ob_state["w0"])
+                )
+                print(
+                    f"trial {t}: ts save {ts_saves[-1]:.2f}s / "
+                    f"orbax save {ob_saves[-1]:.2f}s; ts restore "
+                    f"{ts_restores[-1]:.2f}s / orbax restore "
+                    f"{ob_restores[-1]:.2f}s",
+                    file=sys.stderr,
+                )
+                del ts_state, ob_state, restored, dest_state
+                shutil.rmtree(ts_path, ignore_errors=True)
+                shutil.rmtree(ob_path, ignore_errors=True)
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+    ts_save = statistics.median(ts_saves)
+    ob_save = statistics.median(ob_saves)
+    ts_restore = statistics.median(ts_restores)
+    ob_restore = statistics.median(ob_restores)
+    return {
+        "size_gib": round(nbytes / (1 << 30), 2),
+        "trials": trials,
+        "ts_save_s": [round(x, 2) for x in ts_saves],
+        "orbax_save_s": [round(x, 2) for x in ob_saves],
+        "ts_restore_s": [round(x, 2) for x in ts_restores],
+        "orbax_restore_s": [round(x, 2) for x in ob_restores],
+        "orbax_save_ratio": round(ob_save / ts_save, 2),
+        "orbax_restore_ratio": round(ob_restore / ts_restore, 2),
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--gb", type=float, default=1.0)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="interleaved A/B trials; print one JSON line with medians "
+        "and orbax/ts ratios (bench.py consumes this)",
+    )
     args = p.parse_args()
+
+    if args.json:
+        print(json.dumps(run_json(args.gb, args.trials)))
+        return
 
     mesh = Mesh(np.array(jax.devices()), ("x",))
     state = make_state(mesh, int(args.gb * (1 << 30)), seed=0)

@@ -49,9 +49,19 @@ def _worker(pg, work_dir: str, gb: float, tiny_leaves: int):
     counters = {"bytes": 0}
 
     class CountingFSStoragePlugin(FSStoragePlugin):
+        # Both write paths must count: with the native runtime active the
+        # scheduler routes data writes through write_with_checksum (the
+        # fused write+CRC path), and a counter that hooks only write()
+        # records 0 bytes on such hosts (round-3 driver record).
         async def write(self, write_io):
-            counters["bytes"] += len(write_io.buf)
+            counters["bytes"] += memoryview(write_io.buf).cast("B").nbytes
             await super().write(write_io)
+
+        async def write_with_checksum(self, write_io):
+            entry = await super().write_with_checksum(write_io)
+            if entry is not None:  # None = declined; scheduler falls back
+                counters["bytes"] += memoryview(write_io.buf).cast("B").nbytes
+            return entry
 
     patch = mock.patch(
         "torchsnapshot_tpu.snapshot.url_to_storage_plugin",

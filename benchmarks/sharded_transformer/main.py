@@ -9,6 +9,7 @@ train state (params + adam moments).
 """
 
 import argparse
+import json
 import os
 import shutil
 import sys
@@ -37,7 +38,17 @@ def main() -> None:
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--experts", type=int, default=0)
     p.add_argument("--async-take", action="store_true")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="append one JSON line with the measurements (bench.py "
+        "consumes this; human-readable lines go to stderr)",
+    )
     args = p.parse_args()
+    out = sys.stderr if args.json else sys.stdout
+
+    def say(msg: str) -> None:
+        print(msg, file=out)
 
     cfg = TransformerConfig(
         vocab_size=args.vocab,
@@ -48,7 +59,7 @@ def main() -> None:
         n_experts=args.experts,
     )
     mesh = make_mesh()
-    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    say(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
     state = init_train_state(cfg, seed=0, mesh=mesh)
     step_fn = make_train_step(cfg, mesh=mesh)
     tokens = jax.device_put(
@@ -62,8 +73,9 @@ def main() -> None:
     nbytes = sum(
         x.nbytes for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "nbytes")
     )
-    print(f"train state: {nbytes / (1 << 30):.2f} GiB")
+    say(f"train state: {nbytes / (1 << 30):.2f} GiB")
 
+    record = {"state_gib": round(nbytes / (1 << 30), 3)}
     work_dir = tempfile.mkdtemp(prefix="ts_bench_fsdp_")
     try:
         path = os.path.join(work_dir, "snap")
@@ -73,24 +85,30 @@ def main() -> None:
             blocked = time.perf_counter() - t0
             pending.wait()
             total = time.perf_counter() - t0
-            print(
+            say(
                 f"async save: blocked {blocked:.3f}s, total {total:.2f}s "
                 f"({nbytes / (1 << 30) / total:.2f} GB/s)"
             )
+            record["stall_ms"] = round(blocked * 1000, 1)
+            record["save_total_s"] = round(total, 2)
         else:
             ts.Snapshot.take(path, {"train": ts.PyTreeState(tree)})
             total = time.perf_counter() - t0
-            print(
+            say(
                 f"sync save: {total:.2f}s ({nbytes / (1 << 30) / total:.2f} GB/s)"
             )
+            record["save_total_s"] = round(total, 2)
 
         dest = ts.PyTreeState(state.as_pytree())
         t0 = time.perf_counter()
         ts.Snapshot(path).restore({"train": dest})
         total = time.perf_counter() - t0
-        print(f"restore: {total:.2f}s ({nbytes / (1 << 30) / total:.2f} GB/s)")
+        say(f"restore: {total:.2f}s ({nbytes / (1 << 30) / total:.2f} GB/s)")
+        record["restore_s"] = round(total, 2)
     finally:
         shutil.rmtree(work_dir, ignore_errors=True)
+    if args.json:
+        print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -139,6 +139,9 @@ def test_partitioner_balances_loads() -> None:
         def all_gather_object(self, obj):
             return [obj, obj]
 
+        def gather_object(self, obj, dst=0):
+            return [obj, obj] if self.rank == dst else None
+
         def broadcast_object(self, obj, src=0):
             assert self.rank == src
             return obj

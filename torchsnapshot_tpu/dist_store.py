@@ -55,6 +55,38 @@ class BarrierError(RuntimeError):
     dist_store.py:177-193)."""
 
 
+_READ_GRACE_S = 5.0
+
+
+class _TransientReads:
+    """Tolerance tracker for deadline-bounded poll loops.
+
+    ``try_get`` raises on transport/service failures (None strictly means
+    "key definitively absent"). A poll loop should read a *brief* failure
+    as "not yet" — the deadline machinery exists to ride out hiccups —
+    but a store failing continuously must re-raise rather than be polled
+    until the full deadline: on a TCPStore, a dead socket means the
+    leader is gone, and 300 s of retries would mask a peer death."""
+
+    def __init__(self, grace: float = _READ_GRACE_S) -> None:
+        self._grace = grace
+        self._first_failure: Optional[float] = None
+
+    def read(self, fn):
+        """Run ``fn`` (a store read); None if it failed within grace."""
+        try:
+            out = fn()
+        except Exception:
+            now = time.monotonic()
+            if self._first_failure is None:
+                self._first_failure = now
+            if now - self._first_failure > self._grace:
+                raise
+            return None
+        self._first_failure = None
+        return out
+
+
 class Store(abc.ABC):
     """KV primitives + derived object collectives."""
 
@@ -64,7 +96,10 @@ class Store(abc.ABC):
     def set(self, key: str, value: bytes) -> None: ...
 
     @abc.abstractmethod
-    def try_get(self, key: str) -> Optional[bytes]: ...
+    def try_get(self, key: str) -> Optional[bytes]:
+        """The value, or None when the key is *definitively absent*.
+        Raises on transport/service failures — callers distinguishing
+        "peer did not signal" from "could not observe" depend on it."""
 
     @abc.abstractmethod
     def add(self, key: str, amount: int) -> int:
@@ -78,8 +113,9 @@ class Store(abc.ABC):
 
     def get(self, key: str, timeout: float = _DEFAULT_TIMEOUT_S) -> bytes:
         deadline = time.monotonic() + timeout
+        reads = _TransientReads()
         while True:
-            val = self.try_get(key)
+            val = reads.read(lambda: self.try_get(key))
             if val is not None:
                 return val
             if time.monotonic() > deadline:
@@ -91,10 +127,11 @@ class Store(abc.ABC):
     ) -> Dict[str, bytes]:
         """Block until at least one of ``keys`` exists; returns all present."""
         deadline = time.monotonic() + timeout
+        reads = _TransientReads()
         while True:
             present = {}
             for k in keys:
-                v = self.try_get(k)
+                v = reads.read(lambda k=k: self.try_get(k))
                 if v is not None:
                     present[k] = v
             if present:
@@ -143,6 +180,41 @@ class Store(abc.ABC):
             prefix,
             world_size,
             [f"{prefix}/{i}" for i in range(world_size)] + [f"{prefix}/__all"],
+        )
+        return out
+
+    def gather(
+        self,
+        prefix: str,
+        rank: int,
+        world_size: int,
+        obj: Any,
+        dst: int = 0,
+        timeout: float = _DEFAULT_TIMEOUT_S,
+    ) -> Optional[List[Any]]:
+        """Gather picklable objects to ``dst`` (rank order); None elsewhere.
+
+        Unlike :meth:`exchange`, non-destination ranks publish their own
+        blob and do NOT fetch the combined value: per non-dst rank the
+        store traffic is O(own blob) + one counter bump, not
+        O(world x blob) — the difference between a manifest gather that
+        funnels world² bytes through the leader's socket and one that
+        moves each manifest once (reference analog: the c10d gather the
+        reference's snapshot.py:879-901 all_gather spreads peer-to-peer;
+        here non-leaders don't need the global manifest at all — rank 0
+        alone writes metadata, and restore reads it from storage).
+        """
+        self.set(f"{prefix}/{rank}", pickle.dumps(obj))
+        out = None
+        if rank == dst:
+            out = [
+                pickle.loads(self.get(f"{prefix}/{i}", timeout))
+                for i in range(world_size)
+            ]
+        # Keys survive until every rank (dst included, which increments
+        # only after reading all blobs) has passed through _cleanup.
+        self._cleanup(
+            prefix, world_size, [f"{prefix}/{i}" for i in range(world_size)]
         )
         return out
 
@@ -379,8 +451,23 @@ class JaxCoordinationStore(Store):
     def try_get(self, key: str) -> Optional[bytes]:
         try:
             return bytes(self._client.key_value_try_get_bytes(key))
-        except Exception:
-            return None
+        except Exception as e:
+            # Only "key absent" maps to None (the coordination service
+            # reports it as a NOT_FOUND status; match the status token or
+            # a NotFound exception type so a jaxlib that re-words the
+            # message still classifies correctly). A transport/service
+            # failure must raise: callers read None as "peer did not
+            # signal", and conflating the two turns an unhealthy
+            # coordinator into a false all-clear exactly where the signal
+            # matters (e.g. the preemption grace check before a lone save).
+            msg = str(e).lower()
+            if (
+                "not_found" in msg
+                or "not found" in msg
+                or "notfound" in type(e).__name__.lower()
+            ):
+                return None
+            raise
 
     def supports_add(self) -> bool:
         """Whether this jaxlib's coordination client has atomic increment.
@@ -524,8 +611,11 @@ class LinearBarrier:
     def _key(self, name: str) -> str:
         return f"{self.prefix}/{name}"
 
-    def _check_error(self) -> None:
-        err = self.store.try_get(self._key("error"))
+    def _check_error(self, reads: Optional[_TransientReads] = None) -> None:
+        if reads is not None:
+            err = reads.read(lambda: self.store.try_get(self._key("error")))
+        else:
+            err = self.store.try_get(self._key("error"))
         if err is not None:
             exc = pickle.loads(err)
             raise BarrierError(
@@ -535,9 +625,10 @@ class LinearBarrier:
 
     def _wait_for(self, key: str, timeout: float) -> None:
         deadline = time.monotonic() + timeout
+        reads = _TransientReads()
         while True:
-            self._check_error()
-            if self.store.try_get(key) is not None:
+            self._check_error(reads)
+            if reads.read(lambda: self.store.try_get(key)) is not None:
                 return
             if time.monotonic() > deadline:
                 raise StoreTimeoutError(
@@ -555,9 +646,10 @@ class LinearBarrier:
             self._check_error()
             return
         deadline = time.monotonic() + timeout
+        reads = _TransientReads()
         while True:
-            self._check_error()
-            val = self.store.try_get(key)
+            self._check_error(reads)
+            val = reads.read(lambda: self.store.try_get(key))
             if val is not None and int(val) >= target:
                 return
             if time.monotonic() > deadline:

@@ -471,14 +471,24 @@ class IncrementalTakeContext:
             self._ref_prefix is not None,
             sorted(p for p in self._layouts if p in replicated_paths),
         )
-        gathered = pg_wrapper.all_gather_object(local)
-        if not all(has_base for has_base, _ in gathered):
+        # Gather-to-leader + broadcast of the two decided facts: every
+        # rank applies the same decision without pulling every rank's
+        # launched-leaf list (O(world x leaves) per rank at torchrec
+        # scale) through the coordinator.
+        gathered = pg_wrapper.gather_object(local)
+        decision = None
+        if gathered is not None:
+            all_have_base = all(has_base for has_base, _ in gathered)
+            common_set = set(gathered[0][1])
+            for _, launched in gathered[1:]:
+                common_set &= set(launched)
+            decision = (all_have_base, sorted(common_set))
+        all_have_base, common_list = pg_wrapper.broadcast_object(decision)
+        common = set(common_list)
+        if not all_have_base:
             # Some rank can't reference the base: nobody may.
             self._base_available = {}
             self._ref_prefix = None
-        common = set(gathered[0][1])
-        for _, launched in gathered[1:]:
-            common &= set(launched)
         for path in list(self._layouts):
             if path in replicated_paths and path not in common:
                 del self._layouts[path]

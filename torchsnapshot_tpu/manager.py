@@ -109,9 +109,16 @@ class _PendingManagedSnapshot:
 
     def wait(self) -> Snapshot:
         snapshot = self._pending.wait()  # raises on failed take: no index entry
+        # refs feed the rank-0-only index commit; non-leader ranks carry
+        # no metadata object (the manifest gather is to-leader) and must
+        # not pull the global manifest from storage just to drop it.
         self._manager._commit_step(
             self._step,
-            refs=referenced_steps(self._pending._metadata.manifest),
+            refs=(
+                referenced_steps(snapshot.metadata.manifest)
+                if self._manager._pg.get_rank() == 0
+                else None
+            ),
             metric=self._metric,
         )
         return snapshot
@@ -203,7 +210,11 @@ class CheckpointManager:
         )
         self._commit_step(
             step,
-            refs=referenced_steps(snapshot.metadata.manifest),
+            refs=(
+                referenced_steps(snapshot.metadata.manifest)
+                if self._pg.get_rank() == 0
+                else None
+            ),
             metric=metric,
         )
         return snapshot

@@ -77,11 +77,15 @@ def partition_write_reqs(
         for path, req in replicated_reqs.items()
     }
 
-    gathered_items = pg_wrapper.all_gather_object(sorted(local_items.items()))
-    gathered_loads = pg_wrapper.all_gather_object(base_load)
+    # Gather-to-leader: only rank 0 consumes the per-rank item/load lists
+    # (it computes the assignment and broadcasts it) — non-leaders must
+    # not each pull O(world x items) through the coordinator.
+    gathered_items = pg_wrapper.gather_object(sorted(local_items.items()))
+    gathered_loads = pg_wrapper.gather_object(base_load)
 
     assignment: Dict[str, int] = {}
     if pg_wrapper.get_rank() == 0:
+        assert gathered_items is not None and gathered_loads is not None
         # Union of items across ranks (a path replicated on a strict subset
         # of ranks was already rejected by replication verification, but be
         # permissive here); each item is assignable to any rank that has it.

@@ -93,6 +93,19 @@ class PGWrapper:
             self._next_prefix("ag"), self.rank, self.world_size, obj
         )
 
+    def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
+        """Gather one picklable object per rank to ``dst`` (rank order);
+        returns None on every other rank. Non-destination ranks pay
+        O(own object) store traffic — use this instead of
+        :meth:`all_gather_object` whenever only one rank consumes the
+        result (e.g. the manifest gather: rank 0 alone writes metadata)."""
+        if self.world_size == 1:
+            return [obj]
+        assert self.store is not None
+        return self.store.gather(
+            self._next_prefix("ga"), self.rank, self.world_size, obj, dst
+        )
+
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         """Broadcast ``obj`` from ``src``; other ranks' inputs are ignored."""
         if self.world_size == 1:

@@ -79,6 +79,12 @@ class PreemptionSaver:
             handler for the same signal is invoked after ours.
         rendezvous_timeout: seconds to wait for every rank to join the
             step agreement before giving up (default 120).
+        poll_interval: seconds between background flag polls (default 1).
+        peer_grace: seconds the final symmetry check sleeps before a
+            triggered save, letting a just-timed-out peer's abandoned
+            marker land. Defaults to ``max(1, 2 * poll_interval)``;
+            deployments with slow coordination stores should widen it
+            (the marker publish must fit inside it).
     """
 
     def __init__(
@@ -90,6 +96,7 @@ class PreemptionSaver:
         rendezvous_timeout: float = 120.0,
         session: str = "",
         poll_interval: float = 1.0,
+        peer_grace: Optional[float] = None,
     ) -> None:
         self._pg = PGWrapper(pg)
         # Store keys are namespaced per session: saver lifetimes sharing
@@ -101,6 +108,11 @@ class PreemptionSaver:
         self.exit_after_save = exit_after_save
         self.rendezvous_timeout = rendezvous_timeout
         self.poll_interval = poll_interval
+        self.peer_grace = (
+            peer_grace
+            if peer_grace is not None
+            else max(1.0, 2.0 * poll_interval)
+        )
         self._flagged = threading.Event()
         self._remote_flagged = threading.Event()
         self._stop_poller = threading.Event()
@@ -135,26 +147,31 @@ class PreemptionSaver:
             return
 
         def poll() -> None:
+            # Never give up: a poller that exits on a coordinator hiccup
+            # leaves this rank blind to remote eviction notices, and a
+            # later real preemption then degrades to peers blocking out
+            # the full rendezvous timeout. Failures back off
+            # exponentially (capped) so an unhealthy store isn't hammered.
             failures = 0
-            while not self._stop_poller.wait(self.poll_interval):
+            cap = max(30.0, 16.0 * self.poll_interval)
+            delay = self.poll_interval
+            while not self._stop_poller.wait(delay):
                 try:
                     if store.try_get(self._key("flag")) is not None:
                         self._remote_flagged.set()
                         return
                     failures = 0
+                    delay = self.poll_interval
                 except Exception as e:  # noqa: BLE001 - transient store hiccup
                     failures += 1
-                    if failures >= 5:
-                        logger.error(
-                            "preemption flag poller giving up after %d "
-                            "consecutive store failures (%r): this rank "
-                            "will not observe remote eviction notices",
-                            failures,
-                            e,
-                        )
-                        return
-                    logger.warning(
-                        "preemption flag poll failed (%r); retrying", e
+                    delay = min(cap, delay * 2.0)
+                    log = logger.error if delay >= cap else logger.warning
+                    log(
+                        "preemption flag poll failed %d time(s) (%r); "
+                        "retrying in %.1fs",
+                        failures,
+                        e,
+                        delay,
                     )
 
         self._poller = threading.Thread(
@@ -261,9 +278,21 @@ class PreemptionSaver:
         peer whose marker *publish itself* stalls longer than the grace
         (store unreachable during the eviction) can still be missed;
         timeout-based agreement cannot close that without a third phase,
-        and a store that broken would fail the save anyway."""
-        time.sleep(1.0)
-        return store.try_get(self._key("abandoned")) is not None
+        and a store that broken would fail the save anyway. A *raised*
+        store read here is grounds to give up: an unhealthy coordination
+        service is exactly when "no abandon marker seen" must not be
+        read as an all-clear for a possibly-lone save."""
+        time.sleep(self.peer_grace)
+        try:
+            return store.try_get(self._key("abandoned")) is not None
+        except Exception as e:  # noqa: BLE001 - unhealthy store = no all-clear
+            logger.error(
+                "preemption symmetry check could not read the store (%r); "
+                "abandoning the coordinated save rather than risk a lone "
+                "take",
+                e,
+            )
+            return True
 
     def pending_save(self) -> bool:
         """One-shot check for an agreed save the loop never reached.
@@ -337,26 +366,50 @@ class PreemptionSaver:
         while time.monotonic() < deadline:
             if time.monotonic() >= next_abort_check:
                 next_abort_check = time.monotonic() + 1.0
-                if store.try_get(self._key("abandoned")) is not None:
-                    logger.error("a peer abandoned the preemption rendezvous")
-                    return None
-                for r in range(world):
-                    if store.try_get(self._key(f"done/{r}")) is not None:
-                        # A peer that finished training will never join;
-                        # abandon now, not at the timeout.
+                try:
+                    if store.try_get(self._key("abandoned")) is not None:
                         logger.error(
-                            "rank %d finished training before joining "
-                            "the preemption rendezvous",
-                            r,
+                            "a peer abandoned the preemption rendezvous"
                         )
                         return None
+                    for r in range(world):
+                        if store.try_get(self._key(f"done/{r}")) is not None:
+                            # A peer that finished training will never
+                            # join; abandon now, not at the timeout.
+                            logger.error(
+                                "rank %d finished training before joining "
+                                "the preemption rendezvous",
+                                r,
+                            )
+                            return None
+                except Exception as e:  # noqa: BLE001 - transient store hiccup
+                    # Abort checks are best-effort; the deadline bounds a
+                    # persistently failing store (rendezvous then gives
+                    # up, which is the safe outcome).
+                    logger.warning(
+                        "preemption abort check failed (%r); retrying", e
+                    )
             if joined < world:
-                joined = store.add(self._key("step_count"), 0)
+                try:
+                    joined = store.add(self._key("step_count"), 0)
+                except Exception as e:  # noqa: BLE001 - transient store hiccup
+                    logger.warning(
+                        "preemption join-count poll failed (%r); retrying", e
+                    )
             if joined >= world:
-                steps: List[Optional[bytes]] = [
-                    store.try_get(self._key(f"step/{r}")) for r in range(world)
-                ]
-                if all(s is not None for s in steps):
+                try:
+                    steps: List[Optional[bytes]] = [
+                        store.try_get(self._key(f"step/{r}"))
+                        for r in range(world)
+                    ]
+                except Exception as e:  # noqa: BLE001 - transient store hiccup
+                    # Same best-effort treatment as the abort checks: the
+                    # deadline bounds a persistently failing store.
+                    logger.warning(
+                        "preemption step-key read failed (%r); retrying", e
+                    )
+                    steps = []
+                if steps and all(s is not None for s in steps):
                     return max(int(s.decode()) for s in steps) + 1
             time.sleep(0.05)
         return None

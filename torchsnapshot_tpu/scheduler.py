@@ -265,7 +265,6 @@ async def execute_write_reqs(
             # Fused write+checksum (one cache-hot memory pass) when the
             # plugin overrides it; otherwise checksum first (off the I/O
             # slot), then write.
-            entry = None
             fused = (
                 record_checksums
                 and not fused_declined
@@ -275,6 +274,7 @@ async def execute_write_reqs(
             if record_checksums and not fused:
                 checksums[req.path] = await asyncio.get_running_loop(
                 ).run_in_executor(executor, compute_checksum_entry, buf)
+            declined = False
             async with io_slots:
                 stats.waiting_io -= 1
                 stats.io += 1
@@ -287,17 +287,31 @@ async def execute_write_reqs(
                         entry = await storage.write_with_checksum(write_io)
                         if entry is not None:
                             checksums[req.path] = entry
-                    if entry is None:
-                        if fused:
+                        else:
                             # Plugin declined at runtime (native lib
-                            # unavailable): two-step fallback, and stay
-                            # two-step for the rest of the run.
-                            fused_declined = True
-                            checksums[req.path] = await asyncio.get_running_loop(
-                            ).run_in_executor(executor, compute_checksum_entry, buf)
+                            # unavailable; nothing written): fall back
+                            # OUTSIDE the slot — checksum compute must
+                            # not serialize the bounded I/O streams.
+                            declined = True
+                    else:
                         await storage.write(write_io)
                 finally:
                     stats.io -= 1
+            if declined:
+                # Two-step fallback for this and (sticky) all later
+                # writes: checksum off the I/O slots, then re-acquire a
+                # slot for the plain write.
+                fused_declined = True
+                checksums[req.path] = await asyncio.get_running_loop(
+                ).run_in_executor(executor, compute_checksum_entry, buf)
+                stats.waiting_io += 1
+                async with io_slots:
+                    stats.waiting_io -= 1
+                    stats.io += 1
+                    try:
+                        await storage.write(WriteIO(path=req.path, buf=buf))
+                    finally:
+                        stats.io -= 1
         finally:
             del buf
             await budget.release(buf_len)

@@ -205,8 +205,10 @@ class Snapshot:
         incremental_base: Optional[Any] = None,
         record_digests: bool = False,
         _custom_array_prepare_func=None,
-    ) -> Tuple[PendingIOWork, SnapshotMetadata]:
-        """Shared take core (reference snapshot.py:316-440)."""
+    ) -> Tuple[PendingIOWork, Optional[SnapshotMetadata]]:
+        """Shared take core (reference snapshot.py:316-440). The returned
+        metadata is None on non-leader ranks (manifests gather to rank 0
+        only; see :func:`_gather_manifest`)."""
         _validate_app_state(app_state)
         rank = pg_wrapper.get_rank()
         world_size = pg_wrapper.get_world_size()
@@ -304,8 +306,19 @@ class Snapshot:
             rank_manifest = dict(zip(rank_manifest.keys(), entry_list))
 
         global_manifest = _gather_manifest(rank_manifest, pg_wrapper)
-        metadata = SnapshotMetadata(
-            version=__version__, world_size=world_size, manifest=global_manifest
+        # Non-leader ranks carry no metadata object: the snapshot they
+        # return lazy-loads the committed global manifest from storage
+        # (Snapshot.metadata), which is both cheaper than shipping it
+        # through the coordinator and guaranteed consistent with what
+        # rank 0 committed.
+        metadata = (
+            SnapshotMetadata(
+                version=__version__,
+                world_size=world_size,
+                manifest=global_manifest,
+            )
+            if global_manifest is not None
+            else None
         )
 
         memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
@@ -930,7 +943,7 @@ class PendingSnapshot:
         path: str,
         pending_io_work: PendingIOWork,
         pg_wrapper: PGWrapper,
-        metadata: SnapshotMetadata,
+        metadata: Optional[SnapshotMetadata],
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         commit_nonce: str = "",
@@ -1275,21 +1288,36 @@ def _calculate_replicated_entries(
         matched |= inferred & set(flattened)
     if pg_wrapper.get_world_size() == 1:
         return matched
-    all_matched = pg_wrapper.all_gather_object(sorted(matched))
-    common: Set[str] = set(all_matched[0])
-    for paths in all_matched[1:]:
-        common &= set(paths)
-    verified = pg_wrapper.broadcast_object(sorted(common))
+    # Gather-to-leader + broadcast of the decision: "rank 0 decides,
+    # everyone follows" never needed every rank to hold every rank's
+    # matched list — non-leaders send O(own list) and receive O(common).
+    all_matched = pg_wrapper.gather_object(sorted(matched))
+    common: List[str] = []
+    if all_matched is not None:
+        common_set: Set[str] = set(all_matched[0])
+        for paths in all_matched[1:]:
+            common_set &= set(paths)
+        common = sorted(common_set)
+    verified = pg_wrapper.broadcast_object(common)
     return set(verified)
 
 
-def _gather_manifest(rank_manifest: Manifest, pg_wrapper: PGWrapper) -> Manifest:
-    """All-gather per-rank manifests into the global ``{rank}/{path}`` keyed
-    manifest; replicated entries are kept only under rank 0 (reference
-    snapshot.py:879-901)."""
+def _gather_manifest(
+    rank_manifest: Manifest, pg_wrapper: PGWrapper
+) -> Optional[Manifest]:
+    """Gather per-rank manifests TO RANK 0 and merge into the global
+    ``{rank}/{path}``-keyed manifest there; returns None on every other
+    rank (reference snapshot.py:879-901 all_gathers over c10d, which
+    spreads the world² bytes peer-to-peer; over a KV store the leader is
+    the only socket, so the non-leaders — which don't need the global
+    manifest: rank 0 alone writes metadata, and restore lazy-loads it
+    from storage post-commit — must not each pull O(world x manifest)
+    bytes through it)."""
     from .manifest import is_replicated
 
-    gathered = pg_wrapper.all_gather_object(rank_manifest)
+    gathered = pg_wrapper.gather_object(rank_manifest)
+    if gathered is None:
+        return None
     merged_replicated: Manifest = {}
     if pg_wrapper.get_world_size() > 1:
         from .partitioner import consolidate_replicated_entries

@@ -34,6 +34,35 @@ def get_free_port() -> int:
         return s.getsockname()[1]
 
 
+class ByteCountingStore(Store):
+    """Delegating store wrapper that meters this rank's coordination
+    traffic: payload bytes sent (``set`` values) and received (``try_get``
+    results). Used by the manifest-gather scale test and the
+    protocol-traffic benchmark to prove non-leader ranks pay O(own
+    manifest), not O(world x manifest)."""
+
+    def __init__(self, inner: Store) -> None:
+        self.inner = inner
+        self.sent_bytes = 0
+        self.received_bytes = 0
+
+    def set(self, key: str, value: bytes) -> None:
+        self.sent_bytes += len(value)
+        self.inner.set(key, value)
+
+    def try_get(self, key: str):
+        out = self.inner.try_get(key)
+        if out is not None:
+            self.received_bytes += len(out)
+        return out
+
+    def add(self, key: str, amount: int) -> int:
+        return self.inner.add(key, amount)
+
+    def delete(self, key: str) -> None:
+        self.inner.delete(key)
+
+
 def _worker_main(
     conn,
     fn_module: str,
