@@ -204,15 +204,23 @@ class Store(abc.ABC):
         here non-leaders don't need the global manifest at all — rank 0
         alone writes metadata, and restore reads it from storage).
         """
-        self.set(f"{prefix}/{rank}", pickle.dumps(obj))
+        blob = pickle.dumps(obj)
         out = None
         if rank == dst:
+            # The destination's own blob never touches the store (nobody
+            # else reads it); the loads() keeps all-gather's copy
+            # semantics for the local entry.
             out = [
-                pickle.loads(self.get(f"{prefix}/{i}", timeout))
+                pickle.loads(blob)
+                if i == rank
+                else pickle.loads(self.get(f"{prefix}/{i}", timeout))
                 for i in range(world_size)
             ]
+        else:
+            self.set(f"{prefix}/{rank}", blob)
         # Keys survive until every rank (dst included, which increments
-        # only after reading all blobs) has passed through _cleanup.
+        # only after reading all blobs) has passed through _cleanup;
+        # deleting dst's never-set key is a no-op.
         self._cleanup(
             prefix, world_size, [f"{prefix}/{i}" for i in range(world_size)]
         )
@@ -612,10 +620,14 @@ class LinearBarrier:
         return f"{self.prefix}/{name}"
 
     def _check_error(self, reads: Optional[_TransientReads] = None) -> None:
-        if reads is not None:
-            err = reads.read(lambda: self.store.try_get(self._key("error")))
-        else:
-            err = self.store.try_get(self._key("error"))
+        # One-shot call sites (no shared tracker) still get single-hiccup
+        # tolerance from a fresh tracker: the first failed read returns
+        # None ("no error seen"), matching the pre-strict-try_get
+        # semantics; only a shared tracker accumulating failures past the
+        # grace re-raises.
+        if reads is None:
+            reads = _TransientReads()
+        err = reads.read(lambda: self.store.try_get(self._key("error")))
         if err is not None:
             exc = pickle.loads(err)
             raise BarrierError(

@@ -109,16 +109,9 @@ class _PendingManagedSnapshot:
 
     def wait(self) -> Snapshot:
         snapshot = self._pending.wait()  # raises on failed take: no index entry
-        # refs feed the rank-0-only index commit; non-leader ranks carry
-        # no metadata object (the manifest gather is to-leader) and must
-        # not pull the global manifest from storage just to drop it.
         self._manager._commit_step(
             self._step,
-            refs=(
-                referenced_steps(snapshot.metadata.manifest)
-                if self._manager._pg.get_rank() == 0
-                else None
-            ),
+            refs=lambda: referenced_steps(snapshot.metadata.manifest),
             metric=self._metric,
         )
         return snapshot
@@ -210,11 +203,7 @@ class CheckpointManager:
         )
         self._commit_step(
             step,
-            refs=(
-                referenced_steps(snapshot.metadata.manifest)
-                if self._pg.get_rank() == 0
-                else None
-            ),
+            refs=lambda: referenced_steps(snapshot.metadata.manifest),
             metric=metric,
         )
         return snapshot
@@ -336,11 +325,18 @@ class CheckpointManager:
     def _commit_step(
         self,
         step: int,
-        refs: Optional[Set[int]] = None,
+        refs: Optional[Any] = None,
         metric: Optional[float] = None,
     ) -> None:
+        """``refs`` may be a set or a zero-arg callable returning one.
+        Pass a callable when computing refs requires the snapshot
+        manifest: it is evaluated only on rank 0, after the early
+        return — non-leader ranks hold no in-memory metadata and must
+        not pull the global manifest from storage just to drop it."""
         if self._pg.get_rank() != 0:
             return
+        if callable(refs):
+            refs = refs()
         self._with_root_storage(
             lambda storage: self._commit_step_async(
                 step, storage, refs or set(), metric

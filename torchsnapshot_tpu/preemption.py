@@ -278,21 +278,32 @@ class PreemptionSaver:
         peer whose marker *publish itself* stalls longer than the grace
         (store unreachable during the eviction) can still be missed;
         timeout-based agreement cannot close that without a third phase,
-        and a store that broken would fail the save anyway. A *raised*
-        store read here is grounds to give up: an unhealthy coordination
-        service is exactly when "no abandon marker seen" must not be
-        read as an all-clear for a possibly-lone save."""
+        and a store that broken would fail the save anyway. A raised
+        store read is retried within a short window (one hiccup on one
+        rank must not abort its save while peers proceed into the take
+        and block on its absence); a *persistently* failing store is
+        grounds to give up: that is exactly when "no abandon marker
+        seen" must not be read as an all-clear for a possibly-lone
+        save."""
         time.sleep(self.peer_grace)
-        try:
-            return store.try_get(self._key("abandoned")) is not None
-        except Exception as e:  # noqa: BLE001 - unhealthy store = no all-clear
-            logger.error(
-                "preemption symmetry check could not read the store (%r); "
-                "abandoning the coordinated save rather than risk a lone "
-                "take",
-                e,
-            )
-            return True
+        deadline = time.monotonic() + max(2.0, self.peer_grace)
+        while True:
+            try:
+                return store.try_get(self._key("abandoned")) is not None
+            except Exception as e:  # noqa: BLE001 - unhealthy store
+                if time.monotonic() >= deadline:
+                    logger.error(
+                        "preemption symmetry check could not read the "
+                        "store (%r); abandoning the coordinated save "
+                        "rather than risk a lone take",
+                        e,
+                    )
+                    return True
+                logger.warning(
+                    "preemption symmetry check read failed (%r); retrying",
+                    e,
+                )
+                time.sleep(0.1)
 
     def pending_save(self) -> bool:
         """One-shot check for an agreed save the loop never reached.
