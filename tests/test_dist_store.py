@@ -70,6 +70,102 @@ def test_store_collectives_threads() -> None:
     assert store._kv == {}
 
 
+def test_gather_object_to_leader_threads() -> None:
+    """gather: dst receives rank-ordered blobs, others receive None, the
+    dst's own blob never touches the store, and keys are cleaned up."""
+    store = InProcessStore()
+    world = 3
+    results = {}
+    set_keys = []
+    orig_set = store.set
+
+    def spying_set(key, value):
+        set_keys.append(key)
+        orig_set(key, value)
+
+    store.set = spying_set
+
+    def worker(rank: int) -> None:
+        pg = PGWrapper(ProcessGroup(store=store, rank=rank, world_size=world))
+        results[rank] = pg.gather_object({"rank": rank})
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results[0] == [{"rank": 0}, {"rank": 1}, {"rank": 2}]
+    assert results[1] is None and results[2] is None
+    assert store._kv == {}  # transient keys cleaned
+    # Only non-destination ranks published blobs (suffixes /1 and /2).
+    gather_sets = [k for k in set_keys if "/ga/" in k]
+    assert sorted(k.rsplit("/", 1)[1] for k in gather_sets) == ["1", "2"]
+
+
+class _FlakyStore(InProcessStore):
+    """Raises on the first ``fail_first_n`` reads, then recovers."""
+
+    def __init__(self, fail_first_n: int) -> None:
+        super().__init__()
+        self.fails_left = fail_first_n
+        self.raised = 0
+
+    def try_get(self, key):
+        if self.fails_left > 0:
+            self.fails_left -= 1
+            self.raised += 1
+            raise ConnectionError("simulated transport hiccup")
+        return super().try_get(key)
+
+
+class _DeadStore(InProcessStore):
+    def try_get(self, key):
+        raise ConnectionError("store is gone")
+
+
+def test_get_rides_out_transient_read_failures() -> None:
+    """try_get raising means "could not observe", not "absent"; the
+    deadline-bounded helpers retry through brief failures."""
+    store = _FlakyStore(fail_first_n=3)
+    store.set("k", b"v")
+    assert store.get("k", timeout=5.0) == b"v"
+    assert store.raised == 3
+
+
+def test_get_reraises_on_persistently_dead_store() -> None:
+    """A store failing continuously must re-raise after the short grace,
+    not be polled until the full deadline (a dead TCPStore socket means
+    the leader is gone)."""
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        _DeadStore().get("k", timeout=60.0)
+    assert time.monotonic() - t0 < 30.0  # grace, not the 60s deadline
+
+
+def test_barrier_tolerates_transient_read_failures() -> None:
+    """A momentary store error inside a barrier wait must not abort the
+    commit barrier."""
+    store = _FlakyStore(fail_first_n=2)
+    world = 2
+    errors = []
+
+    def worker(rank: int) -> None:
+        try:
+            b = LinearBarrier("b", store, rank=rank, world_size=world)
+            b.arrive(timeout=30.0)
+            b.depart(timeout=30.0)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert store.raised == 2  # the hiccups actually happened
+
+
 def test_linear_barrier_happy_path() -> None:
     store = InProcessStore()
     world = 3
