@@ -79,4 +79,10 @@ def main(path: str) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/sharded_snapshot")
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("path", nargs="?", default=None)
+    p.add_argument("--work-dir", default="/tmp/sharded_snapshot")
+    args = p.parse_args()
+    main(args.path or args.work_dir)
