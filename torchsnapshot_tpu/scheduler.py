@@ -227,7 +227,19 @@ class PendingIOWork:
     async def complete(self) -> None:
         try:
             if self.io_tasks:
-                await asyncio.gather(*self.io_tasks)
+                try:
+                    await asyncio.gather(*self.io_tasks)
+                except BaseException:
+                    # Settle the sibling writes before re-raising: gather
+                    # propagates on the FIRST failure while the rest keep
+                    # running, and the caller's failure path closes the
+                    # event loop — leaving tasks to die mid-write with
+                    # "Task was destroyed but it is pending" noise (and
+                    # buffers whose budget releases never ran).
+                    for t in self.io_tasks:
+                        t.cancel()
+                    await asyncio.gather(*self.io_tasks, return_exceptions=True)
+                    raise
         finally:
             self._executor.shutdown(wait=False)
         self.reporter.report_phase_done("writing")
