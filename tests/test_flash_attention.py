@@ -15,6 +15,17 @@ import pytest
 
 from torchsnapshot_tpu.ops import causal_attention, flash_causal_attention
 
+# Interpreter-mode comparisons are CPU-path tests: Pallas's interpreter
+# lowers the kernel body to plain jax ops on the ACTIVE backend, and on
+# a TPU backend that hybrid diverges numerically from both the native
+# kernel and the dense reference. The TPU claim is enforced by
+# test_flash_compiles_natively_on_tpu (interpret=False, real chip).
+_interpret_mode = pytest.mark.skipif(
+    os.environ.get("TS_TEST_ON_TPU") == "1",
+    reason="interpret-mode comparisons are CPU-backend tests; the "
+    "native-compile test covers TPU",
+)
+
 
 def _qkv(seed, shape=(2, 256, 4, 32), dtype=jnp.float32):
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
@@ -25,6 +36,7 @@ def _qkv(seed, shape=(2, 256, 4, 32), dtype=jnp.float32):
     )
 
 
+@_interpret_mode
 @pytest.mark.parametrize("block_q,block_k", [(64, 64), (128, 64), (64, 128)])
 def test_flash_matches_dense_f32(block_q, block_k) -> None:
     q, k, v = _qkv(0)
@@ -37,6 +49,7 @@ def test_flash_matches_dense_f32(block_q, block_k) -> None:
     )
 
 
+@_interpret_mode
 def test_flash_matches_dense_bf16() -> None:
     q, k, v = _qkv(1, dtype=jnp.bfloat16)
     dense = causal_attention(q, k, v)
@@ -49,6 +62,7 @@ def test_flash_matches_dense_bf16() -> None:
     )
 
 
+@_interpret_mode
 def test_flash_causality() -> None:
     """Future tokens cannot influence outputs: perturbing position j only
     changes outputs at positions >= j."""
@@ -64,6 +78,7 @@ def test_flash_causality() -> None:
     assert not np.allclose(np.asarray(pert[:, j:]), np.asarray(base[:, j:]))
 
 
+@_interpret_mode
 def test_flash_rejects_nondivisible_seq() -> None:
     q, k, v = _qkv(3, shape=(1, 96, 2, 16))
     with pytest.raises(ValueError, match="multiple"):
@@ -102,6 +117,7 @@ def test_flash_compiles_natively_on_tpu() -> None:
     assert err2 < 0.05, err2
 
 
+@_interpret_mode
 def test_flash_grad_matches_dense() -> None:
     """Reverse-mode through the kernel (custom_vjp with the blockwise
     recompute backward) must match dense attention's gradients."""
