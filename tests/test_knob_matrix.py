@@ -1,0 +1,61 @@
+"""Knob-combination matrix: the incremental chain (take -> incremental
+take -> restore -> deep fsck) must hold under every combination of slab
+batching, checksum disable, and a starvation-level memory budget.
+
+Pairwise knob interactions are where configuration bugs live (e.g.
+incremental refs into batched slab locations, budget admission around
+slab-sized buffers); the per-knob tests cover each in isolation only.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.fsck import verify_snapshot
+from torchsnapshot_tpu.knobs import (
+    disable_checksums,
+    enable_batching,
+    override_incremental_chunk_size_bytes,
+    override_per_rank_memory_budget_bytes,
+)
+
+
+@pytest.mark.parametrize("batching", [False, True])
+@pytest.mark.parametrize("no_checksums", [False, True])
+@pytest.mark.parametrize("tiny_budget", [False, True])
+def test_incremental_chain_under_knob_combo(
+    tmp_path, batching, no_checksums, tiny_budget
+) -> None:
+    rng = np.random.default_rng(0)
+    state = {
+        f"l{i}": rng.standard_normal(2000 + i).astype(np.float32)
+        for i in range(24)
+    }
+    stack = contextlib.ExitStack()
+    with stack:
+        if batching:
+            stack.enter_context(enable_batching())
+        if no_checksums:
+            stack.enter_context(disable_checksums())
+        if tiny_budget:
+            stack.enter_context(
+                override_per_rank_memory_budget_bytes(65536)
+            )
+        p0, p1 = str(tmp_path / "s0"), str(tmp_path / "s1")
+        with override_incremental_chunk_size_bytes(256):
+            ts.Snapshot.take(
+                p0, {"m": ts.PyTreeState(dict(state))}, record_digests=True
+            )
+            state2 = dict(state)
+            state2["l3"] = state["l3"] + 1.0
+            ts.Snapshot.take(
+                p1, {"m": ts.PyTreeState(state2)}, incremental_base=p0
+            )
+        dst = ts.PyTreeState({k: np.zeros_like(v) for k, v in state.items()})
+        ts.Snapshot(p1).restore({"m": dst})
+        for k in state2:
+            np.testing.assert_array_equal(dst.tree[k], state2[k])
+        report = verify_snapshot(p1, deep=True)
+        assert report.ok
