@@ -20,6 +20,8 @@ from torchsnapshot_tpu.knobs import (
     override_incremental_chunk_size_bytes,
     override_per_rank_memory_budget_bytes,
 )
+from torchsnapshot_tpu.manager import _entry_locations
+from torchsnapshot_tpu.test_utils import assert_tree_eq
 
 
 @pytest.mark.parametrize("batching", [False, True])
@@ -53,9 +55,27 @@ def test_incremental_chain_under_knob_combo(
             ts.Snapshot.take(
                 p1, {"m": ts.PyTreeState(state2)}, incremental_base=p0
             )
+        # The incremental take must actually have deduplicated against
+        # the base — the pairwise interaction under test. A silent
+        # degrade to full rewrite would still restore and fsck clean.
+        manifest = ts.Snapshot(p1).get_manifest()
+        ref_locations = [
+            loc
+            for entry in manifest.values()
+            for loc in _entry_locations(entry)
+            if loc is not None and loc.startswith("../s0")
+        ]
+        assert len(ref_locations) > 10, (
+            "incremental take rewrote everything instead of referencing "
+            f"the base (refs: {len(ref_locations)})"
+        )
+
         dst = ts.PyTreeState({k: np.zeros_like(v) for k, v in state.items()})
         ts.Snapshot(p1).restore({"m": dst})
-        for k in state2:
-            np.testing.assert_array_equal(dst.tree[k], state2[k])
+        assert_tree_eq(dst.tree, state2)
         report = verify_snapshot(p1, deep=True)
         assert report.ok
+        if not no_checksums:
+            # FsckReport exposes crcs_verified so "deep OK" can never be
+            # silently hollow — enforce that here.
+            assert report.crcs_verified > 0
