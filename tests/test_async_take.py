@@ -127,6 +127,37 @@ def test_async_take_peer_failure_no_commit(pg) -> None:
 
 
 @multiprocess_test(nproc=2)
+def test_sync_take_peer_failure_fails_fast_no_commit(pg) -> None:
+    """SYNC take symmetry of the async case above: rank 1's storage
+    fails; rank 0 must observe the reported error at the commit barrier
+    and raise well before the store timeout (it used to block the full
+    300 s), and no commit marker may exist on either rank."""
+    import time
+
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "sync-fail-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+
+    plugin_cls = FaultyFSStoragePlugin if pg.rank == 1 else FSStoragePlugin
+    app_state = {
+        "prog": ts.StateDict(rank=pg.rank),
+        "p": ts.PyTreeState({"w": jnp.ones(8) * pg.rank}),
+    }
+    t0 = time.monotonic()
+    with _patch_plugin(plugin_cls), pytest.raises(Exception):
+        ts.Snapshot.take(path, app_state, pg=pg)
+    assert time.monotonic() - t0 < 60.0, "survivor blocked to store timeout"
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+
+
+@multiprocess_test(nproc=2)
 def test_async_take_distributed_commit(pg) -> None:
     import jax.numpy as jnp
 

@@ -109,35 +109,65 @@ class Snapshot:
         written — the manifest references the base's blob instead
         (incremental.py). ``record_digests`` records digests without a
         base, making this snapshot usable as a future base."""
+        import uuid
+
         pg_wrapper = PGWrapper(pg)
         path = pg_wrapper.broadcast_object(path)  # rank-0 path wins
+        # Error-propagating commit barrier, same design as async_take's:
+        # a rank whose writes fail must not strand its peers for the full
+        # store timeout — they observe the reported error at arrive() and
+        # abandon (no commit marker anywhere). The nonce keeps barrier
+        # keys from aliasing any earlier take to the same path.
+        barrier = None
+        if pg_wrapper.get_world_size() > 1:
+            commit_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+            assert pg_wrapper.store is not None
+            barrier = LinearBarrier(
+                prefix=f"__snapshot_commit/{commit_nonce}",
+                store=pg_wrapper.store,
+                rank=pg_wrapper.get_rank(),
+                world_size=pg_wrapper.get_world_size(),
+            )
         event_loop = asyncio.new_event_loop()
         try:
             storage = url_to_storage_plugin(path)
-            pending_io_work, metadata = cls._take_impl(
-                path=path,
-                app_state=app_state,
-                pg_wrapper=pg_wrapper,
-                replicated=replicated or [],
-                storage=storage,
-                event_loop=event_loop,
-                is_async_snapshot=False,
-                incremental_base=incremental_base,
-                record_digests=record_digests,
-                _custom_array_prepare_func=_custom_array_prepare_func,
-            )
-            pending_io_work.sync_complete(event_loop)
-            pending_io_work.finalize_checksums()
-            _maybe_write_checksum_table(
-                pending_io_work, pg_wrapper.get_rank(), storage, event_loop
-            )
+            try:
+                pending_io_work, metadata = cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    pg_wrapper=pg_wrapper,
+                    replicated=replicated or [],
+                    storage=storage,
+                    event_loop=event_loop,
+                    is_async_snapshot=False,
+                    incremental_base=incremental_base,
+                    record_digests=record_digests,
+                    _custom_array_prepare_func=_custom_array_prepare_func,
+                )
+                pending_io_work.sync_complete(event_loop)
+                pending_io_work.finalize_checksums()
+                _maybe_write_checksum_table(
+                    pending_io_work, pg_wrapper.get_rank(), storage, event_loop
+                )
+            except BaseException as e:
+                if barrier is not None:
+                    try:
+                        barrier.report_error(e)
+                    except Exception:  # noqa: BLE001 - already failing
+                        logger.error(
+                            "failed to report take error to peers; they "
+                            "will abandon at the barrier timeout"
+                        )
+                raise
 
             # All writes are durable on every rank before the commit marker
             # exists anywhere (commit-after-barrier invariant).
-            pg_wrapper.barrier()
+            if barrier is not None:
+                barrier.arrive()
             if pg_wrapper.get_rank() == 0:
                 cls._write_snapshot_metadata(metadata, storage, event_loop)
-            pg_wrapper.barrier()
+            if barrier is not None:
+                barrier.depart()
             event_loop.run_until_complete(storage.close())
         finally:
             event_loop.close()
