@@ -17,6 +17,61 @@ from torchsnapshot_tpu.test_utils import multiprocess_test
 
 
 @multiprocess_test(nproc=2)
+def test_restore_peer_failure_fails_fast(pg) -> None:
+    """Rank 1's DATA reads fail mid-restore: the error propagates through
+    the inter-stateful barrier so rank 0 raises within seconds instead of
+    blocking out the 300 s store timeout, and a clean retry restores
+    per-rank values correctly afterwards."""
+    import time
+    from unittest import mock
+
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    path = os.path.join(tempfile.gettempdir(), "restore-fail-fast-test")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+    state = {
+        "m": ts.PyTreeState(
+            {"w": np.full(4096, 1.0 + pg.rank, np.float32)}
+        )
+    }
+    ts.Snapshot.take(path, state, pg=pg)
+
+    class FaultyDataRead(FSStoragePlugin):
+        # Data blobs only: metadata/checksum-table reads precede any
+        # cross-rank coordination.
+        async def read(self, read_io):
+            if "/m/" in read_io.path:
+                raise OSError("injected read failure")
+            await super().read(read_io)
+
+        async def read_with_checksum(self, read_io):
+            if "/m/" in read_io.path:
+                raise OSError("injected read failure")
+            return await super().read_with_checksum(read_io)
+
+    cls = FaultyDataRead if pg.rank == 1 else FSStoragePlugin
+    patch = mock.patch(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
+        side_effect=lambda url: cls(root=url.split("://")[-1]),
+    )
+    dst = {"m": ts.PyTreeState({"w": np.zeros(4096, np.float32)})}
+    t0 = time.monotonic()
+    with patch, pytest.raises(Exception):
+        ts.Snapshot(path, pg=pg).restore(dst)
+    assert time.monotonic() - t0 < 60.0, "survivor blocked to store timeout"
+
+    dst2 = {"m": ts.PyTreeState({"w": np.zeros(4096, np.float32)})}
+    ts.Snapshot(path, pg=pg).restore(dst2)
+    assert float(dst2["m"].tree["w"][0]) == 1.0 + pg.rank
+
+
+@multiprocess_test(nproc=2)
 def test_distributed_take_and_manifest(pg) -> None:
     import jax.numpy as jnp
 

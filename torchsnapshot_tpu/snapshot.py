@@ -430,9 +430,31 @@ class Snapshot:
 
     def restore(self, app_state: AppState) -> None:
         """In-place restore (reference snapshot.py:442-491)."""
+        import uuid
+
         _validate_app_state(app_state)
         pg_wrapper = PGWrapper(self._pg_arg)
         rank = pg_wrapper.get_rank()
+        # Error-propagating inter-stateful barriers (same design as the
+        # take commit barrier): a rank whose reads fail — bit rot, a
+        # CRC mismatch — reports before raising, so peers waiting at the
+        # current key's barrier abandon instead of blocking out the full
+        # store timeout.
+        restore_nonce = None
+        if pg_wrapper.get_world_size() > 1:
+            restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+
+        def key_barrier(i: int) -> Optional[LinearBarrier]:
+            if restore_nonce is None:
+                return None
+            assert pg_wrapper.store is not None
+            return LinearBarrier(
+                prefix=f"__restore/{restore_nonce}/{i}",
+                store=pg_wrapper.store,
+                rank=rank,
+                world_size=pg_wrapper.get_world_size(),
+            )
+
         event_loop = asyncio.new_event_loop()
         try:
             storage = url_to_storage_plugin(self.path)
@@ -443,22 +465,35 @@ class Snapshot:
             rng_key_and_state = _pop_rng_state(app_state)
             rng_key = rng_key_and_state[0] if rng_key_and_state else None
             keys = _gather_keys(app_state, pg_wrapper)
-            for key in keys:
+            for i, key in enumerate(keys):
                 stateful = app_state.get(key)
                 if key == rng_key:
                     stateful = None  # restored last, below
-                if stateful is not None:
-                    self._load_stateful(
-                        key=key,
-                        stateful=stateful,
-                        available=available,
-                        storage=storage,
-                        memory_budget_bytes=memory_budget_bytes,
-                        event_loop=event_loop,
-                        rank=rank,
-                        checksum_table=checksum_table,
-                    )
-                pg_wrapper.barrier()
+                barrier = key_barrier(i)
+                try:
+                    if stateful is not None:
+                        self._load_stateful(
+                            key=key,
+                            stateful=stateful,
+                            available=available,
+                            storage=storage,
+                            memory_budget_bytes=memory_budget_bytes,
+                            event_loop=event_loop,
+                            rank=rank,
+                            checksum_table=checksum_table,
+                        )
+                except BaseException as e:
+                    if barrier is not None:
+                        try:
+                            barrier.report_error(e)
+                        except Exception:  # noqa: BLE001 - already failing
+                            logger.error(
+                                "failed to report restore error to peers"
+                            )
+                    raise
+                if barrier is not None:
+                    barrier.arrive()
+                    barrier.depart()
             # RNG state is restored last so that load_state_dict side
             # effects of other statefuls cannot disturb it (reference
             # snapshot.py:478-489).
