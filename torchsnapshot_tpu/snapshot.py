@@ -562,6 +562,14 @@ class Snapshot:
             # globally ordered (reference snapshot.py:353-370).
             pg_wrapper.barrier()
 
+        # Nonce for the apply phase's error-propagating barriers — agreed
+        # here, on the thread that owns collective ordering.
+        restore_nonce = None
+        if pg_wrapper.get_world_size() > 1:
+            import uuid
+
+            restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+
         return PendingRestore(
             path=self.path,
             keys=keys,
@@ -571,6 +579,7 @@ class Snapshot:
             rank=rank,
             world_size=self.metadata.world_size,
             rng_key=rng_key,
+            restore_nonce=restore_nonce,
         )
 
     def _load_stateful(
@@ -1108,6 +1117,7 @@ class PendingRestore:
         rank: int,
         world_size: int,
         rng_key: Optional[str] = None,
+        restore_nonce: Optional[str] = None,
     ) -> None:
         import threading
 
@@ -1115,6 +1125,7 @@ class PendingRestore:
         self._keys = keys
         self._plans = plans
         self._rng_key = rng_key
+        self._restore_nonce = restore_nonce
         self._pg = pg_wrapper
         self._memory_budget_bytes = memory_budget_bytes
         self._rank = rank
@@ -1173,23 +1184,43 @@ class PendingRestore:
             event_loop.close()
             self._done.set()
 
+    def _key_barrier(self, i: int) -> Optional[LinearBarrier]:
+        if self._restore_nonce is None:
+            return None
+        assert self._pg.store is not None
+        return LinearBarrier(
+            prefix=f"__restore/{self._restore_nonce}/{i}",
+            store=self._pg.store,
+            rank=self._rank,
+            world_size=self._pg.get_world_size(),
+        )
+
     def wait(self) -> None:
         """Block until reads finish, then apply the state dicts. Must be
         called from the thread that owns collective ordering (the one
         that called async_restore).
 
         Failure semantics match the sync restore: a rank whose reads (or
-        applies) failed raises without completing the barrier schedule,
-        and its peers block in their next barrier until the store barrier
-        times out or the job runtime tears the world down — a failed
-        distributed restore is fatal to the job, not recoverable
-        per-rank."""
+        applies) failed reports the error into the barrier its peers are
+        waiting at and raises; the peers observe it and abandon within
+        seconds (no commit-style retry — a failed distributed restore is
+        fatal to the job, not recoverable per-rank)."""
         self._thread.join()
         if self._exc_info is not None:
             # State was never applied; the read buffers are useless.
             # Release them before raising (the handle may be kept for
-            # diagnostics, and a retry will allocate its own).
+            # diagnostics, and a retry will allocate its own). Peers whose
+            # reads succeeded are waiting at the FIRST apply barrier —
+            # tell them before raising.
             self._plans = {}
+            first = self._key_barrier(0) if self._keys else None
+            if first is not None:
+                try:
+                    first.report_error(self._exc_info)
+                except Exception:  # noqa: BLE001 - already failing
+                    logger.error(
+                        "failed to report restore-read error to peers"
+                    )
             raise self._exc_info
         if self._applied:
             return
@@ -1202,13 +1233,28 @@ class PendingRestore:
         # perturb the shared schedule — and applied after all barriers
         # (RngState application is collective-free), the sync path's
         # restore-RNG-last invariant.
-        for key in self._keys:
-            plan = self._plans.get(key)
-            if plan is not None and key != self._rng_key:
-                plan.apply()
+        for i, key in enumerate(self._keys):
+            barrier = self._key_barrier(i)
+            try:
+                plan = self._plans.get(key)
+                if plan is not None and key != self._rng_key:
+                    plan.apply()
+            except BaseException as e:
+                if barrier is not None:
+                    try:
+                        barrier.report_error(e)
+                    except Exception:  # noqa: BLE001 - already failing
+                        logger.error(
+                            "failed to report restore-apply error to peers"
+                        )
+                raise
             # load_state_dict may run collectives; keep global order
             # (reference snapshot.py:466-476 barrier discipline).
-            self._pg.barrier()
+            if barrier is not None:
+                barrier.arrive()
+                barrier.depart()
+            else:
+                self._pg.barrier()
         rng_plan = self._plans.get(self._rng_key) if self._rng_key else None
         if rng_plan is not None:
             rng_plan.apply()
