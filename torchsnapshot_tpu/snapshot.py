@@ -27,6 +27,7 @@ from fully-replicated shardings rather than inferred from DDP modules.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import fnmatch
 import logging
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -69,6 +70,27 @@ from .version import __version__
 logger: logging.Logger = logging.getLogger(__name__)
 
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
+
+
+@contextlib.contextmanager
+def _reporting_to(barrier: Optional["LinearBarrier"], what: str):
+    """Fail-fast discipline shared by every distributed phase: an error
+    raised inside the block is reported into ``barrier`` (best-effort)
+    before propagating, so peers waiting there abandon within seconds
+    instead of blocking out the store timeout."""
+    try:
+        yield
+    except BaseException as e:
+        if barrier is not None:
+            try:
+                barrier.report_error(e)
+            except Exception:  # noqa: BLE001 - already failing
+                logger.error(
+                    "failed to report %s error to peers; they will "
+                    "abandon at the barrier timeout",
+                    what,
+                )
+        raise
 
 
 class Snapshot:
@@ -131,7 +153,7 @@ class Snapshot:
         event_loop = asyncio.new_event_loop()
         try:
             storage = url_to_storage_plugin(path)
-            try:
+            with _reporting_to(barrier, "take"):
                 pending_io_work, metadata = cls._take_impl(
                     path=path,
                     app_state=app_state,
@@ -149,16 +171,6 @@ class Snapshot:
                 _maybe_write_checksum_table(
                     pending_io_work, pg_wrapper.get_rank(), storage, event_loop
                 )
-            except BaseException as e:
-                if barrier is not None:
-                    try:
-                        barrier.report_error(e)
-                    except Exception:  # noqa: BLE001 - already failing
-                        logger.error(
-                            "failed to report take error to peers; they "
-                            "will abandon at the barrier timeout"
-                        )
-                raise
 
             # All writes are durable on every rank before the commit marker
             # exists anywhere (commit-after-barrier invariant).
@@ -470,7 +482,7 @@ class Snapshot:
                 if key == rng_key:
                     stateful = None  # restored last, below
                 barrier = key_barrier(i)
-                try:
+                with _reporting_to(barrier, "restore"):
                     if stateful is not None:
                         self._load_stateful(
                             key=key,
@@ -482,15 +494,6 @@ class Snapshot:
                             rank=rank,
                             checksum_table=checksum_table,
                         )
-                except BaseException as e:
-                    if barrier is not None:
-                        try:
-                            barrier.report_error(e)
-                        except Exception:  # noqa: BLE001 - already failing
-                            logger.error(
-                                "failed to report restore error to peers"
-                            )
-                    raise
                 if barrier is not None:
                     barrier.arrive()
                     barrier.depart()
@@ -1214,14 +1217,8 @@ class PendingRestore:
             # tell them before raising.
             self._plans = {}
             first = self._key_barrier(0) if self._keys else None
-            if first is not None:
-                try:
-                    first.report_error(self._exc_info)
-                except Exception:  # noqa: BLE001 - already failing
-                    logger.error(
-                        "failed to report restore-read error to peers"
-                    )
-            raise self._exc_info
+            with _reporting_to(first, "restore-read"):
+                raise self._exc_info
         if self._applied:
             return
         # One barrier per gathered KEY, plan or no plan: different ranks
@@ -1235,19 +1232,10 @@ class PendingRestore:
         # restore-RNG-last invariant.
         for i, key in enumerate(self._keys):
             barrier = self._key_barrier(i)
-            try:
+            with _reporting_to(barrier, "restore-apply"):
                 plan = self._plans.get(key)
                 if plan is not None and key != self._rng_key:
                     plan.apply()
-            except BaseException as e:
-                if barrier is not None:
-                    try:
-                        barrier.report_error(e)
-                    except Exception:  # noqa: BLE001 - already failing
-                        logger.error(
-                            "failed to report restore-apply error to peers"
-                        )
-                raise
             # load_state_dict may run collectives; keep global order
             # (reference snapshot.py:466-476 barrier discipline).
             if barrier is not None:
