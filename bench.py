@@ -244,7 +244,10 @@ def sync_docs() -> int:
 
     record, path = newest_record()
     if record is None:
-        _log("bench: no BENCH_r*.json found; nothing to sync")
+        _log(
+            "bench: no BENCH_r*.json with a non-null parsed record "
+            "(none present, or every round timed out); nothing to sync"
+        )
         return 1
     write_signal_of_record(record)
     _log(f"bench: synced BENCH.md from {path.name}")

@@ -1,18 +1,25 @@
 """Docs-consistency lanes, enforced locally too: BENCH.md must quote the
 driver-recorded signal of record (committed-number drift like round 2's
 0.92-vs-0.646 efficiency headline fails here), and every relative doc
-link must resolve."""
+link must resolve. A timed-out driver run records ``parsed: null``
+(round 4 did) — the checker must fall back to the newest round that
+parsed, never pass vacuously."""
 
 import importlib.util
+import json
 import pathlib
 
 
-def _run_tool(name: str) -> int:
+def _load_tool(name: str):
     tools = pathlib.Path(__file__).parent.parent / "tools" / name
     spec = importlib.util.spec_from_file_location(name[:-3], tools)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.main()
+    return mod
+
+
+def _run_tool(name: str) -> int:
+    return _load_tool(name).main()
 
 
 def test_bench_docs_match_signal_of_record(capsys):
@@ -23,3 +30,67 @@ def test_bench_docs_match_signal_of_record(capsys):
 def test_doc_links_resolve(capsys):
     rc = _run_tool("check_doc_links.py")
     assert rc == 0, capsys.readouterr().out
+
+
+def _bench_md_for(record: dict) -> str:
+    return (
+        "# bench\n<!-- BENCH_SIGNAL_OF_RECORD: generated -->\n```json\n"
+        + json.dumps(record, indent=2)
+        + "\n```\n"
+    )
+
+
+def _write_round(root, n, parsed):
+    (root / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 124 if parsed is None else 0, "parsed": parsed})
+    )
+
+
+def test_null_parsed_newest_falls_back_to_older_record(tmp_path, capsys):
+    """A timed-out newest round must not green the check by itself: the
+    checker skips it (with a warning naming it) and verifies BENCH.md
+    against the newest round that actually parsed."""
+    mod = _load_tool("check_bench_docs.py")
+    good = {"metric": "x", "value": 1.0}
+    _write_round(tmp_path, 3, good)
+    _write_round(tmp_path, 4, None)
+    (tmp_path / "BENCH.md").write_text(_bench_md_for(good))
+    assert mod.main(root=tmp_path) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r04.json" in out and "BENCH_r03.json" in out
+
+    # ...and against that older record the check still has teeth:
+    (tmp_path / "BENCH.md").write_text(_bench_md_for({"metric": "x", "value": 2.0}))
+    assert mod.main(root=tmp_path) == 1
+
+
+def test_all_null_parsed_with_block_fails_loudly(tmp_path, capsys):
+    """Deleting every parsed record while BENCH.md still carries a block
+    must flip the checker red — the vacuous-pass regression (round 4's
+    ``data.get("parsed", data)`` returned None and the lane greened)."""
+    mod = _load_tool("check_bench_docs.py")
+    _write_round(tmp_path, 3, None)
+    _write_round(tmp_path, 4, None)
+    (tmp_path / "BENCH.md").write_text(_bench_md_for({"metric": "x"}))
+    assert mod.main(root=tmp_path) == 1
+    assert "non-null" in capsys.readouterr().out
+
+
+def test_corrupt_older_record_does_not_crash_the_check(tmp_path):
+    """A truncated BENCH_r*.json (killed mid-write) is skipped like a
+    null-parsed one, not allowed to crash the lane with a traceback."""
+    mod = _load_tool("check_bench_docs.py")
+    good = {"metric": "x", "value": 1.0}
+    (tmp_path / "BENCH_r01.json").write_text("{truncated")
+    _write_round(tmp_path, 2, good)
+    (tmp_path / "BENCH.md").write_text(_bench_md_for(good))
+    assert mod.main(root=tmp_path) == 0
+    # ...and when the corrupt record is the only one, the block fails loudly.
+    (tmp_path / "BENCH_r02.json").unlink()
+    assert mod.main(root=tmp_path) == 1
+
+
+def test_no_records_and_no_block_is_clean(tmp_path):
+    mod = _load_tool("check_bench_docs.py")
+    (tmp_path / "BENCH.md").write_text("# bench\nno block here\n")
+    assert mod.main(root=tmp_path) == 0

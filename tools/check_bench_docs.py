@@ -6,8 +6,12 @@ numbers (round 2 shipped a hand-typed 0.92 pipeline efficiency while
 ``BENCH_r02.json`` recorded 0.646) is exactly the class of error this
 check exists to catch. BENCH.md carries a fenced JSON block between
 ``BENCH_SIGNAL_OF_RECORD`` markers that must equal the ``parsed`` record
-of the newest ``BENCH_r*.json`` in the repo root. Stdlib-only; run from
-anywhere:
+of the newest ``BENCH_r*.json`` **with a non-null parsed record** — a
+timed-out driver run writes ``parsed: null`` (round 4 did), and such a
+record must not vacuously green the check: it is skipped with a warning
+and the check falls back to the newest round that actually parsed. If
+BENCH.md carries a block but no round ever parsed, that is a hard
+failure. Stdlib-only; run from anywhere:
 
     python tools/check_bench_docs.py
 """
@@ -24,29 +28,68 @@ BLOCK_RE = re.compile(
 )
 
 
-def newest_record():
+def scan_records(root: Path = ROOT):
+    """All BENCH_r*.json records, newest round first, as
+    ``(round, path, parsed_or_None)`` triples. ``parsed`` is the driver's
+    parse of bench.py's final JSON line; null means the run died before
+    (or without) emitting one."""
     rounds = []
-    for path in ROOT.glob("BENCH_r*.json"):
+    for path in root.glob("BENCH_r*.json"):
         m = re.fullmatch(r"BENCH_r(\d+)\.json", path.name)
         if m:
-            rounds.append((int(m.group(1)), path))
-    if not rounds:
-        return None, None
-    _, path = max(rounds)
-    data = json.loads(path.read_text())
-    return data.get("parsed", data), path
+            try:
+                data = json.loads(path.read_text())
+                parsed = data.get("parsed", data)
+            except (json.JSONDecodeError, OSError):
+                # A corrupt/truncated record must not crash the check —
+                # treat it like a run that never parsed.
+                parsed = None
+            rounds.append((int(m.group(1)), path, parsed))
+    return sorted(rounds, reverse=True)
 
 
-def main() -> int:
-    record, record_path = newest_record()
-    if record is None:
-        print("check_bench_docs: no BENCH_r*.json found; nothing to check")
-        return 0
-    bench_md = ROOT / "BENCH.md"
+def newest_record(root: Path = ROOT, log=print):
+    """The newest record with a non-null parse, skipping (and naming)
+    broken newer rounds. Returns ``(record, path)`` — ``(None, None)``
+    only when no round ever parsed."""
+    skipped = []
+    for _, path, parsed in scan_records(root):
+        if parsed is not None:
+            if skipped:
+                log(
+                    "check_bench_docs: WARNING: skipped "
+                    + ", ".join(p.name for p in skipped)
+                    + " (parsed is null or unreadable — timed-out or "
+                    + f"corrupt run); using {path.name}"
+                )
+            return parsed, path
+        skipped.append(path)
+    if skipped:
+        log(
+            "check_bench_docs: WARNING: no record has a non-null parse: "
+            + ", ".join(p.name for p in skipped)
+        )
+    return None, None
+
+
+def main(root: Path = ROOT) -> int:
+    record, record_path = newest_record(root)
+    bench_md = root / "BENCH.md"
     if not bench_md.exists():
         print("check_bench_docs: BENCH.md missing")
         return 1
     m = BLOCK_RE.search(bench_md.read_text())
+    if record is None:
+        if m is not None:
+            # The block claims to quote a signal of record that does not
+            # exist — the exact situation a vacuous pass would hide.
+            print(
+                "check_bench_docs: BENCH.md carries a signal-of-record "
+                "block but no BENCH_r*.json has a non-null parsed record"
+            )
+            return 1
+        print("check_bench_docs: no parsed BENCH_r*.json and no block; nothing to check")
+        return 0
     if not m:
         print(
             "check_bench_docs: BENCH.md has no BENCH_SIGNAL_OF_RECORD block "
