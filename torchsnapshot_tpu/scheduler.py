@@ -43,6 +43,23 @@ from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 
 logger: logging.Logger = logging.getLogger(__name__)
 
+# Observability hook: wall-clock phase completions (seconds since the
+# pipeline's reporter started) of the most recent write/read pipeline run
+# in this process, keyed by phase name ("staging"/"writing"/"loading").
+# The reporter already logs these numbers (report_phase_done) but not
+# machine-readably; bench.py's in-take stall diagnosis reads them here.
+# Last-writer-wins across concurrent pipelines — callers that care run
+# one pipeline at a time.
+_LAST_PHASE_S: dict = {}
+
+
+def reset_phase_timings() -> None:
+    _LAST_PHASE_S.clear()
+
+
+def last_phase_timings() -> dict:
+    return dict(_LAST_PHASE_S)
+
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES: int = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER: float = 0.6
 _LOG_LINE_LIMIT = 8
@@ -185,6 +202,7 @@ class _ProgressReporter:
 
     def report_phase_done(self, phase: str) -> None:
         elapsed = time.monotonic() - self.begin_ts
+        _LAST_PHASE_S[phase] = round(elapsed, 3)
         mbps = self.stats.bytes_moved / 1024**2 / elapsed if elapsed > 0 else 0.0
         msg = (
             f"Rank {self.rank} completed {phase} in {elapsed:.2f}s "
