@@ -7,6 +7,7 @@ slow/faulty FS plugins patched in, asserting a failed async take leaves no
 """
 
 import asyncio
+import contextlib
 import os
 import tempfile
 import time
@@ -123,6 +124,88 @@ def test_async_take_peer_failure_no_commit(pg) -> None:
         pending = ts.Snapshot.async_take(path, app_state, pg=pg)
         with pytest.raises(Exception):
             pending.wait()
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+
+
+@multiprocess_test(nproc=2)
+def test_async_take_rank0_staging_failure_fails_fast(pg) -> None:
+    """Rank 0 fails during STAGING in a rank-0-only step (replication
+    consolidation, after the non-leader manifest gather): its error must
+    reach rank 1's commit thread through the commit-nonce barrier, so
+    rank 1's wait() raises in seconds instead of stranding for the 300 s
+    store timeout. Pins two round-5 changes together: async_take
+    constructs the error-reporting barrier handle BEFORE _take_impl, and
+    the memory-budget all-gather runs BEFORE the manifest gather (a peer
+    must have no wrapped collective left between its gather send and the
+    commit barrier — it cannot see the reported error from inside an
+    op-seq poll loop)."""
+    import time
+
+    import jax.numpy as jnp
+
+    path = os.path.join(tempfile.gettempdir(), "async-rank0-staging-fail")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+    app_state = {"p": ts.PyTreeState({"w": jnp.ones(4096)})}
+    t0 = time.monotonic()
+    if pg.rank == 0:
+        with mock.patch(
+            "torchsnapshot_tpu.partitioner.consolidate_replicated_entries",
+            side_effect=RuntimeError("injected staging failure"),
+        ), pytest.raises(RuntimeError, match="injected staging failure"):
+            ts.Snapshot.async_take(path, app_state, pg=pg, replicated=["p/**"])
+    else:
+        pending = ts.Snapshot.async_take(
+            path, app_state, pg=pg, replicated=["p/**"]
+        )
+        with pytest.raises(Exception):
+            pending.wait()
+        assert time.monotonic() - t0 < 60.0, (
+            "peer blocked to store timeout despite reported staging error"
+        )
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+
+
+@multiprocess_test(nproc=2)
+def test_sync_take_commit_window_failure_fails_fast(pg) -> None:
+    """Rank 0's metadata write fails INSIDE the commit window (between
+    barrier arrive and depart): the round-5 _reporting_to wrap means
+    peers polling at depart() observe the error and abandon in seconds
+    (they used to block out the full store timeout), and no commit
+    marker exists."""
+    import time
+
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.snapshot import Snapshot
+
+    path = os.path.join(tempfile.gettempdir(), "sync-commit-window-fail")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+    app_state = {"p": ts.PyTreeState({"w": jnp.ones(1024) * pg.rank})}
+    ctx = (
+        mock.patch.object(
+            Snapshot,
+            "_write_snapshot_metadata",
+            side_effect=RuntimeError("injected metadata-write failure"),
+        )
+        if pg.rank == 0
+        else contextlib.nullcontext()
+    )
+    t0 = time.monotonic()
+    with ctx, pytest.raises(Exception):
+        ts.Snapshot.take(path, app_state, pg=pg)
+    assert time.monotonic() - t0 < 60.0, "peer blocked to store timeout"
     assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
 
 

@@ -157,3 +157,49 @@ def test_two_process_jax_distributed_snapshot(tmp_path) -> None:
     # Both ranks agreed on one preemption-save step over the
     # coordination service.
     assert results[0] == results[1] and results[0] is not None, results
+
+
+def test_constructor_probe_rejects_misclassifying_client() -> None:
+    """The absent-key self-check (round 5): a jaxlib whose coordination
+    client words the absent-key status in a way try_get cannot classify
+    as NOT_FOUND must be rejected loudly AT CONSTRUCTION — otherwise
+    every absent-key poll raises and, past the transient-read grace, all
+    barriers and preemption polls fail on real pods with the cause
+    (message wording) nowhere near the symptom."""
+    from unittest import mock
+
+    import pytest
+
+    class WeirdClient:
+        def key_value_try_get_bytes(self, key):
+            raise ValueError("no such entry exists")  # not a NOT_FOUND token
+
+    class _State:
+        client = WeirdClient()
+
+    with mock.patch("jax._src.distributed.global_state", _State()):
+        from torchsnapshot_tpu.dist_store import JaxCoordinationStore
+
+        with pytest.raises(RuntimeError, match="absent-key probe"):
+            JaxCoordinationStore()
+
+
+def test_constructor_probe_rejects_phantom_values() -> None:
+    """A store returning a value for a never-set key has broken get
+    semantics (e.g. a client echoing defaults); refuse it."""
+    from unittest import mock
+
+    import pytest
+
+    class EchoClient:
+        def key_value_try_get_bytes(self, key):
+            return b"phantom"
+
+    class _State:
+        client = EchoClient()
+
+    with mock.patch("jax._src.distributed.global_state", _State()):
+        from torchsnapshot_tpu.dist_store import JaxCoordinationStore
+
+        with pytest.raises(RuntimeError, match="never set"):
+            JaxCoordinationStore()

@@ -443,6 +443,8 @@ class JaxCoordinationStore(Store):
     """
 
     def __init__(self) -> None:
+        import uuid
+
         from jax._src import distributed
 
         client = distributed.global_state.client
@@ -452,6 +454,32 @@ class JaxCoordinationStore(Store):
                 "JaxCoordinationStore requires a coordinator"
             )
         self._client = client
+        # Self-check the absent-key classification NOW: try_get maps the
+        # coordination service's NOT_FOUND status to None by matching the
+        # status token in the raised exception. A jaxlib that words the
+        # absent-key status differently would otherwise turn EVERY
+        # absent-key poll into a raise — after the _TransientReads grace,
+        # all barriers and preemption polls on real pods would fail, a
+        # silent total-breakage mode whose cause (message wording) sits
+        # far from its symptom. Probing a key that provably was never set
+        # makes the mismatch loud at construction instead.
+        probe = f"__ts_absent_probe/{uuid.uuid4().hex}"
+        try:
+            val = self.try_get(probe)
+        except Exception as e:
+            raise RuntimeError(
+                "JaxCoordinationStore: absent-key probe failed — either "
+                "this jaxlib reports an absent key in a way try_get does "
+                "not classify as NOT_FOUND, or the coordination service "
+                "is unreachable. Use TCPStore coordination instead "
+                f"(probe raised {e!r})."
+            ) from e
+        if val is not None:
+            raise RuntimeError(
+                "JaxCoordinationStore: absent-key probe returned a value "
+                f"({val!r}) for a key that was never set; refusing to use "
+                "a store with broken get semantics"
+            )
 
     def set(self, key: str, value: bytes) -> None:
         self._client.key_value_set_bytes(key, value)

@@ -84,11 +84,12 @@ def _reporting_to(barrier: Optional["LinearBarrier"], what: str):
         if barrier is not None:
             try:
                 barrier.report_error(e)
-            except Exception:  # noqa: BLE001 - already failing
+            except Exception as report_exc:  # noqa: BLE001 - already failing
                 logger.error(
-                    "failed to report %s error to peers; they will "
+                    "failed to report %s error to peers (%r); they will "
                     "abandon at the barrier timeout",
                     what,
+                    report_exc,
                 )
         raise
 
@@ -173,13 +174,20 @@ class Snapshot:
                 )
 
             # All writes are durable on every rank before the commit marker
-            # exists anywhere (commit-after-barrier invariant).
-            if barrier is not None:
-                barrier.arrive()
-            if pg_wrapper.get_rank() == 0:
-                cls._write_snapshot_metadata(metadata, storage, event_loop)
-            if barrier is not None:
-                barrier.depart()
+            # exists anywhere (commit-after-barrier invariant). The commit
+            # window itself stays under _reporting_to: if rank 0's metadata
+            # write fails between arrive() and depart(), peers polling at
+            # depart() observe the reported error and abandon in seconds
+            # instead of blocking out the store timeout (the async path's
+            # catch-all in PendingSnapshot._complete_snapshot already
+            # covers its equivalent window).
+            with _reporting_to(barrier, "commit"):
+                if barrier is not None:
+                    barrier.arrive()
+                if pg_wrapper.get_rank() == 0:
+                    cls._write_snapshot_metadata(metadata, storage, event_loop)
+                if barrier is not None:
+                    barrier.depart()
             event_loop.run_until_complete(storage.close())
         finally:
             event_loop.close()
@@ -210,20 +218,47 @@ class Snapshot:
         # to the same path (including failed ones) must never alias this
         # take's barrier.
         commit_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+        # Error-reporting handle on the SAME commit barrier the background
+        # commit threads key off this nonce: staging (_take_impl) includes
+        # rank-0-only work such as replication verification, and a rank
+        # that fails there must poison the barrier before raising — peers
+        # whose staging succeeded already have commit threads waiting at
+        # arrive(), and without the report they block out the full store
+        # timeout.
+        barrier = None
+        if pg_wrapper.get_world_size() > 1:
+            assert pg_wrapper.store is not None
+            barrier = LinearBarrier(
+                prefix=f"__snapshot_commit/{commit_nonce}",
+                store=pg_wrapper.store,
+                rank=pg_wrapper.get_rank(),
+                world_size=pg_wrapper.get_world_size(),
+            )
         event_loop = asyncio.new_event_loop()
         storage = url_to_storage_plugin(path)
-        pending_io_work, metadata = cls._take_impl(
-            path=path,
-            app_state=app_state,
-            pg_wrapper=pg_wrapper,
-            replicated=replicated or [],
-            storage=storage,
-            event_loop=event_loop,
-            is_async_snapshot=True,
-            incremental_base=incremental_base,
-            record_digests=record_digests,
-            _custom_array_prepare_func=_custom_array_prepare_func,
-        )
+        try:
+            with _reporting_to(barrier, "async take staging"):
+                pending_io_work, metadata = cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    pg_wrapper=pg_wrapper,
+                    replicated=replicated or [],
+                    storage=storage,
+                    event_loop=event_loop,
+                    is_async_snapshot=True,
+                    incremental_base=incremental_base,
+                    record_digests=record_digests,
+                    _custom_array_prepare_func=_custom_array_prepare_func,
+                )
+        except BaseException:
+            # The failure path owns the loop/storage (no PendingSnapshot
+            # thread will ever run to close them).
+            try:
+                event_loop.run_until_complete(storage.close())
+            except Exception:  # noqa: BLE001 - already failing
+                pass
+            event_loop.close()
+            raise
         return PendingSnapshot(
             path=path,
             pending_io_work=pending_io_work,
@@ -347,6 +382,16 @@ class Snapshot:
             entry_list, write_reqs = batch_write_requests(entry_list, write_reqs)
             rank_manifest = dict(zip(rank_manifest.keys(), entry_list))
 
+        # Budget agreement runs BEFORE the manifest gather on purpose: the
+        # gather's consolidation/validation is the last rank-0-only
+        # failure point of staging, and it must also be the last wrapped
+        # collective — a peer must have nothing left between its
+        # (non-blocking) gather send and the error-propagating commit
+        # barrier, or a rank-0 failure strands it inside an op-seq
+        # collective poll (a 300 s store timeout) where the reported
+        # error is invisible.
+        memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
+
         global_manifest = _gather_manifest(rank_manifest, pg_wrapper)
         # Non-leader ranks carry no metadata object: the snapshot they
         # return lazy-loads the committed global manifest from storage
@@ -363,7 +408,6 @@ class Snapshot:
             else None
         )
 
-        memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
         pending_io_work = sync_execute_write_reqs(
             write_reqs=write_reqs,
             storage=storage,
