@@ -1,0 +1,142 @@
+"""Composition of the flagship subsystems in one flow: periodic
+incremental saves through CheckpointManager → retention GC with
+ref-pinning → a PreemptionSaver eviction save driven THROUGH the same
+manager (chaining off the last periodic incremental step) → deep fsck of
+every retained snapshot after GC → restart → resume.
+
+Each feature is individually tested elsewhere (test_manager,
+test_preemption, test_incremental, test_fsck); this test asserts their
+*composition*: the eviction save participates in ref-aware GC, its
+incremental chain stays intact across deletions, and a restarted manager
+resumes from it. Structural model: the reference's layered test pyramid
+(SURVEY.md §4) — e2e over the exact subsystem seams."""
+
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.fsck import verify_snapshot
+from torchsnapshot_tpu.manager import referenced_steps
+from torchsnapshot_tpu.pg_wrapper import PGWrapper
+from torchsnapshot_tpu.test_utils import drive_preemption_loop, multiprocess_test
+
+
+def _state(rank: int, step: int) -> dict:
+    # "frozen" never changes: every incremental save references step 0's
+    # blob (chained refs collapse to the origin step at take time), so
+    # GC must pin step 0's directory long after the index dropped it.
+    # "hot" changes every step: every save writes a fresh blob.
+    return {
+        "train": ts.PyTreeState(
+            {
+                "frozen": np.arange(4096, dtype=np.float32) + rank,
+                "hot": np.full(2048, float(step * 10 + rank), np.float32),
+            }
+        ),
+        "progress": ts.StateDict(step=step),
+    }
+
+
+@multiprocess_test(nproc=2)
+def test_preemption_save_through_incremental_manager_with_gc(pg) -> None:
+    root = os.path.join(tempfile.gettempdir(), "preempt-mgr-comp-test")
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    wrapper = PGWrapper(pg)
+    wrapper.barrier()
+
+    mgr = ts.CheckpointManager(root, keep_last_n=2, pg=pg, incremental=True)
+    # Periodic training saves: 0 is the digest-recorded base, 1 and 2
+    # chain off their predecessors.
+    for step in (0, 1, 2):
+        mgr.save(step, _state(pg.rank, step))
+    wrapper.barrier()  # rank 0's index write + GC are durable
+    # keep_last_n=2 dropped step 0 from the index, but steps 1/2 still
+    # reference its unchanged "frozen" blob — pinned, not deleted.
+    assert mgr.all_steps() == [1, 2]
+    assert os.path.isdir(mgr.step_path(0)), "referenced base was deleted"
+
+    # Eviction mid-training: both ranks agree on one step and save it
+    # through the SAME manager — the save must chain incrementally off
+    # the last periodic step like any other save.
+    saver = ts.PreemptionSaver(
+        pg,
+        signals=(),
+        poll_interval=0.02,
+        rendezvous_timeout=30.0,
+        session="mgr-comp",
+    )
+    saved_at = drive_preemption_loop(
+        pg,
+        saver,
+        save_fn=lambda step: mgr.save(step, _state(pg.rank, step)),
+        evict_rank=1,
+        evict_step=5,
+        steps=200,
+    )
+    assert saved_at is not None, "eviction save never triggered"
+    agreed = wrapper.all_gather_object(saved_at)
+    assert agreed[0] == agreed[1] == saved_at, agreed
+    wrapper.barrier()  # rank 0's eviction-save commit + GC done
+
+    # The eviction save participated in retention exactly like a periodic
+    # save: index now [2, saved_at]; step 1 (unreferenced) was GC'd —
+    # commit marker first, then every blob (empty dirs remain by design:
+    # plugins cannot list) — while step 0, still referenced by both
+    # retained manifests, stays pinned with its blobs intact.
+    assert mgr.all_steps() == [2, saved_at]
+    step1 = mgr.step_path(1)
+    assert not os.path.exists(
+        os.path.join(step1, ".snapshot_metadata")
+    ), "dead step survived GC with a commit marker"
+    leftover = [
+        os.path.join(d, f)
+        for d, _, fs in os.walk(step1)
+        for f in fs
+    ]
+    assert not leftover, f"dead step's blobs survived GC: {leftover}"
+    assert os.path.exists(
+        os.path.join(mgr.step_path(0), ".snapshot_metadata")
+    ), "pinned base was deleted"
+
+    # The eviction snapshot is a real increment, not a full rewrite: its
+    # manifest references the origin step of the unchanged leaf.
+    snap = ts.Snapshot(mgr.step_path(saved_at), pg=pg)
+    refs = referenced_steps(snap.metadata.manifest)
+    assert 0 in refs, f"eviction save did not chain (refs: {sorted(refs)})"
+
+    # Deep fsck (full CRC audit, chain-aware) on every retained step:
+    # the incremental chains — including refs into the GC'd-but-pinned
+    # step 0 — are fully intact after the deletions.
+    for step in mgr.all_steps():
+        report = verify_snapshot(mgr.step_path(step), deep=True)
+        assert report.ok, (step, report.problems)
+    wrapper.barrier()
+
+    # Restart: a fresh manager (fresh process group state is the next
+    # process's job; here a fresh instance) resumes from the eviction
+    # step with the exact pre-eviction values.
+    mgr2 = ts.CheckpointManager(root, pg=pg, incremental=True)
+    dest = {
+        "train": ts.PyTreeState(
+            {
+                "frozen": np.zeros(4096, np.float32),
+                "hot": np.zeros(2048, np.float32),
+            }
+        ),
+        "progress": ts.StateDict(step=-1),
+    }
+    resumed = mgr2.restore_latest(dest)
+    assert resumed == saved_at
+    assert dest["progress"]["step"] == saved_at
+    np.testing.assert_array_equal(
+        dest["train"].tree["frozen"],
+        np.arange(4096, dtype=np.float32) + pg.rank,
+    )
+    np.testing.assert_array_equal(
+        dest["train"].tree["hot"],
+        np.full(2048, float(saved_at * 10 + pg.rank), np.float32),
+    )
