@@ -1,0 +1,54 @@
+"""Unit pins for bench.py's measurement helpers — the shared
+bracketed-efficiency epistemics (one definition for save AND restore)
+and the link-scaled probe sizing. Imported without running any leg."""
+
+import importlib.util
+import pathlib
+import sys
+
+
+def _load_bench():
+    path = pathlib.Path(__file__).parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("ts_bench_module", path)
+    mod = importlib.util.module_from_spec(spec)
+    # bench.py installs nothing at import time (handlers install in
+    # main()); importing is safe and side-effect-free beyond jax import.
+    sys.modules["ts_bench_module"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bracketed_efficiency_uses_best_bracket_side():
+    bench = _load_bench()
+    # 1 GiB moved in 10 s = 0.1 GB/s achieved; brackets are the max of
+    # the adjacent probes.
+    brackets, ratios, eff, unstable = bench._bracketed_efficiency(
+        [10.0, 20.0], [0.1, 0.2, 0.1], gib=1.0
+    )
+    assert brackets == [0.2, 0.2]
+    assert abs(ratios[0] - 0.5) < 1e-9  # 0.1 achieved / 0.2 bracket
+    assert abs(ratios[1] - 0.25) < 1e-9  # 0.05 achieved / 0.2 bracket
+    assert abs(eff - 0.375) < 1e-9  # median of the two
+    # 0.1 -> 0.2 adjacent disagreement is exactly 2x > 1.5x.
+    assert unstable
+
+
+def test_bracketed_efficiency_stable_link_not_flagged():
+    bench = _load_bench()
+    _, _, eff, unstable = bench._bracketed_efficiency(
+        [10.0], [0.1, 0.12], gib=1.0
+    )
+    assert not unstable
+    assert abs(eff - (0.1 / 0.12)) < 1e-9
+
+
+def test_scaled_chunk_targets_probe_seconds_within_clamp():
+    bench = _load_bench()
+    # 0.015 GB/s link, 4 streams, 12 s target -> ~46 MiB per stream.
+    mib = bench._scaled_chunk_mib(0.015, 4)
+    assert 32 <= mib <= 64
+    # Fast link clamps at the pipeline's real 256 MiB leaf size.
+    assert bench._scaled_chunk_mib(10.0, 4) == 256
+    # Degenerate/slow links clamp at the bandwidth-bound floor.
+    assert bench._scaled_chunk_mib(0.0005, 4) == 32
+    assert bench._scaled_chunk_mib(0.0, 4) == 32
