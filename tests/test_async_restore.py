@@ -244,3 +244,49 @@ def test_distributed_async_restore_rng_on_one_rank(pg) -> None:
             jax.random.key_data(dest["mm_rng"].keys),
             jax.random.key_data(jax.random.key(3)),
         )
+
+
+@multiprocess_test(nproc=2)
+def test_async_restore_peer_planning_failure_fails_fast(pg) -> None:
+    """Rank 1 fails during async-restore PLANNING (a pre-read setup
+    phase): round 5 keys the plan loop with error-propagating barriers
+    (agreed before any storage read), so rank 0 abandons at the plan
+    barrier in seconds — previously it stranded in a plain op-seq
+    barrier, where a reported error is invisible, for the full store
+    timeout."""
+    import shutil
+    import time
+    from unittest import mock
+
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.snapshot import Snapshot
+
+    path = os.path.join(tempfile.gettempdir(), "async-restore-plan-fail")
+    if pg.rank == 0:
+        shutil.rmtree(path, ignore_errors=True)
+    PGWrapper(pg).barrier()
+    state = {"m": ts.PyTreeState({"w": np.full(2048, 1.0 + pg.rank)})}
+    ts.Snapshot.take(path, state, pg=pg)
+
+    dest = {"m": ts.PyTreeState({"w": np.zeros(2048)})}
+    import contextlib
+
+    ctx = (
+        mock.patch.object(
+            Snapshot,
+            "_plan_stateful_load",
+            side_effect=RuntimeError("injected planning failure"),
+        )
+        if pg.rank == 1
+        else contextlib.nullcontext()
+    )
+    t0 = time.monotonic()
+    with ctx, pytest.raises(Exception):
+        pending = ts.Snapshot(path, pg=pg).async_restore(dest)
+        pending.wait()
+    assert time.monotonic() - t0 < 60.0, "peer blocked to store timeout"
+
+    # A clean retry still restores correctly.
+    dest2 = {"m": ts.PyTreeState({"w": np.zeros(2048)})}
+    ts.Snapshot(path, pg=pg).async_restore(dest2).wait()
+    assert float(np.asarray(dest2["m"].tree["w"])[0]) == 1.0 + pg.rank

@@ -436,3 +436,46 @@ def test_take_rng_on_one_rank_keeps_barrier_schedule(pg) -> None:
     ts.Snapshot(path, pg=pg).restore(dest)
     assert dest["aa"]["v"] == pg.rank
     assert dest["zz"]["w"] == 10 + pg.rank
+
+
+@multiprocess_test(nproc=2)
+def test_restore_setup_failure_fails_fast(pg) -> None:
+    """Rank 1 fails in restore SETUP (the manifest read — the
+    pre-coordination phase): round 5 hoists the restore's collectives
+    before the setup reads and reports setup failures into key barrier
+    0, so rank 0 abandons there in seconds instead of stranding inside
+    an op-seq collective poll for the full store timeout."""
+    import contextlib
+    import time
+    from unittest import mock
+
+    import numpy as np
+
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    path = os.path.join(tempfile.gettempdir(), "restore-setup-fail")
+    if pg.rank == 0:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    PGWrapper(pg).barrier()
+    state = {"m": ts.PyTreeState({"w": np.full(2048, 2.0 + pg.rank)})}
+    ts.Snapshot.take(path, state, pg=pg)
+
+    dest = {"m": ts.PyTreeState({"w": np.zeros(2048)})}
+    ctx = (
+        mock.patch(
+            "torchsnapshot_tpu.snapshot.get_manifest_for_rank",
+            side_effect=OSError("injected manifest read failure"),
+        )
+        if pg.rank == 1
+        else contextlib.nullcontext()
+    )
+    t0 = time.monotonic()
+    with ctx, pytest.raises(Exception):
+        ts.Snapshot(path, pg=pg).restore(dest)
+    assert time.monotonic() - t0 < 60.0, "peer blocked to store timeout"
+
+    dest2 = {"m": ts.PyTreeState({"w": np.zeros(2048)})}
+    ts.Snapshot(path, pg=pg).restore(dest2)
+    assert float(dest2["m"].tree["w"][0]) == 2.0 + pg.rank

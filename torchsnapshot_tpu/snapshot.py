@@ -72,6 +72,21 @@ logger: logging.Logger = logging.getLogger(__name__)
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 
 
+def _nonce_barrier(prefix: str, pg_wrapper: "PGWrapper") -> Optional[LinearBarrier]:
+    """The error-propagating rendezvous used by every distributed phase
+    (take commit, restore keys, async plan/apply), built one way so the
+    phases can never diverge in barrier wiring. None single-process."""
+    if pg_wrapper.get_world_size() <= 1:
+        return None
+    assert pg_wrapper.store is not None
+    return LinearBarrier(
+        prefix=prefix,
+        store=pg_wrapper.store,
+        rank=pg_wrapper.get_rank(),
+        world_size=pg_wrapper.get_world_size(),
+    )
+
+
 @contextlib.contextmanager
 def _reporting_to(barrier: Optional["LinearBarrier"], what: str):
     """Fail-fast discipline shared by every distributed phase: an error
@@ -144,12 +159,8 @@ class Snapshot:
         barrier = None
         if pg_wrapper.get_world_size() > 1:
             commit_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
-            assert pg_wrapper.store is not None
-            barrier = LinearBarrier(
-                prefix=f"__snapshot_commit/{commit_nonce}",
-                store=pg_wrapper.store,
-                rank=pg_wrapper.get_rank(),
-                world_size=pg_wrapper.get_world_size(),
+            barrier = _nonce_barrier(
+                f"__snapshot_commit/{commit_nonce}", pg_wrapper
             )
         event_loop = asyncio.new_event_loop()
         try:
@@ -225,15 +236,9 @@ class Snapshot:
         # whose staging succeeded already have commit threads waiting at
         # arrive(), and without the report they block out the full store
         # timeout.
-        barrier = None
-        if pg_wrapper.get_world_size() > 1:
-            assert pg_wrapper.store is not None
-            barrier = LinearBarrier(
-                prefix=f"__snapshot_commit/{commit_nonce}",
-                store=pg_wrapper.store,
-                rank=pg_wrapper.get_rank(),
-                world_size=pg_wrapper.get_world_size(),
-            )
+        barrier = _nonce_barrier(
+            f"__snapshot_commit/{commit_nonce}", pg_wrapper
+        )
         event_loop = asyncio.new_event_loop()
         storage = url_to_storage_plugin(path)
         try:
@@ -503,24 +508,30 @@ class Snapshot:
         def key_barrier(i: int) -> Optional[LinearBarrier]:
             if restore_nonce is None:
                 return None
-            assert pg_wrapper.store is not None
-            return LinearBarrier(
-                prefix=f"__restore/{restore_nonce}/{i}",
-                store=pg_wrapper.store,
-                rank=rank,
-                world_size=pg_wrapper.get_world_size(),
+            return _nonce_barrier(
+                f"__restore/{restore_nonce}/{i}", pg_wrapper
             )
 
         event_loop = asyncio.new_event_loop()
         try:
             storage = url_to_storage_plugin(self.path)
-            available = get_manifest_for_rank(self.metadata, rank)
-            memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
-            checksum_table = self._get_checksum_table(storage, event_loop)
-
+            # Collectives FIRST, storage reads second (round 5; same
+            # principle as _take_impl's budget-before-gather order): the
+            # metadata and checksum-table reads are the restore's
+            # pre-coordination failure points, and a rank failing there
+            # must not leave peers inside an op-seq collective poll —
+            # where a reported error is invisible. After the reorder,
+            # only local work sits between a rank's setup reads and the
+            # first error-aware key barrier, so setup failures reported
+            # into key barrier 0 abandon peers in seconds.
             rng_key_and_state = _pop_rng_state(app_state)
             rng_key = rng_key_and_state[0] if rng_key_and_state else None
             keys = _gather_keys(app_state, pg_wrapper)
+            memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
+            setup_barrier = key_barrier(0) if keys else None
+            with _reporting_to(setup_barrier, "restore setup"):
+                available = get_manifest_for_rank(self.metadata, rank)
+                checksum_table = self._get_checksum_table(storage, event_loop)
             for i, key in enumerate(keys):
                 stateful = app_state.get(key)
                 if key == rng_key:
@@ -584,7 +595,6 @@ class Snapshot:
         _validate_app_state(app_state)
         pg_wrapper = PGWrapper(self._pg_arg)
         rank = pg_wrapper.get_rank()
-        available = get_manifest_for_rank(self.metadata, rank)
         memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
 
         rng_key_and_state = _pop_rng_state(app_state)
@@ -596,26 +606,49 @@ class Snapshot:
         # exactly like the sync path.
         keys = _gather_keys(app_state, pg_wrapper)
 
-        plans: Dict[str, _StatefulLoadPlan] = {}
-        for key in keys:
-            stateful = app_state.get(key)
-            if stateful is not None:
-                plan = self._plan_stateful_load(
-                    key, stateful, available, memory_budget_bytes
-                )
-                if plan is not None:
-                    plans[key] = plan
-            # state_dict() may itself run collectives: keep the capture
-            # globally ordered (reference snapshot.py:353-370).
-            pg_wrapper.barrier()
-
-        # Nonce for the apply phase's error-propagating barriers — agreed
-        # here, on the thread that owns collective ordering.
+        # Nonce for the plan AND apply phases' error-propagating barriers
+        # — agreed BEFORE any storage read or planning (round 5), so the
+        # whole setup runs with an error-aware rendezvous in place: the
+        # metadata read and per-key planning report failures into the
+        # plan barriers below, and peers abandon there in seconds instead
+        # of stranding inside a plain op-seq barrier (where a reported
+        # error is invisible) for the full store timeout.
         restore_nonce = None
         if pg_wrapper.get_world_size() > 1:
             import uuid
 
             restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+
+        def plan_barrier(i: int) -> Optional[LinearBarrier]:
+            if restore_nonce is None:
+                return None
+            return _nonce_barrier(
+                f"__restore/{restore_nonce}/plan{i}", pg_wrapper
+            )
+
+        setup_barrier = plan_barrier(0) if keys else None
+        with _reporting_to(setup_barrier, "async restore setup"):
+            available = get_manifest_for_rank(self.metadata, rank)
+            world_size = self.metadata.world_size
+
+        plans: Dict[str, _StatefulLoadPlan] = {}
+        for i, key in enumerate(keys):
+            barrier = plan_barrier(i)
+            with _reporting_to(barrier, "async restore planning"):
+                stateful = app_state.get(key)
+                if stateful is not None:
+                    plan = self._plan_stateful_load(
+                        key, stateful, available, memory_budget_bytes
+                    )
+                    if plan is not None:
+                        plans[key] = plan
+            # state_dict() may itself run collectives: keep the capture
+            # globally ordered (reference snapshot.py:353-370). The
+            # barrier is error-aware: a peer's planning failure abandons
+            # this rank here instead of at a store timeout.
+            if barrier is not None:
+                barrier.arrive()
+                barrier.depart()
 
         return PendingRestore(
             path=self.path,
@@ -624,7 +657,7 @@ class Snapshot:
             pg_wrapper=pg_wrapper,
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
-            world_size=self.metadata.world_size,
+            world_size=world_size,
             rng_key=rng_key,
             restore_nonce=restore_nonce,
         )
@@ -1088,14 +1121,9 @@ class PendingSnapshot:
     def _complete_snapshot(self) -> None:
         barrier = None
         try:
-            if self.pg.get_world_size() > 1:
-                assert self.pg.store is not None
-                barrier = LinearBarrier(
-                    prefix=f"__snapshot_commit/{self.commit_nonce}",
-                    store=self.pg.store,
-                    rank=self.pg.get_rank(),
-                    world_size=self.pg.get_world_size(),
-                )
+            barrier = _nonce_barrier(
+                f"__snapshot_commit/{self.commit_nonce}", self.pg
+            )
             self._pending_io_work.sync_complete(self._event_loop)
             self._pending_io_work.finalize_checksums()
             _maybe_write_checksum_table(
@@ -1234,12 +1262,8 @@ class PendingRestore:
     def _key_barrier(self, i: int) -> Optional[LinearBarrier]:
         if self._restore_nonce is None:
             return None
-        assert self._pg.store is not None
-        return LinearBarrier(
-            prefix=f"__restore/{self._restore_nonce}/{i}",
-            store=self._pg.store,
-            rank=self._rank,
-            world_size=self._pg.get_world_size(),
+        return _nonce_barrier(
+            f"__restore/{self._restore_nonce}/{i}", self._pg
         )
 
     def wait(self) -> None:
