@@ -33,19 +33,12 @@ class SlowFSStoragePlugin(FSStoragePlugin):
         await super().write(write_io)
 
 
-class FaultyFSStoragePlugin(FSStoragePlugin):
-    async def write(self, write_io: WriteIO) -> None:
-        if write_io.path != SNAPSHOT_METADATA_FNAME:
-            await asyncio.sleep(0.05)
-            raise OSError("injected storage failure")
-        await super().write(write_io)
+from torchsnapshot_tpu.test_utils import faulty_fs_plugin
+from torchsnapshot_tpu.test_utils import patch_storage_plugin as _patch_plugin
 
-
-def _patch_plugin(cls):
-    return mock.patch(
-        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
-        side_effect=lambda url: cls(root=url.split("://")[-1]),
-    )
+FaultyFSStoragePlugin = faulty_fs_plugin(
+    lambda path: path != SNAPSHOT_METADATA_FNAME, delay_s=0.05
+)
 
 
 def test_async_take_roundtrip(tmp_path) -> None:

@@ -10,13 +10,12 @@ passed during round 4; these 8 deterministic seeds pin it.
 """
 
 import os
-from unittest import mock
 
 import numpy as np
 import pytest
 
 import torchsnapshot_tpu as ts
-from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.test_utils import faulty_fs_plugin, patch_storage_plugin
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -40,22 +39,14 @@ def test_read_failure_raises_then_clean_retry_succeeds(tmp_path, seed) -> None:
     fail_at = int(rng.integers(0, n_leaves))
     counter = {"n": 0}
 
-    class CrashyRead(FSStoragePlugin):
-        async def read(self, read_io):
-            counter["n"] += 1
-            if counter["n"] > fail_at:
-                raise OSError("injected read failure")
-            await super().read(read_io)
+    def _crash_after(_path: str) -> bool:
+        counter["n"] += 1
+        return counter["n"] > fail_at
 
-        async def read_with_checksum(self, read_io):
-            counter["n"] += 1
-            if counter["n"] > fail_at:
-                raise OSError("injected read failure")
-            return await super().read_with_checksum(read_io)
-
-    patch = mock.patch(
-        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
-        side_effect=lambda url: CrashyRead(root=url.split("://")[-1]),
+    patch = patch_storage_plugin(
+        faulty_fs_plugin(
+            _crash_after, ops=("read",), exc_msg="injected read failure"
+        )
     )
     dst = ts.PyTreeState(
         {f"l{i}": np.zeros_like(state[f"l{i}"]) for i in range(n_leaves)}
@@ -84,22 +75,12 @@ def test_crash_at_random_write_index(tmp_path, seed) -> None:
     fail_at = int(rng.integers(0, n_leaves + 2))
     counter = {"n": 0}
 
-    class Crashy(FSStoragePlugin):
-        async def write(self, write_io):
-            counter["n"] += 1
-            if counter["n"] > fail_at:
-                raise OSError("injected failure")
-            await super().write(write_io)
+    def _crash_after(_path: str) -> bool:
+        counter["n"] += 1
+        return counter["n"] > fail_at
 
-        async def write_with_checksum(self, write_io):
-            counter["n"] += 1
-            if counter["n"] > fail_at:
-                raise OSError("injected failure")
-            return await super().write_with_checksum(write_io)
-
-    patch = mock.patch(
-        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
-        side_effect=lambda url: Crashy(root=url.split("://")[-1]),
+    patch = patch_storage_plugin(
+        faulty_fs_plugin(_crash_after, exc_msg="injected failure")
     )
     path = str(tmp_path / "s")
     crashed = False

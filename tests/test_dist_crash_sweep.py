@@ -1,0 +1,176 @@
+"""Seeded randomized distributed crash sweep — the suite-resident slice
+of the round-5 fail-fast validation (the full sweep ran 22 cases; these
+seeds pin one of each injection family under schedule variation).
+
+Each case injects one failure at a random covered point — take side:
+storage write on a random rank, rank-0 metadata write in the commit
+window, rank-0 replication consolidation during staging; restore side:
+setup (manifest read), data read, async planning on a random rank —
+over random state shapes and sync/async modes, asserting every rank
+raises well under the 300 s store timeout, no commit marker survives a
+failed take, and a clean retry succeeds after a failed restore. This is
+the regression net for the collectives-before-failure-points rule
+(docs/design.md): peers must abandon at an error-aware barrier, never
+inside an op-seq collective poll."""
+
+import contextlib
+import os
+import shutil
+import tempfile
+import time
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.pg_wrapper import PGWrapper
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME, Snapshot
+from torchsnapshot_tpu.test_utils import (
+    faulty_fs_plugin,
+    multiprocess_test,
+    patch_storage_plugin,
+)
+
+
+def _data_blob(path: str) -> bool:
+    return "/m/" in path or "batched" in path
+
+
+def _rand_state(rng, n_leaves: int, rank: int) -> dict:
+    return {
+        "m": ts.PyTreeState(
+            {
+                f"l{i}": rng.standard_normal(
+                    int(rng.integers(64, 4096))
+                ).astype(np.float32)
+                + rank
+                for i in range(n_leaves)
+            }
+        )
+    }
+
+
+def _take_case(pg, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    mode = rng.choice(["sync", "async"])
+    fail_point = rng.choice(["write", "metadata", "consolidate"])
+    fail_rank = int(rng.integers(0, 2)) if fail_point == "write" else 0
+    path = os.path.join(tempfile.gettempdir(), f"crash-sweep-take-{seed}")
+    if pg.rank == 0:
+        shutil.rmtree(path, ignore_errors=True)
+    PGWrapper(pg).barrier()
+    state = _rand_state(rng, int(rng.integers(1, 5)), pg.rank)
+
+    ctx = contextlib.nullcontext()
+    if fail_point == "write" and pg.rank == fail_rank:
+        ctx = patch_storage_plugin(
+            faulty_fs_plugin(
+                _data_blob, exc_msg=f"injected write failure ({seed})"
+            )
+        )
+    elif fail_point == "metadata" and pg.rank == 0:
+        ctx = mock.patch.object(
+            Snapshot,
+            "_write_snapshot_metadata",
+            side_effect=RuntimeError(f"injected metadata failure ({seed})"),
+        )
+    elif fail_point == "consolidate" and pg.rank == 0:
+        ctx = mock.patch(
+            "torchsnapshot_tpu.partitioner.consolidate_replicated_entries",
+            side_effect=RuntimeError(f"injected consolidate failure ({seed})"),
+        )
+
+    t0 = time.monotonic()
+    with ctx, pytest.raises(Exception):
+        if mode == "sync":
+            ts.Snapshot.take(path, state, pg=pg, replicated=["m/**"])
+        else:
+            ts.Snapshot.async_take(
+                path, state, pg=pg, replicated=["m/**"]
+            ).wait()
+    assert time.monotonic() - t0 < 60.0, (
+        f"seed {seed} rank {pg.rank} blocked to store timeout "
+        f"({mode}/{fail_point}/rank{fail_rank})"
+    )
+    assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
+
+
+def _restore_case(pg, seed: int) -> None:
+    rng = np.random.default_rng(1000 + seed)
+    mode = rng.choice(["sync", "async"])
+    fail_point = rng.choice(["setup", "read", "plan"])
+    fail_rank = int(rng.integers(0, 2))
+    n_leaves = int(rng.integers(1, 4))
+    path = os.path.join(tempfile.gettempdir(), f"crash-sweep-restore-{seed}")
+    if pg.rank == 0:
+        shutil.rmtree(path, ignore_errors=True)
+    PGWrapper(pg).barrier()
+    state = _rand_state(rng, n_leaves, pg.rank)
+    ts.Snapshot.take(path, state, pg=pg)
+
+    def dest():
+        return {
+            "m": ts.PyTreeState(
+                {
+                    f"l{i}": np.zeros_like(state["m"].tree[f"l{i}"])
+                    for i in range(n_leaves)
+                }
+            )
+        }
+
+    ctx = contextlib.nullcontext()
+    if pg.rank == fail_rank:
+        if fail_point == "setup":
+            ctx = mock.patch(
+                "torchsnapshot_tpu.snapshot.get_manifest_for_rank",
+                side_effect=OSError(f"injected setup failure ({seed})"),
+            )
+        elif fail_point == "read":
+            ctx = patch_storage_plugin(
+                faulty_fs_plugin(
+                    _data_blob,
+                    ops=("read",),
+                    exc_msg=f"injected read failure ({seed})",
+                )
+            )
+        else:
+            ctx = mock.patch.object(
+                Snapshot,
+                "_plan_stateful_load",
+                side_effect=RuntimeError(f"injected plan failure ({seed})"),
+            )
+
+    t0 = time.monotonic()
+    with ctx, pytest.raises(Exception):
+        if mode == "sync":
+            ts.Snapshot(path, pg=pg).restore(dest())
+        else:
+            ts.Snapshot(path, pg=pg).async_restore(dest()).wait()
+    assert time.monotonic() - t0 < 60.0, (
+        f"seed {seed} rank {pg.rank} blocked to store timeout "
+        f"({mode}/{fail_point}/rank{fail_rank})"
+    )
+    d2 = dest()
+    if mode == "sync":
+        ts.Snapshot(path, pg=pg).restore(d2)
+    else:
+        ts.Snapshot(path, pg=pg).async_restore(d2).wait()
+    for i in range(n_leaves):
+        np.testing.assert_array_equal(
+            d2["m"].tree[f"l{i}"], state["m"].tree[f"l{i}"]
+        )
+
+
+@multiprocess_test(nproc=2)
+def test_take_crash_sweep(pg) -> None:
+    # async/metadata, async/write, sync/consolidate, sync/write
+    for seed in (0, 2, 9, 11):
+        _take_case(pg, seed)
+
+
+@multiprocess_test(nproc=2)
+def test_restore_crash_sweep(pg) -> None:
+    # sync/read, async/setup, sync/plan, async/plan
+    for seed in (0, 4, 13, 17):
+        _restore_case(pg, seed)

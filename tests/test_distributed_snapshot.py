@@ -42,24 +42,18 @@ def test_restore_peer_failure_fails_fast(pg) -> None:
     }
     ts.Snapshot.take(path, state, pg=pg)
 
-    class FaultyDataRead(FSStoragePlugin):
-        # Data blobs only: metadata/checksum-table reads precede any
-        # cross-rank coordination.
-        async def read(self, read_io):
-            if "/m/" in read_io.path:
-                raise OSError("injected read failure")
-            await super().read(read_io)
-
-        async def read_with_checksum(self, read_io):
-            if "/m/" in read_io.path:
-                raise OSError("injected read failure")
-            return await super().read_with_checksum(read_io)
-
-    cls = FaultyDataRead if pg.rank == 1 else FSStoragePlugin
-    patch = mock.patch(
-        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
-        side_effect=lambda url: cls(root=url.split("://")[-1]),
+    from torchsnapshot_tpu.test_utils import (
+        faulty_fs_plugin,
+        patch_storage_plugin,
     )
+
+    # Data blobs only: metadata/checksum-table reads precede any
+    # cross-rank coordination.
+    FaultyDataRead = faulty_fs_plugin(
+        lambda p: "/m/" in p, ops=("read",), exc_msg="injected read failure"
+    )
+    cls = FaultyDataRead if pg.rank == 1 else FSStoragePlugin
+    patch = patch_storage_plugin(cls)
     dst = {"m": ts.PyTreeState({"w": np.zeros(4096, np.float32)})}
     t0 = time.monotonic()
     with patch, pytest.raises(Exception):

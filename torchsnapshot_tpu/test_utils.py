@@ -34,6 +34,61 @@ def get_free_port() -> int:
         return s.getsockname()[1]
 
 
+def faulty_fs_plugin(
+    should_fail: Callable[[str], bool],
+    ops: Sequence[str] = ("write",),
+    exc_msg: str = "injected storage failure",
+    delay_s: float = 0.0,
+):
+    """An ``FSStoragePlugin`` subclass whose listed ``ops`` ("write",
+    "read" — each covering its fused ``*_with_checksum`` variant too)
+    raise ``OSError(exc_msg)`` when ``should_fail(io.path)`` is truthy.
+
+    The one fault-injection seam for the crash/fail-fast tests:
+    ``should_fail`` may filter by path (data blobs only) or close over a
+    counter (crash at the N-th storage op). Pair with
+    :func:`patch_storage_plugin`."""
+    import asyncio
+
+    from .storage_plugins.fs import FSStoragePlugin
+
+    async def _maybe_fail(path: str, op: str) -> None:
+        if op in ops and should_fail(path):
+            if delay_s:
+                await asyncio.sleep(delay_s)
+            raise OSError(exc_msg)
+
+    class _Faulty(FSStoragePlugin):
+        async def write(self, write_io):
+            await _maybe_fail(write_io.path, "write")
+            await super().write(write_io)
+
+        async def write_with_checksum(self, write_io):
+            await _maybe_fail(write_io.path, "write")
+            return await super().write_with_checksum(write_io)
+
+        async def read(self, read_io):
+            await _maybe_fail(read_io.path, "read")
+            await super().read(read_io)
+
+        async def read_with_checksum(self, read_io):
+            await _maybe_fail(read_io.path, "read")
+            return await super().read_with_checksum(read_io)
+
+    return _Faulty
+
+
+def patch_storage_plugin(cls):
+    """Route ``Snapshot``'s plugin resolution to ``cls`` for the scope of
+    the returned context manager."""
+    from unittest import mock
+
+    return mock.patch(
+        "torchsnapshot_tpu.snapshot.url_to_storage_plugin",
+        side_effect=lambda url: cls(root=url.split("://")[-1]),
+    )
+
+
 class ByteCountingStore(Store):
     """Delegating store wrapper that meters this rank's coordination
     traffic: payload bytes sent (``set`` values) and received (``try_get``
