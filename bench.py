@@ -98,7 +98,14 @@ START = time.monotonic()
 BUDGET_S = float(os.environ.get("TS_BENCH_BUDGET_S", "1200"))
 RESERVE_S = 45.0  # kept back for finalization (ceiling-after, emission)
 PROBE_TARGET_S = 12.0  # a scaled probe should cost about this much
-_PARTIAL_PATH = Path(__file__).resolve().parent / "BENCH_partial.json"
+# Repo-root by default (stable regardless of cwd, where the driver looks);
+# overridable so tests/sandboxed runs don't dirty the working tree.
+_PARTIAL_PATH = Path(
+    os.environ.get(
+        "TS_BENCH_PARTIAL_PATH",
+        Path(__file__).resolve().parent / "BENCH_partial.json",
+    )
+)
 
 # The record, filled leg by leg. Headline fields first so a partial
 # record still leads with the metric contract.
