@@ -1,0 +1,30 @@
+"""Shared helper for the reference-library interop tests.
+
+One definition of "import the actual TorchSnapshot library" so the
+reader and writer interop suites cannot drift: same location policy
+(``TS_REFERENCE_ROOT`` env override, default ``/root/reference``), same
+skip behavior when the library or its dependencies are absent.
+"""
+
+import os
+import sys
+
+import pytest
+
+_REFERENCE_ROOT = os.environ.get("TS_REFERENCE_ROOT", "/root/reference")
+
+
+def import_reference():
+    """Import and return the reference ``torchsnapshot`` package, or
+    skip the calling test when it is unavailable."""
+    if not os.path.isdir(_REFERENCE_ROOT):
+        pytest.skip("reference tree not present")
+    sys.path.insert(0, _REFERENCE_ROOT)
+    try:
+        import torchsnapshot  # noqa: F401
+
+        return torchsnapshot
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"reference library not importable: {e!r}")
+    finally:
+        sys.path.remove(_REFERENCE_ROOT)

@@ -16,11 +16,12 @@ Two layers:
 import base64
 import os
 import struct
-import sys
 from collections import OrderedDict
 
 import numpy as np
 import pytest
+
+from interop_utils import import_reference
 import yaml
 
 from torchsnapshot_tpu.tricks.torchsnapshot_reader import (
@@ -30,7 +31,6 @@ from torchsnapshot_tpu.tricks.torchsnapshot_reader import (
 
 ml_dtypes = pytest.importorskip("ml_dtypes")
 
-_REFERENCE_ROOT = "/root/reference"
 
 
 def _write(path, data: bytes) -> None:
@@ -217,6 +217,9 @@ def test_torch_save_entries(tmp_path):
         "n": 5,
         "leaf_list": [1, 2, 3],
         "pairs": [(0, "a"), (1, "b")],
+        # numpy payloads are rejected by torch>=2.6's weights_only
+        # default — the reader must load the user's own checkpoint fully.
+        "np_payload": np.arange(3),
     }
     import io as _io
 
@@ -250,6 +253,7 @@ def test_torch_save_entries(tmp_path):
     assert state["s"]["o"]["n"] == 5
     assert state["s"]["o"]["leaf_list"] == [1, 2, 3]
     assert state["s"]["o"]["pairs"] == [(0, "a"), (1, "b")]
+    np.testing.assert_array_equal(state["s"]["o"]["np_payload"], np.arange(3))
 
 
 def test_qtensor_serializer_rejected_with_explanation(tmp_path):
@@ -273,23 +277,9 @@ def test_qtensor_serializer_rejected_with_explanation(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _import_reference():
-    if not os.path.isdir(_REFERENCE_ROOT):
-        pytest.skip("reference tree not present")
-    sys.path.insert(0, _REFERENCE_ROOT)
-    try:
-        import torchsnapshot  # noqa: F401
-
-        return torchsnapshot
-    except Exception as e:  # pragma: no cover - environment-dependent
-        pytest.skip(f"reference library not importable: {e!r}")
-    finally:
-        sys.path.remove(_REFERENCE_ROOT)
-
-
 def test_reference_library_interop(tmp_path):
     torch = pytest.importorskip("torch")
-    torchsnapshot = _import_reference()
+    torchsnapshot = import_reference()
 
     torch.manual_seed(3)
     app_state = {
@@ -332,7 +322,7 @@ def test_reference_library_interop(tmp_path):
 
 def test_reference_library_interop_chunked_and_batched(tmp_path):
     torch = pytest.importorskip("torch")
-    torchsnapshot = _import_reference()
+    torchsnapshot = import_reference()
 
     big = torch.randn(1 << 14)  # 64 KiB fp32 — chunks at a 16 KiB knob
     small = [torch.randn(16) for _ in range(4)]

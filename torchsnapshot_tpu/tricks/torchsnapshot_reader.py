@@ -367,7 +367,14 @@ class ReferenceSnapshotReader:
                 "this snapshot entry was serialized with torch_save; "
                 "install torch (CPU is enough) to read it"
             ) from None
-        return torch.load(io.BytesIO(bytes(data)), map_location="cpu")
+        # weights_only=False: torch>=2.6 flipped the default, which
+        # rejects numpy payloads and user classes — the very things the
+        # reference pickles into object entries. This reads the user's
+        # OWN checkpoint (same trust model as the reference-era
+        # torch.load), so full unpickling is the correct behavior here.
+        return torch.load(
+            io.BytesIO(bytes(data)), map_location="cpu", weights_only=False
+        )
 
     def _inflate(
         self, manifest: Dict[str, Any], leaves: Dict[str, Any]

@@ -1,0 +1,238 @@
+"""Write reference-format (TorchSnapshot 0.0.3) snapshots from JAX state.
+
+The reverse of :mod:`.torchsnapshot_reader`, completing bidirectional
+migration: a team moving to this framework keeps an escape hatch back to
+their torch tooling — evaluation scripts, checkpoint inspectors, or a
+rollback of the migration itself — because anything this writer emits is
+restorable by the *actual* reference library
+(``torchsnapshot.Snapshot(path).restore(...)``), which the interop test
+exercises.
+
+This is a migration utility, not a second checkpointer: single-process,
+world_size 1, synchronous, no batching/partitioning — the native
+``Snapshot.take`` remains the production path. Format per the reference
+schema (entry taxonomy ``manifest.py:27-290``, flatten/percent-escaping
+``flatten.py:204-211``, dtype strings ``serialization.py:56-79``):
+
+- numpy / ``jax.Array`` leaves → ``Tensor`` entries. Dtypes in the
+  reference's buffer-protocol set (f64/f32/f16/bf16/i64/i32/i16/i8/u8/
+  bool) are written as raw little-endian bytes readable with no torch at
+  all; complex64/128 — which the reference only round-trips via
+  ``torch_save`` — are written with that serializer (torch required).
+  Dtypes the reference cannot represent at all (fp8, uint16/32/64) are
+  rejected with a clear error rather than silently widened.
+- int/str/bool/bytes/float leaves → inline primitive entries (float in
+  the reference's exact base64-packed form).
+- anything else → ``object`` entries via ``torch.save`` (torch required;
+  the reference's object path is torch_save pickles).
+
+Usage::
+
+    from torchsnapshot_tpu.tricks.torchsnapshot_writer import (
+        write_reference_snapshot,
+    )
+
+    write_reference_snapshot(
+        "/ckpts/export_for_torch",
+        {"model": {"w": params["w"], "bias": params["bias"]},
+         "progress": {"step": 100}},
+    )
+    # torch side:  torchsnapshot.Snapshot(path).restore(app_state)
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import struct
+from typing import Any, Dict
+
+import numpy as np
+
+from ..event_loop import run_in_fresh_event_loop
+from ..flatten import DictEntry, ListEntry, OrderedDictEntry, flatten
+from ..io_types import WriteIO
+from ..manifest import yaml, _Dumper
+from ..storage_plugin import url_to_storage_plugin
+
+_METADATA_FNAME = ".snapshot_metadata"
+
+# numpy dtype name → reference dtype string, buffer-protocol subset
+# (reference serialization.py:146-159: complex is NOT buffer-protocol
+# there; it round-trips via torch_save).
+_BUFFER_PROTOCOL_DTYPES: Dict[str, str] = {
+    "float64": "torch.float64",
+    "float32": "torch.float32",
+    "float16": "torch.float16",
+    "bfloat16": "torch.bfloat16",
+    "int64": "torch.int64",
+    "int32": "torch.int32",
+    "int16": "torch.int16",
+    "int8": "torch.int8",
+    "uint8": "torch.uint8",
+    "bool": "torch.bool",
+}
+_TORCH_SAVE_DTYPES: Dict[str, str] = {
+    "complex128": "torch.complex128",
+    "complex64": "torch.complex64",
+}
+
+
+def write_reference_snapshot(path: str, app_state: Dict[str, Any]) -> None:
+    """Write ``app_state`` (``{key: nested pytree-like value}``) as a
+    world_size-1 reference-format snapshot at ``path`` (fs/s3/gs URL)."""
+    manifest: Dict[str, Any] = {}
+    pending = []  # (logical_path, leaf) — serialized one at a time below
+
+    for key, value in app_state.items():
+        containers, leaves = flatten(value, prefix=key)
+        for cpath, centry in containers.items():
+            manifest[f"0/{cpath}"] = _container_to_reference(centry)
+        pending.extend(leaves.items())
+
+    async def _go() -> None:
+        storage = url_to_storage_plugin(path)
+        try:
+            # Serialize each leaf inside the loop and drop its bytes
+            # after the write: peak memory is one leaf, not the whole
+            # checkpoint (this is the multi-GB rollback-export path).
+            for lpath, leaf in pending:
+                entry, blob = _prepare_leaf(lpath, leaf)
+                manifest[f"0/{lpath}"] = entry
+                if blob is not None:
+                    await storage.write(
+                        WriteIO(path=path_location(lpath), buf=blob)
+                    )
+            doc = {"version": "0.0.3", "world_size": 1, "manifest": manifest}
+            metadata = yaml.dump(doc, sort_keys=False, Dumper=_Dumper)
+            # Metadata last: its presence is the reference's commit marker.
+            await storage.write(
+                WriteIO(path=_METADATA_FNAME, buf=metadata.encode("utf-8"))
+            )
+        finally:
+            await storage.close()
+
+    run_in_fresh_event_loop(_go())
+
+
+def _container_to_reference(entry: Any) -> Dict[str, Any]:
+    if isinstance(entry, ListEntry):
+        return {"type": "list"}
+    if isinstance(entry, OrderedDictEntry):
+        return {"type": "OrderedDict", "keys": list(entry.keys)}
+    if isinstance(entry, DictEntry):
+        return {"type": "dict", "keys": list(entry.keys)}
+    raise TypeError(f"unexpected container entry {entry!r}")
+
+
+def _prepare_leaf(path: str, leaf: Any) -> tuple:
+    """Returns ``(manifest_entry, blob_bytes_or_None)``."""
+    if isinstance(leaf, bool):  # before int: bool is an int subclass
+        return _primitive("bool", str(leaf)), None
+    if isinstance(leaf, int):
+        return _primitive("int", str(leaf)), None
+    if isinstance(leaf, float):
+        packed = base64.b64encode(struct.pack("d", leaf)).decode("utf-8")
+        return _primitive("float", packed, readable=str(leaf)), None
+    if isinstance(leaf, str):
+        return _primitive("str", leaf), None
+    if isinstance(leaf, bytes):
+        return (
+            _primitive("bytes", base64.b64encode(leaf).decode("utf-8")),
+            None,
+        )
+
+    arr = _as_numpy(leaf)
+    if arr is not None:
+        return _tensor_entry(path, arr)
+
+    # Generic object → torch_save pickle (the reference's object path).
+    torch = _require_torch(f"object leaf at {path!r}")
+    buf = io.BytesIO()
+    torch.save(leaf, buf)
+    entry = {
+        "type": "object",
+        "location": path_location(path),
+        "serializer": "torch_save",
+        "obj_type": type(leaf).__name__,
+        "replicated": False,
+    }
+    return entry, buf.getvalue()
+
+
+def path_location(path: str) -> str:
+    return f"0/{path}"
+
+
+def _as_numpy(leaf: Any):
+    """numpy/jax arrays (and 0-d numpy scalars) → contiguous ndarray;
+    None for non-array leaves."""
+    if isinstance(leaf, np.ndarray):
+        return np.ascontiguousarray(leaf)
+    if isinstance(leaf, np.generic):
+        return np.ascontiguousarray(np.asarray(leaf))
+    # jax.Array without importing jax eagerly: anything exposing
+    # __array__ plus .dtype/.shape quacks close enough.
+    if hasattr(leaf, "__array__") and hasattr(leaf, "dtype") and hasattr(
+        leaf, "shape"
+    ):
+        return np.ascontiguousarray(np.asarray(leaf))
+    return None
+
+
+def _tensor_entry(path: str, arr: np.ndarray) -> tuple:
+    name = arr.dtype.name
+    if name in _BUFFER_PROTOCOL_DTYPES:
+        entry = {
+            "type": "Tensor",
+            "location": path_location(path),
+            "serializer": "buffer_protocol",
+            "dtype": _BUFFER_PROTOCOL_DTYPES[name],
+            "shape": list(arr.shape),
+            "replicated": False,
+            "byte_range": None,
+        }
+        return entry, arr.tobytes()
+    if name in _TORCH_SAVE_DTYPES:
+        torch = _require_torch(f"complex leaf at {path!r}")
+        buf = io.BytesIO()
+        torch.save(torch.from_numpy(np.ascontiguousarray(arr)), buf)
+        entry = {
+            "type": "Tensor",
+            "location": path_location(path),
+            "serializer": "torch_save",
+            "dtype": _TORCH_SAVE_DTYPES[name],
+            "shape": list(arr.shape),
+            "replicated": False,
+            "byte_range": None,
+        }
+        return entry, buf.getvalue()
+    raise ValueError(
+        f"dtype {name!r} (leaf {path!r}) has no representation in the "
+        f"reference's format (its dtype table is fixed — reference "
+        f"serialization.py:32-103); cast to a supported dtype first "
+        f"(e.g. fp8 -> bfloat16, uint32 -> int64)"
+    )
+
+
+def _primitive(
+    kind: str, serialized: str, readable: str = None
+) -> Dict[str, Any]:
+    return {
+        "type": kind,
+        "serialized_value": serialized,
+        "replicated": False,
+        "readable": readable,
+    }
+
+
+def _require_torch(what: str):
+    try:
+        import torch
+
+        return torch
+    except ImportError:
+        raise RuntimeError(
+            f"writing {what} requires torch (the reference format "
+            f"serializes it via torch_save)"
+        ) from None
