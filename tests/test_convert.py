@@ -1,0 +1,163 @@
+"""Reference-snapshot → native-snapshot conversion CLI.
+
+After conversion the full native feature set must apply: the converted
+snapshot restores through the native path, passes the native fsck, and
+chains as an incremental base.
+"""
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.tricks.convert import convert, main, verify_source
+from torchsnapshot_tpu.tricks.torchsnapshot_reader import (
+    ReferenceSnapshotReader,
+)
+from torchsnapshot_tpu.tricks.torchsnapshot_writer import (
+    write_reference_snapshot,
+)
+
+ml_dtypes = pytest.importorskip("ml_dtypes")
+
+
+def _reference_snapshot(path) -> dict:
+    state = {
+        "model": {
+            "w": np.random.default_rng(0).standard_normal((8, 4)).astype(
+                np.float32
+            ),
+            "emb": np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16),
+        },
+        "progress": {"step": 7, "tag": "run-a"},
+    }
+    write_reference_snapshot(str(path), state)
+    return state
+
+
+def test_convert_then_native_restore_and_fsck(tmp_path):
+    src = tmp_path / "old"
+    dst = tmp_path / "new"
+    state = _reference_snapshot(src)
+
+    assert main([str(src), str(dst), "--verify"]) == 0
+
+    # Native restore of the converted snapshot.
+    dest = {
+        "model": ts.PyTreeState(
+            {
+                "w": np.zeros((8, 4), np.float32),
+                "emb": np.zeros(16, ml_dtypes.bfloat16),
+            }
+        ),
+        "progress": ts.PyTreeState({"step": 0, "tag": ""}),
+    }
+    ts.Snapshot(str(dst)).restore(dest)
+    np.testing.assert_array_equal(dest["model"].tree["w"], state["model"]["w"])
+    np.testing.assert_array_equal(
+        np.asarray(dest["model"].tree["emb"]).view(np.uint16),
+        state["model"]["emb"].view(np.uint16),
+    )
+    assert dest["progress"].tree["step"] == 7
+    assert dest["progress"].tree["tag"] == "run-a"
+
+    # Native deep fsck accepts it.
+    from torchsnapshot_tpu.fsck import verify_snapshot
+
+    report = verify_snapshot(str(dst), deep=True)
+    assert not report.problems
+
+    # The converted snapshot is a valid incremental base: an unchanged
+    # next take chains off it and rewrites (next to) nothing.
+    nxt = tmp_path / "next"
+    ts.Snapshot.take(
+        str(nxt),
+        {
+            "model": ts.PyTreeState(
+                {
+                    "w": state["model"]["w"],
+                    "emb": state["model"]["emb"],
+                }
+            ),
+            "progress": ts.PyTreeState({"step": 7, "tag": "run-a"}),
+        },
+        incremental_base=str(dst),
+    )
+    next_report = verify_snapshot(str(nxt), deep=True)
+    assert not next_report.problems
+    # Chained entries use parent-ref locations into the base snapshot
+    # (manifest.py ArrayEntry.location contract) — their presence proves
+    # the converted snapshot's recorded digests made chunks skippable.
+    meta_text = (nxt / ".snapshot_metadata").read_text()
+    assert "../" in meta_text, "next take did not chain off the converted base"
+
+
+def test_verify_catches_missing_and_truncated_blobs(tmp_path):
+    src = tmp_path / "old"
+    _reference_snapshot(src)
+
+    # Truncate one blob, delete another.
+    w_blob = src / "0" / "model" / "w"
+    w_blob.write_bytes(w_blob.read_bytes()[:10])
+    (src / "0" / "model" / "emb").unlink()
+
+    reader = ReferenceSnapshotReader(str(src))
+    problems = verify_source(reader, rank=0)
+    reader.close()
+    assert any("missing blob" in p for p in problems)
+    assert any("bytes" in p and "w" in p for p in problems)
+
+    # CLI fails fast and leaves no destination commit marker.
+    dst = tmp_path / "new"
+    assert main([str(src), str(dst), "--verify"]) == 1
+    assert not (dst / ".snapshot_metadata").exists()
+
+
+def test_dropped_rank_warning(tmp_path, capsys):
+    """A multi-rank source with per-rank private state: converting rank
+    0's view must warn loudly that other ranks' entries are not carried."""
+    import yaml
+
+    src = tmp_path / "old"
+    _reference_snapshot(src)
+    # Graft a rank-1 private tensor entry into the metadata (world 2).
+    meta_path = src / ".snapshot_metadata"
+    doc = yaml.safe_load(meta_path.read_text())
+    doc["world_size"] = 2
+    blob = np.ones(4, np.float32)
+    (src / "1" / "opt").mkdir(parents=True)
+    (src / "1" / "opt" / "m").write_bytes(blob.tobytes())
+    doc["manifest"]["1/opt"] = {"type": "dict", "keys": ["m"]}
+    doc["manifest"]["1/opt/m"] = {
+        "type": "Tensor",
+        "location": "1/opt/m",
+        "serializer": "buffer_protocol",
+        "dtype": "torch.float32",
+        "shape": [4],
+        "replicated": False,
+        "byte_range": None,
+    }
+    meta_path.write_text(yaml.safe_dump(doc, sort_keys=False))
+
+    dst = tmp_path / "new"
+    assert main([str(src), str(dst), "--verify"]) == 0
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "rank 1" in err and "opt/m" in err
+    # Converting rank 1 instead carries its private state and warns
+    # about rank 0's.
+    dst1 = tmp_path / "new_rank1"
+    assert main([str(src), str(dst1), "--rank", "1"]) == 0
+    err = capsys.readouterr().err
+    assert "rank 0" in err
+    dest = {"opt": ts.PyTreeState({"m": np.zeros(4, np.float32)})}
+    ts.Snapshot(str(dst1)).restore(dest)
+    np.testing.assert_array_equal(dest["opt"].tree["m"], blob)
+
+
+def test_convert_without_verify_still_fails_cleanly(tmp_path):
+    src = tmp_path / "old"
+    _reference_snapshot(src)
+    (src / "0" / "model" / "w").unlink()
+    dst = tmp_path / "new"
+    with pytest.raises(FileNotFoundError):
+        convert(str(src), str(dst))
+    assert not (dst / ".snapshot_metadata").exists()
