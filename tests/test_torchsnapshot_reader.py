@@ -320,6 +320,33 @@ def test_reference_library_interop(tmp_path):
     assert state["progress"]["step"] == 17
 
 
+def test_reference_library_interop_hostile_keys(tmp_path):
+    """Keys containing the path separator, percent signs, and int keys —
+    the percent-encoding corners (reference flatten.py:204-211) — written
+    by the actual reference library, decoded by our reader."""
+    torch = pytest.importorskip("torch")
+    torchsnapshot = import_reference()
+
+    hostile = {
+        "a/b": torch.ones(2),
+        "100%": "percent",
+        "%2F": "encoded-looking",
+        7: torch.zeros(3),
+        "plain": {"x/y%z": 1},
+    }
+    app_state = {"s": torchsnapshot.StateDict(**{"outer": hostile})}
+    snap = str(tmp_path / "hostile")
+    torchsnapshot.Snapshot.take(snap, app_state)
+
+    state = read_reference_snapshot(snap)
+    outer = state["s"]["outer"]
+    np.testing.assert_array_equal(outer["a/b"], np.ones(2, np.float32))
+    assert outer["100%"] == "percent"
+    assert outer["%2F"] == "encoded-looking"
+    np.testing.assert_array_equal(outer[7], np.zeros(3, np.float32))
+    assert outer["plain"] == {"x/y%z": 1}
+
+
 def test_reference_library_interop_chunked_and_batched(tmp_path):
     torch = pytest.importorskip("torch")
     torchsnapshot = import_reference()
