@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import base64
 import io
+import math
 import struct
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
@@ -311,6 +312,26 @@ class ReferenceSnapshotReader:
         shape = tuple(entry["shape"])
         if serializer == "buffer_protocol":
             dtype = _np_dtype(entry["dtype"])
+            need = dtype.itemsize * math.prod(int(d) for d in shape)
+            if len(data) != need:
+                hint = ""
+                if len(data) == 0 and shape == () and entry["dtype"] == (
+                    "torch.bfloat16"
+                ):
+                    # Reference bug, verified against it directly: its 0-d
+                    # bf16 zero-copy path (serialization.py:216-233) writes
+                    # an EMPTY blob, and its own restore fails on it too —
+                    # the value was destroyed at save time.
+                    hint = (
+                        " (known reference bug: 0-d bfloat16 tensors are "
+                        "saved as empty blobs and are unrecoverable — the "
+                        "reference's own restore fails on them as well)"
+                    )
+                raise ValueError(
+                    f"blob {entry['location']!r} holds {len(data)} bytes "
+                    f"but entry dtype={entry['dtype']} shape={list(shape)} "
+                    f"needs {need}{hint}"
+                )
             # Zero-copy over the read buffer (read-only is fine: consumers
             # copy on device_put / window assignment).
             arr = np.frombuffer(data, dtype=dtype)
