@@ -125,6 +125,47 @@ def _primitive_value(entry: Dict[str, Any]) -> Any:
     raise ValueError(f"not a primitive entry type: {kind!r}")
 
 
+def _entry_boxes(entry: Dict[str, Any]):
+    """Normalize a tensor-bearing entry into
+    ``([(offsets, sizes, tensor_entry)], global_shape, np_dtype)``.
+
+    For ShardedTensor the global shape is the shard envelope (the entry
+    records no global shape); ChunkedTensor and Tensor declare theirs.
+    One definition shared by the dense ``_assemble`` path and
+    ``read_sharded``, so envelope/dtype inference cannot diverge."""
+    kind = entry.get("type")
+    if kind == "Tensor":
+        shape = tuple(int(d) for d in entry["shape"])
+        return (
+            [(tuple(0 for _ in shape), shape, entry)],
+            shape,
+            _np_dtype(entry["dtype"]),
+        )
+    if kind in ("ShardedTensor", "ChunkedTensor"):
+        raw = entry["shards"] if kind == "ShardedTensor" else entry["chunks"]
+        if not raw:
+            raise ValueError("entry has no shards/chunks")
+        boxes = [
+            (
+                tuple(int(o) for o in b["offsets"]),
+                tuple(int(s) for s in b["sizes"]),
+                b["tensor"],
+            )
+            for b in raw
+        ]
+        if kind == "ChunkedTensor":
+            shape = tuple(int(d) for d in entry["shape"])
+            dtype = _np_dtype(entry["dtype"])
+        else:
+            ndim = len(boxes[0][0])
+            shape = tuple(
+                max(o[d] + s[d] for o, s, _ in boxes) for d in range(ndim)
+            )
+            dtype = _np_dtype(boxes[0][2]["dtype"])
+        return boxes, shape, dtype
+    raise ValueError(f"entry type {kind!r} is not a tensor entry")
+
+
 class ReferenceSnapshotReader:
     """Random and bulk access to a reference-format snapshot.
 
@@ -266,6 +307,125 @@ class ReferenceSnapshotReader:
         }
         return self._inflate(manifest, leaves)
 
+    def read_sharded(
+        self,
+        path: str,
+        sharding: Any,
+        rank: Optional[int] = None,
+        global_shape: Optional[Tuple[int, ...]] = None,
+    ) -> Any:
+        """Place one tensor entry directly into a sharded ``jax.Array``.
+
+        The TPU-native migration path for large sharded state (old FSDP /
+        model-parallel checkpoints): each addressable device's shard box
+        is assembled from only the persisted shards overlapping it (the
+        same N-d box algebra the native resharding restore uses,
+        ``parallel/overlap.py``), so the full array is never materialized
+        on the host — peak host memory is one device shard plus the
+        overlapping source pieces. Accepts ``Tensor``, ``ShardedTensor``
+        and ``ChunkedTensor`` entries; any ``jax.sharding.Sharding`` for
+        an N-d layout works, including layouts different from the one the
+        checkpoint was saved under (resharding-on-read).
+
+        ``global_shape``: pass the expected full shape when known. A
+        ``ShardedTensor`` entry records no global shape — it is inferred
+        as the shard envelope — so a snapshot missing its TAIL shards
+        would silently infer a smaller array; an explicit shape turns
+        that into a loud shard-coverage error.
+        """
+        import jax
+
+        from ..parallel.overlap import Box, box_overlap
+
+        if rank is None:
+            rank_str, _, logical = path.partition("/")
+            rank = int(rank_str)
+        else:
+            logical = path
+        manifest = self.manifest_for_rank(rank)
+        if logical not in manifest:
+            raise KeyError(f"{logical!r} not in the rank-{rank} manifest")
+        raw_boxes, shape, dtype = _entry_boxes(manifest[logical])
+        # Dedup identical persisted boxes (a DP-replicated checkpoint can
+        # record the same shard box from several ranks).
+        seen = set()
+        boxes = []
+        for offsets, sizes, tentry in raw_boxes:
+            if (offsets, sizes) not in seen:
+                seen.add((offsets, sizes))
+                boxes.append((Box(offsets, sizes), tentry))
+        if global_shape is not None:
+            global_shape = tuple(int(d) for d in global_shape)
+            if len(global_shape) != len(shape) or any(
+                g < s for g, s in zip(global_shape, shape)
+            ):
+                raise ValueError(
+                    f"{logical!r}: global_shape {global_shape} is "
+                    f"incompatible with the persisted extent {shape}"
+                )
+            shape = global_shape
+
+        # Group devices by destination box: replicated / partially-
+        # replicated layouts assemble each distinct box once and
+        # device_put the same host array to every device sharing it.
+        groups: Dict[Tuple, List[Any]] = {}
+        for device, index in sharding.addressable_devices_indices_map(
+            shape
+        ).items():
+            dst_box = Box.from_index(index, shape)
+            groups.setdefault((dst_box.offsets, dst_box.sizes), []).append(
+                device
+            )
+
+        # Plan overlaps up front so each source piece knows how many
+        # groups still need it — pieces are evicted at zero, keeping
+        # peak host memory at one assembled box + its live sources
+        # (NOT the whole array).
+        plans = {}
+        uses = dict.fromkeys(range(len(boxes)), 0)
+        for key in groups:
+            dst_box = Box(*key)
+            plan = []
+            for i, (sbox, _) in enumerate(boxes):
+                ov = box_overlap(sbox, dst_box)
+                if ov is not None:
+                    plan.append((i, ov))
+                    uses[i] += 1
+            plans[key] = plan
+
+        pieces: Dict[int, Any] = {}  # box index -> loaded source ndarray
+
+        def _piece(i: int):
+            if i not in pieces:
+                box, tentry = boxes[i]
+                pieces[i] = self._read_tensor(tentry).reshape(box.sizes)
+            return pieces[i]
+
+        shards = []
+        for key, devices in groups.items():
+            dst_box = Box(*key)
+            local = np.zeros(dst_box.sizes, dtype=dtype)
+            covered = np.zeros(dst_box.sizes, dtype=bool)
+            for i, ov in plans[key]:
+                local[ov.dst_slices] = _piece(i)[ov.src_slices]
+                covered[ov.dst_slices] = True
+                uses[i] -= 1
+                if uses[i] == 0:
+                    pieces.pop(i, None)
+            if not covered.all():
+                raise ValueError(
+                    f"{logical!r}: persisted shards cover only "
+                    f"{int(covered.sum())} of {dst_box.numel()} elements of "
+                    f"a destination shard — the snapshot's shard set has "
+                    f"holes"
+                )
+            del covered
+            for device in devices:
+                shards.append(jax.device_put(local, device))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, shards
+        )
+
     # -- internals -----------------------------------------------------
 
     def _read_blob(
@@ -291,14 +451,8 @@ class ReferenceSnapshotReader:
             return _primitive_value(entry)
         if kind == "Tensor":
             return self._read_tensor(entry)
-        if kind == "ShardedTensor":
-            return self._assemble(entry["shards"], dtype=None, shape=None)
-        if kind == "ChunkedTensor":
-            return self._assemble(
-                entry["chunks"],
-                dtype=_np_dtype(entry["dtype"]),
-                shape=tuple(entry["shape"]),
-            )
+        if kind in ("ShardedTensor", "ChunkedTensor"):
+            return self._assemble(entry)
         if kind == "object":
             return self._read_torch_object(entry)
         raise ValueError(f"cannot materialize entry type {kind!r}")
@@ -346,31 +500,16 @@ class ReferenceSnapshotReader:
             f"serialization.py:148-159)"
         )
 
-    def _assemble(
-        self,
-        boxes: List[Dict[str, Any]],
-        dtype: Optional[np.dtype],
-        shape: Optional[Tuple[int, ...]],
-    ) -> np.ndarray:
-        """Assemble shard/chunk boxes (offsets + sizes + tensor entry)
-        into one dense array. For ShardedTensor the global shape is the
-        envelope of the boxes (the entry does not record it)."""
-        if not boxes:
-            raise ValueError("entry has no shards/chunks")
-        if shape is None:
-            ndim = len(boxes[0]["offsets"])
-            shape = tuple(
-                max(b["offsets"][d] + b["sizes"][d] for b in boxes)
-                for d in range(ndim)
-            )
-        if dtype is None:
-            dtype = _np_dtype(boxes[0]["tensor"]["dtype"])
+    def _assemble(self, entry: Dict[str, Any]) -> np.ndarray:
+        """Assemble a sharded/chunked entry's boxes into one dense
+        array (full host materialization — ``read_sharded`` is the
+        bounded-memory alternative)."""
+        boxes, shape, dtype = _entry_boxes(entry)
         out = np.zeros(shape, dtype=dtype)
-        for box in boxes:
-            piece = self._read_tensor(box["tensor"])
-            piece = piece.reshape(tuple(box["sizes"]))
+        for offsets, sizes, tentry in boxes:
+            piece = self._read_tensor(tentry).reshape(sizes)
             window = tuple(
-                slice(o, o + s) for o, s in zip(box["offsets"], box["sizes"])
+                slice(o, o + s) for o, s in zip(offsets, sizes)
             )
             out[window] = piece
         return out
