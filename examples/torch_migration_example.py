@@ -65,6 +65,45 @@ def main() -> None:
     print("torch -> jax migration verified; step =",
           ts.Snapshot(path).read_object("0/progress/step"))
 
+    # ---- Phase 0 (retroactive): EXISTING reference-format checkpoints ----
+    # Checkpoints written by the reference library itself load directly
+    # (tricks.torchsnapshot_reader) or convert once to the native format
+    # (tricks.convert). Demonstrated here with a reference-format
+    # snapshot produced by the export bridge, so the example is
+    # self-contained; a real torchsnapshot-written directory reads the
+    # same way.
+    from torchsnapshot_tpu.tricks.convert import main as convert_main
+    from torchsnapshot_tpu.tricks.torchsnapshot_reader import (
+        read_reference_snapshot,
+    )
+    from torchsnapshot_tpu.tricks.torchsnapshot_writer import (
+        write_reference_snapshot,
+    )
+
+    old_ckpt = os.path.join(work_dir, "reference_format")
+    write_reference_snapshot(
+        old_ckpt,
+        {
+            "model": {"w": model[0].weight.detach().numpy()},
+            "progress": {"step": 100},
+        },
+    )
+    old_state = read_reference_snapshot(old_ckpt)
+    np.testing.assert_array_equal(
+        old_state["model"]["w"], model[0].weight.detach().numpy()
+    )
+    native_ckpt = os.path.join(work_dir, "converted_native")
+    assert convert_main([old_ckpt, native_ckpt, "--verify"]) == 0
+    print("reference-format checkpoint read + converted to native format")
+
+    # ---- Phase 3 (escape hatch): export back to the reference format ----
+    # Anything exported this way restores through the actual reference
+    # library (torchsnapshot.Snapshot(path).restore) — see
+    # docs/migration.md.
+    export = os.path.join(work_dir, "export_for_torch")
+    write_reference_snapshot(export, {"model": {"w": jax_params["layer0"]["w"]}})
+    print(f"jax state exported for torch tooling at {export}")
+
 
 if __name__ == "__main__":
     main()

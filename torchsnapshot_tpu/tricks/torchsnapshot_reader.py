@@ -365,17 +365,15 @@ class ReferenceSnapshotReader:
                 )
             shape = global_shape
 
-        # Group devices by destination box: replicated / partially-
-        # replicated layouts assemble each distinct box once and
-        # device_put the same host array to every device sharing it.
-        groups: Dict[Tuple, List[Any]] = {}
+        # Group devices by destination box (Box is a frozen, hashable
+        # dataclass): replicated / partially-replicated layouts assemble
+        # each distinct box once and place the same host array on every
+        # device sharing it.
+        groups: Dict[Any, List[Any]] = {}
         for device, index in sharding.addressable_devices_indices_map(
             shape
         ).items():
-            dst_box = Box.from_index(index, shape)
-            groups.setdefault((dst_box.offsets, dst_box.sizes), []).append(
-                device
-            )
+            groups.setdefault(Box.from_index(index, shape), []).append(device)
 
         # Plan overlaps up front so each source piece knows how many
         # groups still need it — pieces are evicted at zero, keeping
@@ -383,15 +381,14 @@ class ReferenceSnapshotReader:
         # (NOT the whole array).
         plans = {}
         uses = dict.fromkeys(range(len(boxes)), 0)
-        for key in groups:
-            dst_box = Box(*key)
+        for dst_box in groups:
             plan = []
             for i, (sbox, _) in enumerate(boxes):
                 ov = box_overlap(sbox, dst_box)
                 if ov is not None:
                     plan.append((i, ov))
                     uses[i] += 1
-            plans[key] = plan
+            plans[dst_box] = plan
 
         pieces: Dict[int, Any] = {}  # box index -> loaded source ndarray
 
@@ -401,12 +398,12 @@ class ReferenceSnapshotReader:
                 pieces[i] = self._read_tensor(tentry).reshape(box.sizes)
             return pieces[i]
 
-        shards = []
-        for key, devices in groups.items():
-            dst_box = Box(*key)
+        host_arrays = []
+        put_devices = []
+        for dst_box, devices in groups.items():
             local = np.zeros(dst_box.sizes, dtype=dtype)
             covered = np.zeros(dst_box.sizes, dtype=bool)
-            for i, ov in plans[key]:
+            for i, ov in plans[dst_box]:
                 local[ov.dst_slices] = _piece(i)[ov.src_slices]
                 covered[ov.dst_slices] = True
                 uses[i] -= 1
@@ -421,7 +418,12 @@ class ReferenceSnapshotReader:
                 )
             del covered
             for device in devices:
-                shards.append(jax.device_put(local, device))
+                host_arrays.append(local)
+                put_devices.append(device)
+        # One batched transfer: a per-device device_put loop pays the
+        # dispatch latency N times over (the native restore's batching
+        # rationale, sharded_io_preparer.py).
+        shards = jax.device_put(host_arrays, put_devices)
         return jax.make_array_from_single_device_arrays(
             shape, sharding, shards
         )
