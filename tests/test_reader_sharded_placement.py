@@ -136,6 +136,44 @@ def test_holes_are_detected(tmp_path):
 
 
 @require_8_devices
+def test_row_contiguous_overlaps_use_ranged_reads(snapshot_8x4, monkeypatch):
+    """Dim-0 resharding (the FSDP case): each device's rows must arrive
+    via a ranged read of just those rows — never a whole-shard blob
+    read."""
+    path, full = snapshot_8x4
+    reader = ReferenceSnapshotReader(str(path))
+    reads = []
+    orig = ReferenceSnapshotReader._read_blobs
+
+    def spy(self, requests):
+        reads.extend(
+            (loc, br) for loc, br in requests if loc != ".snapshot_metadata"
+        )
+        return orig(self, requests)
+
+    monkeypatch.setattr(ReferenceSnapshotReader, "_read_blobs", spy)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("x",))
+    arr = reader.read_sharded("0/sh/emb", NamedSharding(mesh, P("x", None)))
+    np.testing.assert_array_equal(np.asarray(arr), full)
+    assert reads, "no blob reads recorded"
+    row_bytes = 4 * 4  # 4 cols x float32
+    for location, byte_range in reads:
+        assert byte_range is not None, f"whole-blob read of {location}"
+        start, end = byte_range
+        assert end - start == row_bytes, (location, byte_range)
+
+    # Column sharding: overlaps are not row slabs -> falls back to whole
+    # source pieces, still correct.
+    reads.clear()
+    col_mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    col = reader.read_sharded(
+        "0/sh/emb", NamedSharding(col_mesh, P(None, "x"))
+    )
+    np.testing.assert_array_equal(np.asarray(col), full)
+    assert any(br is None for _, br in reads), "expected full-piece reads"
+
+
+@require_8_devices
 def test_duplicate_saved_shards_cannot_mask_holes(tmp_path):
     """Two ranks recording the SAME shard box (DP-replicated saves) must
     not double-count coverage: with a real hole in rows 4-8, a summed
