@@ -50,6 +50,23 @@ def test_write_read_roundtrip(plugin) -> None:
     _run(go())
 
 
+def test_ranged_read_past_eof_is_an_error(plugin) -> None:
+    """Both plugins share the contract: a ranged read past the end of a
+    blob is corruption (the manifest promised bytes that aren't there),
+    never a silent partial result."""
+
+    async def go():
+        await plugin.write(WriteIO(path="short", buf=b"0123456789"))
+        with pytest.raises(OSError) as exc_info:
+            await plugin.read(ReadIO(path="short", byte_range=(4, 64)))
+        import errno
+
+        assert exc_info.value.errno == errno.EIO
+        await plugin.close()
+
+    _run(go())
+
+
 def test_write_accepts_memoryview_and_bytearray(plugin) -> None:
     async def go():
         await plugin.write(WriteIO(path="mv", buf=memoryview(b"hello")))

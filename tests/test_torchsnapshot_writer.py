@@ -68,11 +68,16 @@ def test_bridge_over_non_fs_url():
     """The bridge rides the storage-plugin URL grammar: write and read a
     reference-format snapshot through the in-memory plugin (the same
     plumbing s3:// / gs:// use), not just bare filesystem paths."""
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
     url = "memory://ref_bridge_roundtrip"
     state = {"m": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}}
-    write_reference_snapshot(url, state)
-    back = read_reference_snapshot(url)
-    np.testing.assert_array_equal(back["m"]["w"], state["m"]["w"])
+    try:
+        write_reference_snapshot(url, state)
+        back = read_reference_snapshot(url)
+        np.testing.assert_array_equal(back["m"]["w"], state["m"]["w"])
+    finally:
+        MemoryStoragePlugin.drop_store("ref_bridge_roundtrip")
 
 
 def test_unrepresentable_dtype_rejected(tmp_path):

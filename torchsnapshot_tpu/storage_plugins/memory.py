@@ -9,6 +9,7 @@ named stores lets a writer and a reader in the same process share contents.
 from __future__ import annotations
 
 import asyncio
+import errno
 from typing import Dict
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
@@ -31,6 +32,16 @@ class MemoryStoragePlugin(StoragePlugin):
         data = self._blobs[read_io.path]
         if read_io.byte_range is not None:
             start, end = read_io.byte_range
+            if start < 0 or start > end or end > len(data):
+                # FS-plugin contract (EIO, matching its native pread
+                # path): a ranged read outside the blob is corruption,
+                # not a partial success.
+                raise OSError(
+                    errno.EIO,
+                    f"ranged read [{start}, {end}) invalid for "
+                    f"{len(data)}-byte blob",
+                    read_io.path,
+                )
             data = data[start:end]
         read_io.buf = memoryview(data)
         await asyncio.sleep(0)
