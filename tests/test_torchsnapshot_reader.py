@@ -206,6 +206,39 @@ def test_read_object_paths(hand_built):
         reader.read_object("0/app/nope")
 
 
+def test_buffer_protocol_snapshot_reads_without_torch(hand_built):
+    """The module's promise: buffer_protocol entries decode with numpy
+    alone. Pin it by reading the full fixture (which has no torch_save
+    entries) in a subprocess where importing torch is poisoned."""
+    import subprocess
+    import sys
+
+    path, _ = hand_built
+    code = f"""
+import sys
+sys.modules["torch"] = None  # any torch import now raises ImportError
+from torchsnapshot_tpu.tricks.torchsnapshot_reader import (
+    read_reference_snapshot,
+)
+state = read_reference_snapshot({str(path)!r})
+assert state["app"]["weights"].shape == (3, 4)
+assert state["app"]["n"] == -42
+print("NO-TORCH OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "NO-TORCH OK" in proc.stdout
+
+
 def test_torch_save_entries(tmp_path):
     torch = pytest.importorskip("torch")
     t = torch.arange(12, dtype=torch.float64).reshape(3, 4)
