@@ -94,3 +94,32 @@ def test_no_records_and_no_block_is_clean(tmp_path):
     mod = _load_tool("check_bench_docs.py")
     (tmp_path / "BENCH.md").write_text("# bench\nno block here\n")
     assert mod.main(root=tmp_path) == 0
+
+
+def test_tiered_tests_are_lane_correct(capsys):
+    """The tiered crash/latency tests must reach the default
+    -m 'not slow' lane, with the end-to-end sweep marked slow."""
+    rc = _run_tool("check_tiered_markers.py")
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_tiered_marker_check_catches_lane_drift(tmp_path):
+    mod = _load_tool("check_tiered_markers.py")
+    bad = tmp_path / "test_tiered.py"
+    bad.write_text(
+        "import pytest\n"
+        "def test_slow_end_to_end_sweep():\n    pass\n"
+    )
+    errors = mod.check(bad)
+    assert any("end-to-end" in e for e in errors)
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.slow\n"
+        "@pytest.mark.slow\ndef test_only():\n    pass\n"
+    )
+    errors = mod.check(bad)
+    assert any("pytestmark" in e for e in errors)
+    assert any("every test is marked slow" in e for e in errors)
+    assert mod.check(tmp_path / "absent.py") == [
+        "absent.py: missing (tiered tests are tier-1 signal)"
+    ]

@@ -161,3 +161,29 @@ def test_convert_without_verify_still_fails_cleanly(tmp_path):
     with pytest.raises(FileNotFoundError):
         convert(str(src), str(dst))
     assert not (dst / ".snapshot_metadata").exists()
+
+
+def test_verify_reports_unreadable_blobs_instead_of_crashing(tmp_path):
+    """Backend errors that are neither FileNotFoundError nor the
+    normalized OSError(EIO) truncation contract (e.g. an object store's
+    auth/throttle exception escaping retries) must land in the problem
+    list the caller was promised — not crash verify_source."""
+    src = tmp_path / "old"
+    _reference_snapshot(src)
+
+    class _Boom(Exception):
+        pass
+
+    reader = ReferenceSnapshotReader(str(src))
+    try:
+        reader.metadata  # manifest loads fine; only blob probes explode
+
+        def _raise(location, byte_range):
+            raise _Boom("backend exploded")
+
+        reader._read_blob = _raise
+        problems = verify_source(reader, rank=0)
+    finally:
+        reader.close()
+    assert problems
+    assert all("unreadable" in p and "_Boom" in p for p in problems)

@@ -475,3 +475,60 @@ def test_reference_library_interop_chunked_and_batched(tmp_path):
     np.testing.assert_array_equal(state["s"]["big"], big.numpy())
     for i, t in enumerate(small):
         np.testing.assert_array_equal(state["s"][f"small{i}"], t.numpy())
+
+
+def test_assemble_raises_on_shard_coverage_holes(tmp_path):
+    """A sharded entry with an interior hole must raise, not silently
+    zero-fill (read_sharded's covered-mask contract, applied to the
+    dense _assemble path convert.py reads through)."""
+    full = np.arange(16, dtype=np.float32).reshape(4, 4)
+    _write(tmp_path / "s0", full[:1].tobytes())
+    _write(tmp_path / "s3", full[3:].tobytes())  # rows 1-2 missing
+    manifest = {
+        "0/m": {"type": "dict", "keys": ["emb"]},
+        "0/m/emb": {"type": "ShardedTensor", "shards": [
+            _box((0, 0), (1, 4), _tensor_entry("s0", "torch.float32", (1, 4))),
+            _box((3, 0), (1, 4), _tensor_entry("s3", "torch.float32", (1, 4))),
+        ]},
+    }
+    doc = {"version": "0.0.3", "world_size": 1, "manifest": manifest}
+    (tmp_path / ".snapshot_metadata").write_text(
+        yaml.safe_dump(doc, sort_keys=False)
+    )
+    reader = ReferenceSnapshotReader(str(tmp_path))
+    with reader:
+        with pytest.raises(ValueError, match="holes"):
+            reader.read_object("0/m/emb")
+
+
+def test_read_blobs_surfaces_unfilled_buffer_explicitly(tmp_path):
+    """A plugin completing read() without populating buf must raise a
+    named RuntimeError (an assert would vanish under python -O)."""
+    arr = np.ones(4, dtype=np.float32)
+    _write(tmp_path / "0/m/w", arr.tobytes())
+    manifest = {
+        "0/m": {"type": "dict", "keys": ["w"]},
+        "0/m/w": _tensor_entry("0/m/w", "torch.float32", (4,)),
+    }
+    doc = {"version": "0.0.3", "world_size": 1, "manifest": manifest}
+    (tmp_path / ".snapshot_metadata").write_text(
+        yaml.safe_dump(doc, sort_keys=False)
+    )
+
+    class _NoFill:
+        async def read(self, read_io):
+            pass  # never sets read_io.buf
+
+        async def close(self):
+            pass
+
+    reader = ReferenceSnapshotReader(str(tmp_path))
+    try:
+        import asyncio
+
+        reader._loop = asyncio.new_event_loop()
+        reader._storage = _NoFill()
+        with pytest.raises(RuntimeError, match="_NoFill.*without populating"):
+            reader._read_blobs([("0/m/w", None)])
+    finally:
+        reader.close()

@@ -152,3 +152,27 @@ def test_reference_library_reads_complex_and_objects(tmp_path):
     torchsnapshot.Snapshot(snap).restore(dest)
     np.testing.assert_array_equal(dest["s"]["z"].numpy(), cplx)
     assert dest["s"]["o"] == opaque
+
+
+def test_big_endian_arrays_normalized_before_serialization(tmp_path):
+    """A '>f4' array (dtype.name is still 'float32') must round-trip
+    value-exact: the reference format is raw LITTLE-endian bytes, so the
+    writer normalizes byte order before tobytes()."""
+    big = np.arange(12, dtype=np.float32).astype(">f4").reshape(3, 4)
+    big_i = np.array([1, -2, 3], dtype=">i8")
+    snap = str(tmp_path / "snap")
+    write_reference_snapshot(snap, {"m": {"w": big, "i": big_i}})
+    back = read_reference_snapshot(snap)
+    np.testing.assert_array_equal(back["m"]["w"], big.astype("<f4"))
+    np.testing.assert_array_equal(back["m"]["i"], big_i.astype("<i8"))
+    assert float(back["m"]["w"][1, 2]) == 6.0  # not byte-swapped garbage
+
+
+def test_big_endian_complex_normalized_on_torch_save_path(tmp_path):
+    torch = pytest.importorskip("torch")
+    del torch
+    big_c = (np.arange(4) + 1j * np.arange(4)).astype(">c8")
+    snap = str(tmp_path / "snapc")
+    write_reference_snapshot(snap, {"m": {"c": big_c}})
+    back = read_reference_snapshot(snap)
+    np.testing.assert_array_equal(back["m"]["c"], big_c.astype("<c8"))

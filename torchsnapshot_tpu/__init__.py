@@ -23,12 +23,15 @@ from .rng_state import RngState, RNGState
 from .snapshot import PendingRestore, PendingSnapshot, Snapshot
 from .state_dict import PyTreeState, StateDict
 from .stateful import AppState, Stateful
+from .tiered import Mirror, TieredStoragePlugin
 from .version import __version__
 
 __all__ = [
     "AppState",
     "CheckpointManager",
     "FsckReport",
+    "Mirror",
+    "TieredStoragePlugin",
     "PendingRestore",
     "PendingSnapshot",
     "PreemptionSaver",

@@ -45,7 +45,7 @@ from .manifest import (
 )
 from .serialization import array_size_bytes
 from .snapshot import SNAPSHOT_METADATA_FNAME
-from .storage_plugin import url_to_storage_plugin
+from .storage_plugin import split_tiered_url, url_to_storage_plugin
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -53,7 +53,7 @@ logger: logging.Logger = logging.getLogger(__name__)
 @dataclasses.dataclass
 class FsckProblem:
     location: str
-    kind: str  # missing | truncated | checksum | unreadable
+    kind: str  # missing | truncated | checksum | unreadable | unmirrored
     detail: str
 
 
@@ -255,15 +255,61 @@ async def _check_blob(
         )
 
 
-def verify_snapshot(path: str, deep: bool = False) -> FsckReport:
+def _describe_partial_mirror(
+    tiered_path: str, event_loop: asyncio.AbstractEventLoop
+) -> Optional[str]:
+    """For a tiered snapshot whose DURABLE tier lacks the commit marker:
+    a mirror-in-progress description from the fast tier's journal, or
+    None when no journal exists (nothing was ever committed, or the
+    mirror never started)."""
+    tiers = split_tiered_url(tiered_path)
+    if tiers is None:
+        return None
+    from .tiered.journal import MirrorJournal
+
+    fast_url, _ = tiers
+    fast = url_to_storage_plugin(fast_url)
+    try:
+        journal = event_loop.run_until_complete(MirrorJournal.load(fast))
+    finally:
+        event_loop.run_until_complete(fast.close())
+    if journal is None:
+        return None
+    total = len(journal.blobs)
+    return (
+        f"mirror in progress: {len(journal.done)} of {total} blobs "
+        f"durable (journal in the fast tier resumes the upload)"
+    )
+
+
+def verify_snapshot(
+    path: str, deep: bool = False, tier: Optional[str] = None
+) -> FsckReport:
     """Audit one committed snapshot. Never raises for snapshot damage —
     every problem lands in the report; raises only for programmer error
     (e.g. a path that is not a snapshot *directory* at all still yields
-    a report with the metadata problem recorded)."""
+    a report with the metadata problem recorded).
+
+    ``tier`` (tiered:// paths only) restricts the audit to one tier:
+    ``"fast"`` or ``"durable"``. The default audits the composed view
+    (reads fall back per blob, exactly as restore would resolve them).
+    Auditing the durable tier of a partially-mirrored step reports an
+    ``unmirrored`` problem with the journal's progress instead of a bare
+    missing-commit-marker."""
+    audit_path = path
+    if tier is not None:
+        tiers = split_tiered_url(path)
+        if tiers is None:
+            raise ValueError(
+                f"tier={tier!r} requires a tiered:// path, got {path!r}"
+            )
+        if tier not in ("fast", "durable"):
+            raise ValueError(f"tier must be 'fast' or 'durable', got {tier!r}")
+        audit_path = tiers[0] if tier == "fast" else tiers[1]
     problems: List[FsckProblem] = []
     event_loop = asyncio.new_event_loop()
     try:
-        storage = url_to_storage_plugin(path)
+        storage = url_to_storage_plugin(audit_path)
         try:
             read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
             try:
@@ -272,13 +318,23 @@ def verify_snapshot(path: str, deep: bool = False) -> FsckReport:
                     bytes(read_io.buf).decode("utf-8")
                 )
             except FileNotFoundError:
-                problems.append(
-                    FsckProblem(
-                        SNAPSHOT_METADATA_FNAME,
-                        "missing",
-                        "no commit marker: not a committed snapshot",
+                partial = None
+                if tier == "durable":
+                    partial = _describe_partial_mirror(path, event_loop)
+                if partial is not None:
+                    problems.append(
+                        FsckProblem(
+                            SNAPSHOT_METADATA_FNAME, "unmirrored", partial
+                        )
                     )
-                )
+                else:
+                    problems.append(
+                        FsckProblem(
+                            SNAPSHOT_METADATA_FNAME,
+                            "missing",
+                            "no commit marker: not a committed snapshot",
+                        )
+                    )
                 return FsckReport(path, 0, 0, problems, deep)
             except Exception as e:  # noqa: BLE001
                 problems.append(
@@ -345,8 +401,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="read every blob fully and verify recorded CRCs",
     )
+    p.add_argument(
+        "--tier",
+        choices=("fast", "durable"),
+        default=None,
+        help="for tiered:// paths: audit only this tier (default: the "
+        "composed view with per-blob durable fallback)",
+    )
     args = p.parse_args(argv)
-    report = verify_snapshot(args.path, deep=args.deep)
+    report = verify_snapshot(args.path, deep=args.deep, tier=args.tier)
     for prob in report.problems:
         print(f"FSCK {prob.kind}: {prob.location}: {prob.detail}")
     mode = "deep" if report.deep else "shallow"

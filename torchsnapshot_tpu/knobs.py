@@ -26,6 +26,10 @@ _S3_ENDPOINT_URL_ENV = "TORCHSNAPSHOT_TPU_S3_ENDPOINT"
 _INCREMENTAL_CHUNK_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_INCREMENTAL_CHUNK_BYTES"
 _DEVICE_PACK_ENV = "TORCHSNAPSHOT_TPU_DEVICE_PACK"
 _RESTORE_FLUSH_BYTES_ENV = "TORCHSNAPSHOT_TPU_RESTORE_PLACEMENT_FLUSH_BYTES"
+_MIRROR_IO_CONCURRENCY_ENV = "TORCHSNAPSHOT_TPU_MIRROR_IO_CONCURRENCY"
+_MIRROR_PROGRESS_WINDOW_ENV = (
+    "TORCHSNAPSHOT_TPU_MIRROR_PROGRESS_WINDOW_SECONDS"
+)
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -118,6 +122,29 @@ def get_incremental_chunk_size_bytes() -> int:
     )
 
 
+def get_mirror_io_concurrency() -> int:
+    """Max concurrent blob uploads inside the tiered-storage background
+    mirror. Defaults to the per-rank I/O concurrency: the mirror contends
+    with the next take's fast-tier writes, not with the take's durable
+    writes (those no longer exist), so the same bound applies."""
+    val = os.environ.get(_MIRROR_IO_CONCURRENCY_ENV)
+    if val is not None:
+        return int(val)
+    return get_per_rank_io_concurrency()
+
+
+def get_mirror_progress_window_seconds() -> float:
+    """Collective-progress retry window for the tiered mirror's durable
+    uploads (storage_plugins/retry.py semantics: any completed upload
+    refreshes the shared deadline)."""
+    val = os.environ.get(_MIRROR_PROGRESS_WINDOW_ENV)
+    if val is not None:
+        return float(val)
+    from .storage_plugins.retry import DEFAULT_PROGRESS_WINDOW_SECONDS
+
+    return DEFAULT_PROGRESS_WINDOW_SECONDS
+
+
 def get_restore_placement_flush_bytes() -> int:
     """Streaming-restore flush granularity: once this many bytes of leaves
     have completed their reads, their device placements flush as one
@@ -199,4 +226,18 @@ def override_restore_placement_flush_bytes(
     nbytes: int,
 ) -> Generator[None, None, None]:
     with _override_env(_RESTORE_FLUSH_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_mirror_io_concurrency(n: int) -> Generator[None, None, None]:
+    with _override_env(_MIRROR_IO_CONCURRENCY_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_mirror_progress_window_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_MIRROR_PROGRESS_WINDOW_ENV, str(seconds)):
         yield

@@ -52,7 +52,7 @@ from .manifest import (
 from .pg_wrapper import PGWrapper
 from .snapshot import SNAPSHOT_METADATA_FNAME, PendingSnapshot, Snapshot
 from .stateful import AppState
-from .storage_plugin import url_to_storage_plugin
+from .storage_plugin import join_path, split_tiered_url, url_to_storage_plugin
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -129,11 +129,21 @@ class CheckpointManager:
         incremental: bool = False,
         keep_best_n: Optional[int] = None,
         best_mode: str = "min",
+        keep_fast_last_n: Optional[int] = None,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
         if keep_best_n is not None and keep_best_n < 1:
             raise ValueError(f"keep_best_n must be >= 1, got {keep_best_n}")
+        if keep_fast_last_n is not None and keep_fast_last_n < 1:
+            raise ValueError(
+                f"keep_fast_last_n must be >= 1, got {keep_fast_last_n}"
+            )
+        if keep_fast_last_n is not None and split_tiered_url(root) is None:
+            raise ValueError(
+                "keep_fast_last_n requires a tiered:// root (fast-tier "
+                "eviction needs a durable tier to fall back to)"
+            )
         if best_mode not in ("min", "max"):
             raise ValueError(f"best_mode must be 'min' or 'max', got {best_mode}")
         self.root = root
@@ -146,6 +156,15 @@ class CheckpointManager:
         # (see _retained).
         self.keep_best_n = keep_best_n
         self.best_mode = best_mode
+        # Tier-aware retention (tiered:// roots only): retained steps
+        # older than the newest ``keep_fast_last_n`` are dropped from the
+        # FAST tier once durable-complete — they stay committed and
+        # restorable through the per-blob durable fallback. A step is
+        # never evicted before its durable commit marker exists, and the
+        # durable tier is only ever touched by the normal retention GC
+        # (which the index's pin logic already guards for incremental
+        # refs).
+        self.keep_fast_last_n = keep_fast_last_n
         # Default for save()/async_save(): digest-enabled takes that
         # reference the previous committed step's unchanged chunks.
         self.incremental = incremental
@@ -160,7 +179,9 @@ class CheckpointManager:
     # ------------------------------------------------------------------
 
     def step_path(self, step: int) -> str:
-        return f"{self.root.rstrip('/')}/{_step_dirname(step)}"
+        # join_path is tiered-aware: with a tiered:// root, the step
+        # segment lands on BOTH tiers' roots.
+        return join_path(self.root, _step_dirname(step))
 
     def _incremental_take_kwargs(
         self, incremental: Optional[bool], take_kwargs: Dict[str, Any]
@@ -408,6 +429,8 @@ class CheckpointManager:
         else:
             metrics.pop(str(step), None)
         pinned: Set[int] = set(index["pinned"])
+        evicted: Set[int] = set(index["evicted"])
+        evicted.discard(step)  # a re-saved step is fast-resident again
 
         retained = self._retained(steps, step, metrics)
         dropped = [s for s in steps if s not in retained]
@@ -434,10 +457,35 @@ class CheckpointManager:
         for gone in to_delete:
             refs_map.pop(str(gone), None)
             metrics.pop(str(gone), None)
+            evicted.discard(gone)
+
+        # Fast-tier eviction pass (tiered roots with keep_fast_last_n):
+        # surviving steps beyond the newest N — pinned incremental origins
+        # included — lose their fast-tier copies once durable-complete.
+        # Eviction is attempted before the index write so the recorded
+        # evicted set never claims a step this pass failed to evict.
+        if self.keep_fast_last_n is not None:
+            hot = set(steps[-self.keep_fast_last_n :])
+            hot.add(step)
+            candidates = [
+                s
+                for s in sorted(set(steps) | pinned)
+                if s not in hot and s not in evicted
+            ]
+            for old in candidates:
+                try:
+                    if await self._evict_fast_async(old):
+                        evicted.add(old)
+                except Exception as e:  # noqa: BLE001 - must not fail a save
+                    logger.warning(
+                        "Failed to evict step %d from the fast tier: %r",
+                        old,
+                        e,
+                    )
 
         await self._write_index_async(
             steps, storage, refs=refs_map, pinned=sorted(pinned),
-            metrics=metrics,
+            metrics=metrics, evicted=sorted(evicted),
         )
         for old in to_delete:
             try:
@@ -487,6 +535,9 @@ class CheckpointManager:
                         str(int(k)): float(v)
                         for k, v in raw.get("metrics", {}).items()
                     },
+                    "evicted": sorted(
+                        int(s) for s in raw.get("evicted", [])
+                    ),
                 }
             except (ValueError, KeyError, TypeError) as e:
                 logger.warning(
@@ -511,7 +562,10 @@ class CheckpointManager:
                 f"(io_failed={io_failed!r}, corrupt={corrupt!r}); "
                 "refusing to treat the step list as empty"
             )
-        return {"steps": [], "refs": {}, "pinned": [], "metrics": {}}
+        return {
+            "steps": [], "refs": {}, "pinned": [], "metrics": {},
+            "evicted": [],
+        }
 
     async def _write_index_async(
         self,
@@ -520,6 +574,7 @@ class CheckpointManager:
         refs: Optional[Dict[str, List[int]]] = None,
         pinned: Optional[List[int]] = None,
         metrics: Optional[Dict[str, float]] = None,
+        evicted: Optional[List[int]] = None,
     ) -> None:
         payload_obj: Dict[str, Any] = {"steps": steps}
         if refs:
@@ -528,6 +583,8 @@ class CheckpointManager:
             payload_obj["pinned"] = pinned
         if metrics:
             payload_obj["metrics"] = metrics
+        if evicted:
+            payload_obj["evicted"] = evicted
         payload = json.dumps(payload_obj).encode()
         # Backup FIRST, primary second. With this order a torn *primary*
         # write always leaves a valid new backup behind it, and a torn
@@ -542,6 +599,150 @@ class CheckpointManager:
     def _read_index(self) -> List[int]:
         return self._with_root_storage(self._read_index_async)
 
+    async def _evict_fast_async(self, step: int) -> bool:
+        """Drop one step's FAST-tier copy (tiered roots only); the step
+        stays committed and restorable via the per-blob durable fallback.
+        Returns True when evicted, False when the step is not yet safe to
+        evict (durable commit marker absent — the mirror is still
+        working, or failed and will resume)."""
+        from .integrity import table_path
+        from .tiered.journal import MirrorJournal
+        from .tiered.mirror import is_durable_async
+        from .tiered.plugin import TieredStoragePlugin
+
+        path = self.step_path(step)
+        if not await is_durable_async(path):
+            return False
+        storage = url_to_storage_plugin(path)
+        try:
+            if not isinstance(storage, TieredStoragePlugin):
+                return False
+            # The durable manifest is authoritative for what to remove
+            # (the fast copy may already be partial).
+            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+            await storage.durable.read(read_io)
+            metadata = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
+            locations: Set[str] = set()
+            for entry in metadata.manifest.values():
+                locations.update(_entry_locations(entry))
+            locations = {l for l in locations if not l.startswith("../")}
+            for rank in range(metadata.world_size):
+                locations.add(table_path(rank))
+
+            async def _drop(location: str) -> None:
+                try:
+                    await storage.fast.delete(location)
+                except FileNotFoundError:
+                    pass
+
+            # Commit marker first (deletion discipline shared with
+            # _delete_step_async), then data, then the journal.
+            await _drop(SNAPSHOT_METADATA_FNAME)
+            slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
+
+            async def _drop_slotted(location: str) -> None:
+                async with slots:
+                    await _drop(location)
+
+            results = await asyncio.gather(
+                *(_drop_slotted(l) for l in sorted(locations)),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
+            await MirrorJournal(blobs={}).delete(storage.fast)
+        finally:
+            await storage.close()
+        logger.info("Evicted step %d from the fast tier", step)
+        return True
+
+    def wait_durable(
+        self, step: int, timeout: Optional[float] = None
+    ) -> None:
+        """Durability barrier: block until ``step`` is fully mirrored to
+        the durable tier AND the durable tier's index names it — i.e.
+        until the durable tier alone could serve ``restore_latest``.
+        Immediate no-op for non-tiered roots (their commit was the
+        durable write). Raises ``TimeoutError`` on deadline, and
+        re-raises a failed mirror's error (the fast tier remains
+        restorable; the journal resumes the upload)."""
+        import time as _time
+
+        from .tiered.mirror import wait_durable as _wait_durable
+
+        tiers = split_tiered_url(self.root)
+        deadline = (
+            _time.monotonic() + timeout if timeout is not None else None
+        )
+        _wait_durable(self.step_path(step), timeout=timeout)
+        if tiers is None:
+            return
+        fast_root, durable_root = tiers
+        from .tiered.mirror import get_mirror
+
+        mirror = get_mirror()
+        resumed_root = False
+        while True:
+
+            async def _read_durable_index(_url=durable_root):
+                storage = url_to_storage_plugin(_url)
+                try:
+                    return await self._read_index_full_async(storage)
+                finally:
+                    await storage.close()
+
+            try:
+                index = run_in_fresh_event_loop(_read_durable_index())
+                if step in index["steps"]:
+                    return
+            except (FileNotFoundError, RuntimeError):
+                pass  # index not mirrored yet
+            # The index trails through the ROOT's own mirror jobs: if the
+            # newest one failed and nothing is in flight, polling would
+            # never progress — resume it once, then surface its error.
+            root_jobs = mirror.jobs_for(fast_root)
+            if root_jobs and all(j.done_evt.is_set() for j in root_jobs):
+                if root_jobs[-1].error is not None:
+                    if not resumed_root:
+                        resumed_root = True
+                        mirror.resume(self.root)
+                    else:
+                        raise RuntimeError(
+                            f"step {step} is durable, but mirroring the "
+                            f"manager index keeps failing; the fast tier "
+                            f"remains authoritative and resume_mirrors() "
+                            f"retries the upload"
+                        ) from root_jobs[-1].error
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"step {step} durable, but the durable index does not "
+                    f"name it within {timeout}s"
+                )
+            _time.sleep(0.05)
+
+    def resume_mirrors(self) -> List[int]:
+        """Re-enqueue interrupted durable-tier mirrors after a restart:
+        every committed step whose durable commit marker is absent
+        resumes from its journal (completed blobs are skipped) or, when
+        no journal survived, from its fast-tier manifest. Returns the
+        resumed steps. Rank 0 only (peers no-op); no-op for non-tiered
+        roots."""
+        if split_tiered_url(self.root) is None or self._pg.get_rank() != 0:
+            return []
+        from .tiered.mirror import get_mirror, is_durable
+
+        mirror = get_mirror()
+        resumed: List[int] = []
+        for step in self.all_steps():
+            path = self.step_path(step)
+            if not is_durable(path) and mirror.resume(path) is not None:
+                resumed.append(step)
+        # The root's own control blobs (index slots) may also have an
+        # interrupted mirror journaled.
+        mirror.resume(self.root)
+        return resumed
+
     async def _delete_step_async(self, step: int) -> None:
         """Delete a step's blobs, manifest-driven (plugins cannot list).
         The commit marker goes first: once it is gone the step is simply
@@ -551,6 +752,15 @@ class CheckpointManager:
 
         storage = url_to_storage_plugin(self.step_path(step))
         try:
+            from .tiered.plugin import TieredStoragePlugin
+
+            if isinstance(storage, TieredStoragePlugin) and storage.fast_url:
+                # The step is leaving BOTH tiers: stop any in-flight
+                # mirror first (its fast-tier source blobs are about to
+                # vanish; letting it run would only fail noisily).
+                from .tiered.mirror import get_mirror
+
+                get_mirror().cancel_path(storage.fast_url)
             read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
             try:
                 await storage.read(read_io)
@@ -558,6 +768,10 @@ class CheckpointManager:
                 return  # never committed; nothing authoritative to walk
             metadata = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
             await storage.delete(SNAPSHOT_METADATA_FNAME)
+            if isinstance(storage, TieredStoragePlugin):
+                from .tiered.journal import MirrorJournal
+
+                await MirrorJournal(blobs={}).delete(storage.fast)
 
             locations: Set[str] = set()
             manifest: Manifest = metadata.manifest

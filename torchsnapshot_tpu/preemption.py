@@ -115,6 +115,7 @@ class PreemptionSaver:
         )
         self._flagged = threading.Event()
         self._remote_flagged = threading.Event()
+        self._drains: List[Any] = []
         self._stop_poller = threading.Event()
         self._poller: Optional[threading.Thread] = None
         self._flag_published = False
@@ -197,6 +198,22 @@ class PreemptionSaver:
         """True once a signal/request has been observed locally."""
         return self._flagged.is_set()
 
+    def register_drain(self, fn: Any) -> None:
+        """Register a zero-arg callable run during :meth:`close` — before
+        the done marker publishes — to flush work that must fit the
+        eviction grace window. The tiered-checkpoint integration::
+
+            saver.register_drain(
+                lambda: tiered.get_mirror().drain(timeout=grace_s)
+            )
+
+        pushes in-flight durable-tier uploads out before the host dies;
+        whatever misses the window is journaled, so the restarted job's
+        ``CheckpointManager.resume_mirrors()`` resumes the upload instead
+        of re-sending completed blobs. Drain failures are logged, never
+        raised (close() runs on the teardown path)."""
+        self._drains.append(fn)
+
     def uninstall(self) -> None:
         """Restore previously-installed signal handlers."""
         for sig, prev in self._prev_handlers:
@@ -212,6 +229,11 @@ class PreemptionSaver:
         self._stop_poller.set()
         if self._poller is not None:
             self._poller.join(timeout=self.poll_interval + 1.0)
+        for fn in self._drains:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - teardown path
+                logger.warning("preemption drain hook failed: %r", e)
         store = self._pg.store
         if store is not None and self._pg.get_world_size() > 1:
             try:

@@ -60,6 +60,13 @@ def reset_phase_timings() -> None:
 def last_phase_timings() -> dict:
     return dict(_LAST_PHASE_S)
 
+
+def record_phase_timing(phase: str, elapsed_s: float) -> None:
+    """Publish a phase completion into the machine-readable channel from
+    outside the pipeline (the tiered mirror records its "mirroring" phase
+    here, next to the pipeline's staging/writing/loading entries)."""
+    _LAST_PHASE_S[phase] = round(elapsed_s, 3)
+
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES: int = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER: float = 0.6
 _LOG_LINE_LIMIT = 8

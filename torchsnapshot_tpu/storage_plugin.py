@@ -3,8 +3,11 @@
 Reference parity: torchsnapshot/storage_plugin.py:17-59. ``fs://`` is the
 default scheme for bare paths; ``memory://`` is a TPU-repo addition used by
 tests and scratch runs; ``s3://`` / ``gs://`` map to the cloud plugins
-(import-gated on their optional dependencies). Third-party plugins register
-via the ``torchsnapshot_tpu.storage_plugins`` entry-point group.
+(import-gated on their optional dependencies);
+``tiered://<fast_url>|<durable_url>`` composes two of the above into a
+fast-commit + background-durable-mirror pair (tiered/). Third-party
+plugins register via the ``torchsnapshot_tpu.storage_plugins``
+entry-point group.
 """
 
 from __future__ import annotations
@@ -38,6 +41,41 @@ def _parse_url(url_path: str) -> Tuple[str, str]:
     return ("fs", url_path)
 
 
+def split_tiered_url(url_path: str) -> Optional[Tuple[str, str]]:
+    """``(fast_url, durable_url)`` for a ``tiered://fast|durable`` URL,
+    None for any other scheme. Each side is itself a full snapshot URL
+    (bare paths mean ``fs://``); nesting tiered inside tiered is
+    rejected — the mirror topology is exactly two tiers."""
+    scheme, path = _parse_url(url_path)
+    if scheme != "tiered":
+        return None
+    fast, sep, durable = path.partition("|")
+    if not sep or not fast or not durable:
+        raise ValueError(
+            f"tiered URL {url_path!r} must be "
+            f"'tiered://<fast_url>|<durable_url>'"
+        )
+    for side in (fast, durable):
+        if _parse_url(side)[0] == "tiered":
+            raise ValueError(
+                f"tiered URL {url_path!r} nests a tiered tier; only two "
+                f"tiers are supported"
+            )
+    return fast, durable
+
+
+def join_path(url_path: str, segment: str) -> str:
+    """Append a path segment to a snapshot location URL. For tiered URLs
+    the segment applies to BOTH tiers (the two trees mirror each other
+    blob-for-blob); for every other scheme this is the plain
+    ``rstrip('/') + '/' + segment`` join the manager has always used."""
+    tiers = split_tiered_url(url_path)
+    if tiers is not None:
+        fast, durable = tiers
+        return f"tiered://{join_path(fast, segment)}|{join_path(durable, segment)}"
+    return f"{url_path.rstrip('/')}/{segment}"
+
+
 def url_to_storage_plugin(url_path: str) -> StoragePlugin:
     """Build the storage plugin for a snapshot location URL.
 
@@ -63,6 +101,11 @@ def url_to_storage_plugin(url_path: str) -> StoragePlugin:
         from .storage_plugins.gcs import GCSStoragePlugin
 
         return GCSStoragePlugin(root=path)
+    if scheme == "tiered":
+        from .tiered.plugin import TieredStoragePlugin
+
+        fast_url, durable_url = split_tiered_url(url_path)
+        return TieredStoragePlugin(fast_url=fast_url, durable_url=durable_url)
 
     eps = entry_points(group=_ENTRY_POINT_GROUP)
     for ep in eps:
@@ -70,7 +113,8 @@ def url_to_storage_plugin(url_path: str) -> StoragePlugin:
             return ep.load()(path)
     raise RuntimeError(
         f"Unsupported storage scheme {scheme!r} in {url_path!r} "
-        f"(built-in: fs, memory, s3, gs; entry-point group: {_ENTRY_POINT_GROUP})"
+        f"(built-in: fs, memory, s3, gs, tiered; "
+        f"entry-point group: {_ENTRY_POINT_GROUP})"
     )
 
 

@@ -19,8 +19,15 @@ import asyncio
 import os
 from typing import Set
 
-import aiofiles
-import aiofiles.os
+try:
+    import aiofiles
+    import aiofiles.os
+except ImportError:
+    # aiofiles is optional: images without it (some TPU containers ship
+    # only the native runtime) fall back to blocking stdlib I/O on
+    # executor threads — same semantics, and the native fast path is
+    # unaffected either way.
+    aiofiles = None
 
 from .. import _native
 from ..io_types import ReadIO, StoragePlugin, WriteIO
@@ -39,7 +46,13 @@ class FSStoragePlugin(StoragePlugin):
     async def _ensure_parent_dir(self, full_path: str) -> None:
         parent = os.path.dirname(full_path)
         if parent and parent not in self._dir_cache:
-            await aiofiles.os.makedirs(parent, exist_ok=True)
+            if aiofiles is not None:
+                await aiofiles.os.makedirs(parent, exist_ok=True)
+            else:
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(
+                    None, lambda: os.makedirs(parent, exist_ok=True)
+                )
             self._dir_cache.add(parent)
 
     async def write(self, write_io: WriteIO) -> None:
@@ -56,8 +69,18 @@ class FSStoragePlugin(StoragePlugin):
 
             if await loop.run_in_executor(None, _write_native):
                 return
-        async with aiofiles.open(full_path, "wb") as f:
-            await f.write(write_io.buf)
+        if aiofiles is not None:
+            async with aiofiles.open(full_path, "wb") as f:
+                await f.write(write_io.buf)
+            return
+
+        def _write_blocking() -> None:
+            with open(full_path, "wb") as f:
+                f.write(write_io.buf)
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, _write_blocking
+        )
 
     async def write_with_checksum(self, write_io: WriteIO):
         """Fused write + integrity pass (one cache-hot memory pass, one
@@ -99,23 +122,39 @@ class FSStoragePlugin(StoragePlugin):
                     data if data is read_io.dest else memoryview(data)
                 )
                 return
-        async with aiofiles.open(full_path, "rb") as f:
-            if read_io.byte_range is None:
-                data = await f.read()
-            else:
-                start, end = read_io.byte_range
-                await f.seek(start)
-                data = await f.read(end - start)
-                if len(data) < end - start:
-                    # Keep fallback semantics identical to the native path,
-                    # which fails ranged reads past EOF with EIO: a short
-                    # blob is corruption, not a partial success.
-                    raise OSError(
-                        5,
-                        f"short read: {full_path!r} has fewer than "
-                        f"{end} bytes",
-                        full_path,
-                    )
+        if aiofiles is not None:
+            async with aiofiles.open(full_path, "rb") as f:
+                if read_io.byte_range is None:
+                    data = await f.read()
+                else:
+                    start, end = read_io.byte_range
+                    await f.seek(start)
+                    data = await f.read(end - start)
+        else:
+
+            def _read_blocking() -> bytes:
+                with open(full_path, "rb") as f:
+                    if read_io.byte_range is None:
+                        return f.read()
+                    start, end = read_io.byte_range
+                    f.seek(start)
+                    return f.read(end - start)
+
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, _read_blocking
+            )
+        if read_io.byte_range is not None:
+            start, end = read_io.byte_range
+            if len(data) < end - start:
+                # Keep fallback semantics identical to the native path,
+                # which fails ranged reads past EOF with EIO: a short
+                # blob is corruption, not a partial success.
+                raise OSError(
+                    5,
+                    f"short read: {full_path!r} has fewer than "
+                    f"{end} bytes",
+                    full_path,
+                )
         read_io.buf = memoryview(data)
 
     async def read_with_checksum(self, read_io: ReadIO):
@@ -183,7 +222,12 @@ class FSStoragePlugin(StoragePlugin):
         return out
 
     async def delete(self, path: str) -> None:
-        await aiofiles.os.remove(self._full_path(path))
+        if aiofiles is not None:
+            await aiofiles.os.remove(self._full_path(path))
+            return
+        await asyncio.get_running_loop().run_in_executor(
+            None, os.remove, self._full_path(path)
+        )
 
     async def close(self) -> None:
         self._dir_cache.clear()

@@ -198,11 +198,24 @@ class GCSStoragePlugin(StoragePlugin):
             while not download.finished:
                 download.consume_next_chunk(self._session)
         except self._common.InvalidResponse as e:
-            if getattr(e.response, "status_code", None) == 404:
+            status = getattr(e.response, "status_code", None)
+            if status == 404:
                 # Normalize to the FS plugin's missing-blob contract so
                 # callers (e.g. checksum-table probing) can distinguish
                 # absent from unreadable. Definitive: never retried.
                 raise FileNotFoundError(path) from e
+            if status == 416:
+                # Out-of-range ranged read -> the fs/memory plugins' EIO
+                # contract (truncation, not partial success); convert
+                # --verify and fsck classify on it. Definitive: never
+                # retried (OSError is not in the GCS transient taxonomy).
+                import errno
+
+                raise OSError(
+                    errno.EIO,
+                    f"ranged read {byte_range} is outside the blob",
+                    path,
+                ) from e
             raise
         return stream.getvalue()
 

@@ -11,6 +11,7 @@ of the package.
 from __future__ import annotations
 
 import asyncio
+import errno
 import logging
 
 from .. import knobs
@@ -52,6 +53,8 @@ def _is_transient_s3(exc: BaseException) -> bool:
         return True
     if isinstance(exc, FileNotFoundError):
         return False  # normalized missing-key: definitive, never retried
+    if isinstance(exc, OSError) and exc.errno == errno.EIO:
+        return False  # normalized out-of-range read: definitive truncation
     return isinstance(exc, (OSError, asyncio.TimeoutError))
 
 
@@ -143,11 +146,26 @@ class S3StoragePlugin(StoragePlugin):
                 )
             except be.ClientError as e:
                 code = e.response.get("Error", {}).get("Code")
+                status = e.response.get("ResponseMetadata", {}).get(
+                    "HTTPStatusCode"
+                )
                 if code in ("NoSuchKey", "404"):
                     # Normalize to the FS plugin's missing-blob contract so
                     # callers (e.g. checksum-table probing) can distinguish
                     # absent from unreadable.
                     raise FileNotFoundError(read_io.path) from e
+                if code == "InvalidRange" or status == 416:
+                    # Normalize out-of-range ranged reads to the fs/memory
+                    # plugins' EIO contract: a range past the blob is
+                    # truncation/corruption, not a partial success —
+                    # fsck's and convert --verify's problem taxonomies
+                    # depend on it. Definitive: never retried.
+                    raise OSError(
+                        errno.EIO,
+                        f"ranged read {read_io.byte_range} is outside "
+                        f"the blob",
+                        read_io.path,
+                    ) from e
                 raise
             async with resp["Body"] as stream:
                 return await stream.read()

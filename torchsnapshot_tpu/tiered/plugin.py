@@ -1,0 +1,131 @@
+"""TieredStoragePlugin: write through the fast tier, read fast-first
+with per-blob durable fallback, hand written blobs to the background
+mirror at close.
+
+The plugin is deliberately thin: it composes two ordinary plugins and
+keeps a record of what was written through it. ``Snapshot.take`` /
+``async_take`` need no changes — every data write, checksum table and
+the commit marker land on the fast tier at fast-tier bandwidth, the take
+commits there, and when the take closes its plugin the accumulated blob
+inventory is enqueued to the process-wide :class:`Mirror` (commit marker
+ordered last). A take that failed before commit enqueues only data
+blobs — harmless on the durable tier (no commit marker ever follows),
+and the step's eventual GC removes them from both tiers.
+
+Reads try the fast tier and fall back per blob on ``FileNotFoundError``:
+an evicted, partially-evicted or never-local (restarted host) fast tier
+is transparent to restore, ``fsck`` and checksum-table loading alike.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..storage_plugin import url_to_storage_plugin
+
+_METADATA_FNAME = ".snapshot_metadata"  # == snapshot.SNAPSHOT_METADATA_FNAME
+
+
+class TieredStoragePlugin(StoragePlugin):
+    def __init__(
+        self,
+        fast_url: Optional[str] = None,
+        durable_url: Optional[str] = None,
+        fast: Optional[StoragePlugin] = None,
+        durable: Optional[StoragePlugin] = None,
+        mirror=None,
+    ) -> None:
+        """Compose ``fast`` and ``durable`` tiers, each given as a URL
+        (constructed via the registry) or as a ready plugin instance.
+        Mirroring requires URLs (the background worker builds its own
+        plugin instances); instance-composed plugins are read/write
+        valid but never enqueue — the explicit-composition escape hatch
+        for tests and custom topologies."""
+        if fast is None:
+            if fast_url is None:
+                raise ValueError("either fast or fast_url is required")
+            fast = url_to_storage_plugin(fast_url)
+        if durable is None:
+            if durable_url is None:
+                raise ValueError("either durable or durable_url is required")
+            durable = url_to_storage_plugin(durable_url)
+        self.fast = fast
+        self.durable = durable
+        self.fast_url = fast_url
+        self.durable_url = durable_url
+        self._mirror = mirror
+        # path -> staged byte count, in write order; drained into a
+        # mirror job at close().
+        self._written: Dict[str, int] = {}
+
+    # -- writes: fast tier only ------------------------------------------
+
+    async def write(self, write_io: WriteIO) -> None:
+        await self.fast.write(write_io)
+        self._written[write_io.path] = memoryview(write_io.buf).cast(
+            "B"
+        ).nbytes
+
+    async def write_with_checksum(self, write_io: WriteIO):
+        entry = await self.fast.write_with_checksum(write_io)
+        if entry is not None:
+            self._written[write_io.path] = memoryview(write_io.buf).cast(
+                "B"
+            ).nbytes
+        return entry
+
+    # -- reads: fast first, durable per-blob fallback --------------------
+
+    async def read(self, read_io: ReadIO) -> None:
+        try:
+            await self.fast.read(read_io)
+        except FileNotFoundError:
+            await self.durable.read(read_io)
+
+    async def read_with_checksum(self, read_io: ReadIO):
+        try:
+            return await self.fast.read_with_checksum(read_io)
+        except FileNotFoundError:
+            # Decline having read nothing: the scheduler falls back to
+            # read(), whose durable fallback serves the blob.
+            return None
+
+    # -- delete: both tiers (step GC removes the step entirely) ----------
+
+    async def delete(self, path: str) -> None:
+        found = False
+        try:
+            await self.fast.delete(path)
+            found = True
+        except FileNotFoundError:
+            pass
+        try:
+            await self.durable.delete(path)
+            found = True
+        except FileNotFoundError:
+            pass
+        if not found:
+            raise FileNotFoundError(path)
+
+    # -- close: hand the write record to the mirror ----------------------
+
+    async def close(self) -> None:
+        if self._written and self.fast_url and self.durable_url:
+            mirror = self._mirror
+            if mirror is None:
+                from .mirror import get_mirror
+
+                mirror = get_mirror()
+            metadata_path = (
+                _METADATA_FNAME if _METADATA_FNAME in self._written else None
+            )
+            mirror.enqueue(
+                self.fast_url,
+                self.durable_url,
+                dict(self._written),
+                metadata_path=metadata_path,
+            )
+            self._written.clear()
+        await self.fast.close()
+        await self.durable.close()

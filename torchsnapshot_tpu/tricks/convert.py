@@ -52,10 +52,20 @@ def verify_source(reader: ReferenceSnapshotReader, rank: int) -> List[str]:
             except FileNotFoundError:
                 checked[key] = f"missing blob {location}"
             except OSError:
+                # Truncation contract shared by every plugin: fs/memory
+                # raise EIO natively, and the s3/gs plugins normalize
+                # out-of-range ranged reads (botocore InvalidRange /
+                # google InvalidResponse 416) to OSError(EIO) the same
+                # way they normalize 404 to FileNotFoundError.
                 checked[key] = (
                     f"blob {location} is shorter than the {need} bytes "
                     f"its entry needs"
                 )
+            except Exception as e:  # noqa: BLE001 - verification must
+                # report, not crash: an unnormalized backend error (auth,
+                # throttling that exhausted retries) still belongs in the
+                # problem list the caller was promised.
+                checked[key] = f"blob {location} unreadable: {e!r}"
         return checked[key]
 
     for logical, entry in reader.manifest_for_rank(rank).items():

@@ -512,7 +512,15 @@ class ReferenceSnapshotReader:
             ]
             await asyncio.gather(*(self._storage.read(io) for io in ios))
             for io in ios:
-                assert io.buf is not None
+                # Explicit (not an assert): the check must survive
+                # ``python -O``, or a plugin that completed read() without
+                # filling buf surfaces later as an opaque TypeError.
+                if io.buf is None:
+                    raise RuntimeError(
+                        f"storage plugin "
+                        f"{type(self._storage).__name__} completed read() "
+                        f"without populating the buffer for {io.path!r}"
+                    )
             return [io.buf for io in ios]
 
         return self._loop.run_until_complete(_go())
@@ -575,15 +583,29 @@ class ReferenceSnapshotReader:
     def _assemble(self, entry: Dict[str, Any]) -> np.ndarray:
         """Assemble a sharded/chunked entry's boxes into one dense
         array (full host materialization — ``read_sharded`` is the
-        bounded-memory alternative)."""
+        bounded-memory alternative). Interior holes in the shard set
+        raise (matching ``read_sharded``'s covered-mask check) instead
+        of silently zero-filling — a hole means the snapshot lost
+        shards, and zeros here would convert into corrupt-but-valid
+        native snapshots downstream (tricks/convert.py reads through
+        this path)."""
         boxes, shape, dtype = _entry_boxes(entry)
         out = np.zeros(shape, dtype=dtype)
+        covered = np.zeros(shape, dtype=bool)
         for offsets, sizes, tentry in boxes:
             piece = self._read_tensor(tentry).reshape(sizes)
             window = tuple(
                 slice(o, o + s) for o, s in zip(offsets, sizes)
             )
             out[window] = piece
+            covered[window] = True
+        if not covered.all():
+            raise ValueError(
+                f"persisted shards cover only {int(covered.sum())} of "
+                f"{out.size} elements of a "
+                f"{entry.get('type', 'sharded')} entry — the snapshot's "
+                f"shard set has holes"
+            )
         return out
 
     def _read_torch_object(self, entry: Dict[str, Any]) -> Any:

@@ -181,6 +181,16 @@ def _as_numpy(leaf: Any):
 
 
 def _tensor_entry(path: str, arr: np.ndarray) -> tuple:
+    # The reference format is raw LITTLE-endian bytes (and torch cannot
+    # ingest big-endian numpy arrays at all): normalize non-native byte
+    # order before serializing, or a '>f4' array — whose dtype.name is
+    # still plain 'float32' — round-trips byte-swapped.
+    import sys
+
+    if arr.dtype.byteorder == ">" or (
+        arr.dtype.byteorder == "=" and sys.byteorder == "big"
+    ):
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
     name = arr.dtype.name
     if name in _BUFFER_PROTOCOL_DTYPES:
         entry = {
