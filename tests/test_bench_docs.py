@@ -103,6 +103,41 @@ def test_tiered_tests_are_lane_correct(capsys):
     assert rc == 0, capsys.readouterr().out
 
 
+def test_metric_names_are_lane_correct(capsys):
+    """Metric names: snake_case, declared exactly once in
+    telemetry/names.py, call sites use the constants."""
+    rc = _run_tool("check_metric_names.py")
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_metric_name_check_catches_violations(tmp_path):
+    mod = _load_tool("check_metric_names.py")
+    names = tmp_path / "names.py"
+    # camelCase value + duplicate value + duplicate constant.
+    names.write_text(
+        'GOOD = "good_metric"\n'
+        'BAD = "BadMetric"\n'
+        'DUP = "good_metric"\n'
+        'GOOD = "another_metric"\n'
+    )
+    errors = mod.check_names_file(names)
+    assert any("snake_case" in e for e in errors)
+    assert any("registered twice" in e for e in errors)
+    assert any("assigned twice" in e for e in errors)
+    assert mod.check_names_file(tmp_path / "absent.py") == [
+        "absent.py: missing (metric names must be declared here)"
+    ]
+    # A literal metric name at a call site is flagged; a constant is not.
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'reg.counter_inc("literal_name", 1)\n'
+        "reg.counter_inc(names.GOOD, 1)\n"
+    )
+    errors = mod.check_call_sites(pkg, names)
+    assert len(errors) == 1 and "literal_name" in errors[0]
+
+
 def test_tiered_marker_check_catches_lane_drift(tmp_path):
     mod = _load_tool("check_tiered_markers.py")
     bad = tmp_path / "test_tiered.py"

@@ -1,0 +1,567 @@
+"""Unified checkpoint telemetry: registry semantics, per-snapshot
+reports through the JSONL sink, retry/recover counter surfacing, the
+snapshot-stats CLI, and the phase-timing compatibility shim.
+
+Acceptance pin (ISSUE 2): a take with the JSONL sink enabled emits a
+SnapshotReport carrying per-phase durations, per-plugin byte counts and
+a retry counter; ``tools/snapshot_stats.py`` parses that log and renders
+a per-step summary; ``last_phase_timings()`` keeps its legacy keys.
+"""
+
+import asyncio
+import json
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.scheduler import (
+    last_phase_timings,
+    reset_phase_timings,
+    safe_rate_mb_s,
+)
+from torchsnapshot_tpu.storage_plugins.retry import (
+    CollectiveProgressRetryStrategy,
+    RetriesExhausted,
+)
+from torchsnapshot_tpu.telemetry import names
+from torchsnapshot_tpu.telemetry.registry import (
+    MetricsRegistry,
+    parse_series_key,
+    series_key,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Telemetry tests read process-global counters: isolate them."""
+    telemetry.reset_metrics()
+    yield
+    telemetry.reset_metrics()
+
+
+def _state(n=3, size=512, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": rng.standard_normal(size).astype(np.float32)
+        for i in range(n)
+    }
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.counter_inc(names.STORAGE_WRITE_BYTES_TOTAL, 100, plugin="fs")
+    reg.counter_inc(names.STORAGE_WRITE_BYTES_TOTAL, 50, plugin="fs")
+    reg.counter_inc(names.STORAGE_WRITE_BYTES_TOTAL, 7, plugin="s3")
+    reg.gauge_set(names.MIRROR_UPLOAD_LAG_SECONDS, 1.5)
+    reg.histogram_observe(names.MEMORY_BUDGET_WAIT_SECONDS, 0.01)
+    reg.histogram_observe(names.MEMORY_BUDGET_WAIT_SECONDS, 100.0)
+    data = reg.collect()
+    assert data["counters"]['storage_write_bytes_total{plugin="fs"}'] == 150
+    assert data["counters"]['storage_write_bytes_total{plugin="s3"}'] == 7
+    assert data["gauges"][names.MIRROR_UPLOAD_LAG_SECONDS] == 1.5
+    hist = data["histograms"][names.MEMORY_BUDGET_WAIT_SECONDS]
+    assert hist["count"] == 2
+    assert hist["sum"] == pytest.approx(100.01)
+    # 0.01 lands at le=0.025; 100 lands only in the +Inf overflow.
+    by_le = dict(hist["buckets"])
+    assert by_le[0.025] == 1
+    assert by_le[float("inf")] == 2
+
+
+def test_registry_snapshot_delta():
+    reg = MetricsRegistry()
+    reg.counter_inc(names.MANAGER_SAVES_TOTAL, 2)
+    base = reg.counters_snapshot()
+    reg.counter_inc(names.MANAGER_SAVES_TOTAL, 3)
+    reg.counter_inc(names.MANAGER_RESTORES_TOTAL, 1)
+    delta = reg.counters_delta_since(base)
+    assert delta == {
+        names.MANAGER_SAVES_TOTAL: 3,
+        names.MANAGER_RESTORES_TOTAL: 1,
+    }
+
+
+def test_series_key_roundtrip():
+    key = series_key("metric_name", {"b": "2", "a": "1"})
+    assert key == 'metric_name{a="1",b="2"}'
+    assert parse_series_key(key) == ("metric_name", {"a": "1", "b": "2"})
+    assert parse_series_key("bare") == ("bare", {})
+
+
+def test_registry_thread_safety_smoke():
+    reg = MetricsRegistry()
+
+    def worker():
+        for _ in range(1000):
+            reg.counter_inc(names.MANAGER_SAVES_TOTAL)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counters_snapshot()[names.MANAGER_SAVES_TOTAL] == 8000
+
+
+# ---------------------------------------------------------------------------
+# Satellite: throughput guard for near-zero elapsed time
+# ---------------------------------------------------------------------------
+
+
+def test_safe_rate_guards_near_zero_elapsed():
+    assert safe_rate_mb_s(10**9, 0.0) == 0.0
+    assert safe_rate_mb_s(10**9, 1e-12) == 0.0  # would print ~inf MB/s
+    rate = safe_rate_mb_s(1024**2, 1.0)
+    assert rate == pytest.approx(1.0)
+    assert math.isfinite(safe_rate_mb_s(10**12, 0.002))
+
+
+# ---------------------------------------------------------------------------
+# Satellite: retry strategy surfaces attempt/backoff counts
+# ---------------------------------------------------------------------------
+
+
+class _Flaky(Exception):
+    pass
+
+
+def test_retry_attempts_surface_in_registry():
+    strategy = CollectiveProgressRetryStrategy(
+        progress_window_seconds=60.0, scope="unit"
+    )
+    calls = [0]
+
+    async def op():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise _Flaky()
+        return "ok"
+
+    async def run():
+        return await strategy.run(op, retriable_exceptions=(_Flaky,))
+
+    loop = asyncio.new_event_loop()
+    try:
+        assert loop.run_until_complete(run()) == "ok"
+    finally:
+        loop.close()
+    # Per-instance totals (no registry arithmetic needed)...
+    assert strategy.attempts_total == 2
+    assert strategy.backoff_s_total > 0.0
+    assert strategy.exhausted_total == 0
+    # ...and the registry counters, labeled by scope.
+    counters = telemetry.metrics().counters_snapshot()
+    assert counters['storage_retry_attempts_total{scope="unit"}'] == 2
+    assert counters['storage_retry_backoff_seconds_total{scope="unit"}'] > 0
+
+
+def test_retry_exhaustion_counted():
+    strategy = CollectiveProgressRetryStrategy(
+        progress_window_seconds=0.0, scope="unit"
+    )
+
+    async def op():
+        raise _Flaky()
+
+    async def run():
+        await strategy.run(op, retriable_exceptions=(_Flaky,))
+
+    loop = asyncio.new_event_loop()
+    try:
+        with pytest.raises(RetriesExhausted):
+            loop.run_until_complete(run())
+    finally:
+        loop.close()
+    assert strategy.exhausted_total == 1
+    counters = telemetry.metrics().counters_snapshot()
+    assert counters['storage_retries_exhausted_total{scope="unit"}'] == 1
+
+
+def test_gcs_recover_attempts_reach_registry(monkeypatch):
+    """The in-thread resumable-upload recover loop (gcs.py) used to count
+    recover_attempts locally and drop them; they must reach the registry."""
+    gcs = pytest.importorskip("torchsnapshot_tpu.storage_plugins.gcs")
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", "http://localhost:1")
+    plugin = gcs.GCSStoragePlugin(root="bucket/prefix")
+    monkeypatch.setattr(gcs.time, "sleep", lambda s: None)
+
+    class _Resp:
+        status_code = 503
+
+    class _FakeUpload:
+        def __init__(self, url, chunk_size):
+            self.finished = False
+            self._failures_left = 2
+
+        def initiate(self, *args, **kwargs):
+            pass
+
+        def transmit_next_chunk(self, session):
+            if self._failures_left:
+                self._failures_left -= 1
+                raise plugin._common.InvalidResponse(_Resp(), "brownout")
+            self.finished = True
+
+        def recover(self, session):
+            pass
+
+    plugin._resumable_upload_cls = _FakeUpload
+    try:
+        plugin._upload_sync("blob", b"payload")
+    finally:
+        plugin._executor.shutdown(wait=False)
+    counters = telemetry.metrics().counters_snapshot()
+    assert counters[names.GCS_RECOVER_ATTEMPTS_TOTAL] == 2
+
+
+# ---------------------------------------------------------------------------
+# Satellite: phase-timing channel semantics across consecutive takes
+# ---------------------------------------------------------------------------
+
+
+def test_phase_timings_shim_and_reports_do_not_leak_across_takes(tmp_path):
+    state = {"m": ts.PyTreeState(_state())}
+    with knobs.enable_telemetry():
+        ts.Snapshot.take(str(tmp_path / "take1"), state)
+        timings1 = last_phase_timings()
+        assert set(timings1) == {"staging", "writing"}  # legacy keys
+        # An out-of-band phase (the tiered mirror's channel) recorded
+        # between takes must not leak into take 2's REPORT, even though
+        # the last-writer-wins global channel still shows it.
+        from torchsnapshot_tpu.scheduler import record_phase_timing
+
+        record_phase_timing("mirroring", 1.23)
+        ts.Snapshot.take(str(tmp_path / "take2"), state)
+        assert "mirroring" in last_phase_timings()  # global channel: yes
+        events = telemetry.load_events(
+            str(tmp_path / "take2" / ".telemetry.jsonl")
+        )
+        assert len(events) == 1
+        assert set(events[0]["phases"]) == {"staging", "writing"}  # report: no
+        # reset clears the global channel...
+        reset_phase_timings()
+        assert last_phase_timings() == {}
+        # ...and the next take repopulates only its own phases.
+        ts.Snapshot.take(str(tmp_path / "take3"), state)
+        assert set(last_phase_timings()) == {"staging", "writing"}
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: take with the JSONL sink + snapshot-stats CLI
+# ---------------------------------------------------------------------------
+
+
+def test_take_report_via_jsonl_sink_and_stats_cli(tmp_path, capsys):
+    path = str(tmp_path / "step_0000000001")
+    with knobs.enable_telemetry():
+        ts.Snapshot.take(path, {"m": ts.PyTreeState(_state(size=4096))})
+    events_file = os.path.join(path, ".telemetry.jsonl")
+    events = telemetry.load_events(events_file)
+    assert len(events) == 1
+    report = events[0]
+    assert report["kind"] == "take"
+    # Per-phase durations...
+    assert report["phases"]["staging"] >= 0.0
+    assert report["phases"]["writing"] >= report["phases"]["staging"]
+    # ...per-plugin byte counts...
+    assert report["plugins"]["fs"]["write_bytes"] > 0
+    assert report["plugins"]["fs"]["write_ops"] >= 3
+    # ...and a retry counter (zero-filled on a healthy local take).
+    assert report["retries"]["attempts"] == 0
+    assert report["bytes_moved"] == 3 * 4096 * 4
+    assert report["peak_staged_bytes"] > 0
+    # The CLI parses the log and renders a per-step summary.
+    from torchsnapshot_tpu.telemetry.stats import main as stats_main
+
+    assert stats_main([events_file]) == 0
+    out = capsys.readouterr().out
+    assert "step_0000000001" in out
+    assert "take" in out
+    assert "per-plugin totals" in out and "fs" in out
+
+
+def test_tools_snapshot_stats_wrapper(tmp_path, capsys):
+    """The repo-tools entry point parses the same log (loaded the way
+    the tools lane loads every checker)."""
+    import importlib.util
+    import pathlib
+
+    path = str(tmp_path / "snap")
+    with knobs.enable_telemetry():
+        ts.Snapshot.take(path, {"m": ts.PyTreeState(_state())})
+    tool = (
+        pathlib.Path(__file__).parent.parent / "tools" / "snapshot_stats.py"
+    )
+    spec = importlib.util.spec_from_file_location("snapshot_stats", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([os.path.join(path, ".telemetry.jsonl")]) == 0
+    assert "snap" in capsys.readouterr().out
+
+
+def test_restore_report_emitted(tmp_path):
+    path = str(tmp_path / "snap")
+    state = _state()
+    with knobs.enable_telemetry():
+        ts.Snapshot.take(path, {"m": ts.PyTreeState(dict(state))})
+        dst = {"m": ts.PyTreeState({k: np.zeros_like(v) for k, v in state.items()})}
+        ts.Snapshot(path).restore(dst)
+    events = telemetry.load_events(os.path.join(path, ".telemetry.jsonl"))
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["take", "restore"]
+    restore = events[1]
+    assert "loading" in restore["phases"]
+    assert restore["plugins"]["fs"]["read_bytes"] > 0
+    assert restore["bytes_moved"] > 0
+
+
+def test_async_take_report_emitted(tmp_path):
+    path = str(tmp_path / "snap")
+    with knobs.enable_telemetry():
+        pending = ts.Snapshot.async_take(
+            path, {"m": ts.PyTreeState(_state())}
+        )
+        pending.wait()
+    events = telemetry.load_events(os.path.join(path, ".telemetry.jsonl"))
+    assert [e["kind"] for e in events] == ["async_take"]
+    assert set(events[0]["phases"]) == {"staging", "writing"}
+
+
+def test_telemetry_dir_knob_takes_precedence(tmp_path):
+    snap = str(tmp_path / "snap")
+    tdir = str(tmp_path / "telemetry")
+    with knobs.override_telemetry_dir(tdir):
+        ts.Snapshot.take(snap, {"m": ts.PyTreeState(_state())})
+    assert not os.path.exists(os.path.join(snap, ".telemetry.jsonl"))
+    events = telemetry.load_events(os.path.join(tdir, "events.jsonl"))
+    assert len(events) == 1 and events[0]["path"] == snap
+
+
+def test_sink_disabled_writes_nothing(tmp_path):
+    snap = str(tmp_path / "snap")
+    ts.Snapshot.take(snap, {"m": ts.PyTreeState(_state())})
+    assert not os.path.exists(os.path.join(snap, ".telemetry.jsonl"))
+    # The registry still recorded the work.
+    counters = telemetry.metrics().counters_snapshot()
+    assert counters['storage_write_bytes_total{plugin="fs"}'] > 0
+    assert counters['snapshot_reports_total{kind="take"}'] == 1
+
+
+def test_events_path_resolution():
+    from torchsnapshot_tpu.telemetry.sink import events_path_for, local_fs_root
+
+    assert local_fs_root("/plain/dir") == "/plain/dir"
+    assert local_fs_root("fs:///plain/dir") == "/plain/dir"
+    assert local_fs_root("tiered:///fast|gs://bucket/x") == "/fast"
+    assert local_fs_root("gs://bucket/x") is None
+    assert local_fs_root("memory://name") is None
+    # No knobs set -> no sink anywhere.
+    assert events_path_for("/plain/dir") is None
+    with knobs.enable_telemetry():
+        assert events_path_for("/plain/dir") == "/plain/dir/.telemetry.jsonl"
+        # Object-store path without a telemetry dir: nowhere to append.
+        assert events_path_for("gs://bucket/x") is None
+    with knobs.override_telemetry_dir("/tmp/t"):
+        assert events_path_for("gs://bucket/x") == "/tmp/t/events.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# Budget wait / peak staged instrumentation
+# ---------------------------------------------------------------------------
+
+
+def test_report_records_budget_wait_under_tight_budget(tmp_path):
+    path = str(tmp_path / "snap")
+    # Budget fits ~1.25 leaves: later stagers must wait on admission.
+    with knobs.enable_telemetry(), knobs.override_per_rank_memory_budget_bytes(
+        2600
+    ):
+        ts.Snapshot.take(
+            path, {"m": ts.PyTreeState(_state(n=6, size=512))}
+        )
+    report = telemetry.load_events(os.path.join(path, ".telemetry.jsonl"))[0]
+    assert report["budget_wait_s"] > 0.0
+    assert 0 < report["peak_staged_bytes"] <= 2600 + 512 * 4
+
+
+# ---------------------------------------------------------------------------
+# Tiered mirror reports
+# ---------------------------------------------------------------------------
+
+
+def test_mirror_job_emits_report_and_gauges(tmp_path):
+    from torchsnapshot_tpu.tiered import reset_mirror, wait_durable
+
+    reset_mirror()
+    try:
+        fast = str(tmp_path / "fast")
+        durable = str(tmp_path / "durable")
+        url = f"tiered://{fast}|{durable}"
+        with knobs.enable_telemetry():
+            ts.Snapshot.take(url, {"m": ts.PyTreeState(_state())})
+            wait_durable(url, timeout=60)
+        events = telemetry.load_events(os.path.join(fast, ".telemetry.jsonl"))
+        kinds = [e["kind"] for e in events]
+        assert "take" in kinds and "mirror" in kinds
+        take = next(e for e in events if e["kind"] == "take")
+        # The take's report captured the durability backlog it created.
+        assert take["mirror"] != {}
+        mirror = next(e for e in events if e["kind"] == "mirror")
+        assert mirror["blobs"] == mirror["mirror"]["blobs_total"]
+        assert mirror["bytes_moved"] > 0
+        assert mirror["mirror"]["lag_s"] >= 0.0
+        assert mirror["error"] is None
+        data = telemetry.metrics().collect()
+        assert data["counters"][names.MIRROR_JOBS_DONE_TOTAL] == 1
+        assert data["counters"][names.MIRROR_BYTES_TOTAL] > 0
+        assert data["gauges"][names.MIRROR_SNAPSHOTS_PENDING] == 0
+    finally:
+        reset_mirror()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_textfile_written(tmp_path):
+    prom = str(tmp_path / "metrics.prom")
+    snap = str(tmp_path / "snap")
+    with knobs.override_prometheus_textfile(prom):
+        ts.Snapshot.take(snap, {"m": ts.PyTreeState(_state())})
+    text = open(prom).read()
+    assert 'storage_write_bytes_total{plugin="fs"}' in text
+    assert 'snapshot_reports_total{kind="take"} 1' in text
+    assert 'snapshot_phase_seconds_bucket{phase="writing",le="+Inf"}' in text
+    assert "snapshot_phase_seconds_count" in text
+    # Atomic rewrite: no tmp litter.
+    assert os.listdir(tmp_path / "snap") is not None
+    assert not [f for f in os.listdir(tmp_path) if f.startswith("metrics.prom.tmp")]
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank aggregation (pure function; multi-process paths ride the
+# distributed suites)
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_across_ranks_finds_straggler():
+    ranks = [
+        {"phases": {"writing": 1.0}, "bytes_moved": 100, "budget_wait_s": 0.0},
+        {"phases": {"writing": 9.0}, "bytes_moved": 100, "budget_wait_s": 0.5},
+        {"phases": {"writing": 2.0}, "bytes_moved": 300, "budget_wait_s": 0.1},
+    ]
+    agg = telemetry.aggregate_across_ranks(ranks)
+    assert agg["phase_writing_s"] == {
+        "min": 1.0,
+        "median": 2.0,
+        "max": 9.0,
+        "straggler": 1,
+    }
+    assert agg["bytes_moved"]["straggler"] == 2
+    assert agg["budget_wait_s"]["max"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# Satellite: rss profiler joins on exception paths + feeds the registry
+# ---------------------------------------------------------------------------
+
+
+def test_rss_profiler_joins_thread_on_exception_and_sets_gauge():
+    from torchsnapshot_tpu.utils.rss_profiler import (
+        RSSDeltas,
+        measure_rss_deltas,
+    )
+
+    deltas = RSSDeltas()
+    with pytest.raises(RuntimeError, match="boom"):
+        with measure_rss_deltas(deltas, sample_period_seconds=0.01):
+            raise RuntimeError("boom")
+    # The sampler thread is gone (joined, not leaked)...
+    assert not [
+        t for t in threading.enumerate() if t.name == "rss-profiler"
+    ]
+    # ...the exit sample was still appended...
+    assert len(deltas.deltas) >= 1
+    # ...and the peak fed the registry gauge.
+    gauges = telemetry.metrics().collect()["gauges"]
+    assert gauges[names.RSS_PEAK_DELTA_BYTES] == deltas.peak_bytes
+
+
+# ---------------------------------------------------------------------------
+# fsck --stats
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_stats_summarizes_snapshot_events(tmp_path, capsys):
+    from torchsnapshot_tpu.fsck import main as fsck_main
+
+    path = str(tmp_path / "snap")
+    with knobs.enable_telemetry():
+        ts.Snapshot.take(path, {"m": ts.PyTreeState(_state())})
+    assert fsck_main([path, "--stats"]) == 0
+    out = capsys.readouterr().out
+    assert "OK (shallow)" in out
+    assert "telemetry (1 event(s))" in out
+    assert "take" in out
+    # Without events, the summary degrades loudly but the audit passes.
+    bare = str(tmp_path / "bare")
+    ts.Snapshot.take(bare, {"m": ts.PyTreeState(_state())})
+    assert fsck_main([bare, "--stats"]) == 0
+    assert "no events recorded" in capsys.readouterr().out
+
+
+def test_manager_gc_removes_snapshot_event_log(tmp_path):
+    """The snapshot-adjacent .telemetry.jsonl is not manifest-named;
+    retention must still remove it with the step it documents."""
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root, keep_last_n=1)
+    state = {"m": ts.PyTreeState(_state())}
+    with knobs.enable_telemetry():
+        mgr.save(0, state)
+        step0 = os.path.join(root, "step_0000000000", ".telemetry.jsonl")
+        assert os.path.exists(step0)
+        mgr.save(1, state)
+    assert not os.path.exists(step0)  # GC'd with the step
+    assert os.path.exists(
+        os.path.join(root, "step_0000000001", ".telemetry.jsonl")
+    )
+
+
+def test_find_events_for_consults_telemetry_dir(tmp_path):
+    """fsck --stats must find events when the dir sink (higher
+    precedence) received them instead of the snapshot dir."""
+    from torchsnapshot_tpu.telemetry.stats import find_events_for
+
+    snap = str(tmp_path / "snap")
+    other = str(tmp_path / "other")
+    tdir = str(tmp_path / "tdir")
+    with knobs.override_telemetry_dir(tdir):
+        ts.Snapshot.take(snap, {"m": ts.PyTreeState(_state())})
+        ts.Snapshot.take(other, {"m": ts.PyTreeState(_state())})
+        events = find_events_for(snap)
+    assert len(events) == 1 and events[0]["path"] == snap
+
+
+def test_stats_renderer_handles_empty_and_corrupt_lines(tmp_path):
+    from torchsnapshot_tpu.telemetry.stats import render_summary
+
+    assert render_summary([]) == "no telemetry events"
+    log = tmp_path / "events.jsonl"
+    log.write_text(
+        json.dumps({"kind": "take", "path": "/x", "phases": {"writing": 1.0}})
+        + "\n{torn-line\n"
+    )
+    events = telemetry.load_events(str(log))
+    assert len(events) == 1  # corrupt line skipped, not raised
+    assert "/x" in render_summary(events)

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Metric-name lint for the telemetry registry.
+
+The exposition namespace (dashboards, alerts, the Prometheus text file)
+only stays stable if metric names are declared in exactly one place.
+This check enforces, statically (AST, stdlib-only — same shape as
+``check_tiered_markers.py``):
+
+- ``torchsnapshot_tpu/telemetry/names.py`` declares every metric name as
+  a module-level string constant: snake_case value, no constant assigned
+  twice, no value declared twice (registered exactly once);
+- no other file under ``torchsnapshot_tpu/`` passes a string literal as
+  the metric name to ``counter_inc``/``gauge_set``/``histogram_observe``
+  — call sites must reference the ``names.py`` constants, so renames are
+  one-line and greppable.
+
+    python tools/check_metric_names.py
+"""
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "torchsnapshot_tpu"
+NAMES_FILE = PACKAGE / "telemetry" / "names.py"
+
+_SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+_REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
+
+
+def check_names_file(path: Path):
+    """Errors in the declaration file: non-snake_case values, duplicate
+    constants, duplicate values."""
+    errors = []
+    if not path.exists():
+        return [f"{path.name}: missing (metric names must be declared here)"]
+    tree = ast.parse(path.read_text())
+    seen_targets = {}
+    seen_values = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if not isinstance(target, ast.Name):
+                continue
+            if not isinstance(node.value, ast.Constant) or not isinstance(
+                node.value.value, str
+            ):
+                errors.append(
+                    f"{path.name}:{node.lineno}: {target.id} is not a "
+                    f"string literal"
+                )
+                continue
+            value = node.value.value
+            if not _SNAKE_CASE.match(value):
+                errors.append(
+                    f"{path.name}:{node.lineno}: {value!r} is not "
+                    f"snake_case"
+                )
+            if target.id in seen_targets:
+                errors.append(
+                    f"{path.name}:{node.lineno}: constant {target.id} "
+                    f"assigned twice (first at line "
+                    f"{seen_targets[target.id]})"
+                )
+            seen_targets[target.id] = node.lineno
+            if value in seen_values:
+                errors.append(
+                    f"{path.name}:{node.lineno}: metric {value!r} "
+                    f"registered twice (first at line {seen_values[value]})"
+                )
+            seen_values[value] = node.lineno
+    if not seen_values and not errors:
+        errors.append(f"{path.name}: no metric names declared")
+    return errors
+
+
+def check_call_sites(package: Path, names_file: Path):
+    """Errors at registry call sites: string-literal metric names
+    outside names.py."""
+    errors = []
+    for py in sorted(package.rglob("*.py")):
+        if py == names_file:
+            continue
+        try:
+            tree = ast.parse(py.read_text())
+        except SyntaxError as e:
+            errors.append(f"{py.relative_to(package.parent)}: {e}")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            method = func.attr if isinstance(func, ast.Attribute) else None
+            if method not in _REGISTRY_METHODS or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                errors.append(
+                    f"{py.relative_to(package.parent)}:{node.lineno}: "
+                    f"literal metric name {first.value!r} in {method}() — "
+                    f"use a telemetry/names.py constant"
+                )
+    return errors
+
+
+def check(package: Path = PACKAGE, names_file: Path = NAMES_FILE):
+    return check_names_file(names_file) + check_call_sites(
+        package, names_file
+    )
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(e)
+    if not errors:
+        print(
+            "check_metric_names: metric names are snake_case, registered "
+            "exactly once in telemetry/names.py, and call sites use the "
+            "constants"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""snapshot-stats: per-step tables from a checkpoint-telemetry event log.
+
+Thin repo-tools wrapper over ``torchsnapshot_tpu.telemetry.stats`` (also
+reachable as ``python -m torchsnapshot_tpu.telemetry``) so BENCH drivers
+and operators shelling in from the repo root consume the same renderer::
+
+    python tools/snapshot_stats.py /ckpts/.telemetry.jsonl
+    python tools/snapshot_stats.py events.jsonl --kind take
+    python tools/snapshot_stats.py events.jsonl --path-contains step_00
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from torchsnapshot_tpu.telemetry.stats import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

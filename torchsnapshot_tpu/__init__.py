@@ -9,6 +9,7 @@ write-load partitioning of replicated state, shard-level persistence of
 restore, atomic commit, and pluggable storage backends.
 """
 
+from . import telemetry
 from .fsck import FsckReport, verify_snapshot
 from .knobs import (
     enable_batching,
@@ -23,6 +24,7 @@ from .rng_state import RngState, RNGState
 from .snapshot import PendingRestore, PendingSnapshot, Snapshot
 from .state_dict import PyTreeState, StateDict
 from .stateful import AppState, Stateful
+from .telemetry import MetricsRegistry, SnapshotReport
 from .tiered import Mirror, TieredStoragePlugin
 from .version import __version__
 
@@ -30,7 +32,10 @@ __all__ = [
     "AppState",
     "CheckpointManager",
     "FsckReport",
+    "MetricsRegistry",
     "Mirror",
+    "SnapshotReport",
+    "telemetry",
     "TieredStoragePlugin",
     "PendingRestore",
     "PendingSnapshot",

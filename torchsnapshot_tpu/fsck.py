@@ -408,8 +408,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="for tiered:// paths: audit only this tier (default: the "
         "composed view with per-blob durable fallback)",
     )
+    p.add_argument(
+        "--stats",
+        action="store_true",
+        help="after the audit, summarize the snapshot's telemetry "
+        "events (.telemetry.jsonl written by the JSONL sink; see "
+        "docs/observability.md)",
+    )
     args = p.parse_args(argv)
     report = verify_snapshot(args.path, deep=args.deep, tier=args.tier)
+    if args.stats:
+        from .telemetry.stats import find_events_for, render_summary
+
+        events = find_events_for(args.path)
+        print()
+        if events:
+            print(f"telemetry ({len(events)} event(s)):")
+            print(render_summary(events))
+        else:
+            print(
+                "telemetry: no events recorded for this snapshot (take "
+                "it with TORCHSNAPSHOT_TPU_TELEMETRY=1 for the "
+                "snapshot-adjacent sink, or run this command with the "
+                "same TORCHSNAPSHOT_TPU_TELEMETRY_DIR the take used)"
+            )
+        print()
     for prob in report.problems:
         print(f"FSCK {prob.kind}: {prob.location}: {prob.detail}")
     mode = "deep" if report.deep else "shallow"

@@ -30,6 +30,9 @@ _MIRROR_IO_CONCURRENCY_ENV = "TORCHSNAPSHOT_TPU_MIRROR_IO_CONCURRENCY"
 _MIRROR_PROGRESS_WINDOW_ENV = (
     "TORCHSNAPSHOT_TPU_MIRROR_PROGRESS_WINDOW_SECONDS"
 )
+_TELEMETRY_ENV = "TORCHSNAPSHOT_TPU_TELEMETRY"
+_TELEMETRY_DIR_ENV = "TORCHSNAPSHOT_TPU_TELEMETRY_DIR"
+_PROM_FILE_ENV = "TORCHSNAPSHOT_TPU_PROM_FILE"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -145,6 +148,30 @@ def get_mirror_progress_window_seconds() -> float:
     return DEFAULT_PROGRESS_WINDOW_SECONDS
 
 
+def get_telemetry_dir() -> Optional[str]:
+    """Local directory for the telemetry JSONL event log
+    (``<dir>/events.jsonl``). Takes precedence over the
+    snapshot-adjacent sink; unset = no directory sink."""
+    return os.environ.get(_TELEMETRY_DIR_ENV) or None
+
+
+def is_telemetry_sink_enabled() -> bool:
+    """Snapshot-adjacent JSONL sink toggle: with the env var present,
+    every take/restore/mirror against a *local* snapshot path appends
+    its SnapshotReport to ``<snapshot>/.telemetry.jsonl``. A telemetry
+    dir (above) also counts as enablement — reports then go there
+    instead. The registry itself always records; these knobs only
+    control whether anything is written out."""
+    return _TELEMETRY_ENV in os.environ or get_telemetry_dir() is not None
+
+
+def get_prometheus_textfile() -> Optional[str]:
+    """Prometheus text-exposition file, rewritten (atomically) after
+    every report emission — the node-exporter textfile-collector
+    convention. Unset = disabled."""
+    return os.environ.get(_PROM_FILE_ENV) or None
+
+
 def get_restore_placement_flush_bytes() -> int:
     """Streaming-restore flush granularity: once this many bytes of leaves
     have completed their reads, their device placements flush as one
@@ -226,6 +253,24 @@ def override_restore_placement_flush_bytes(
     nbytes: int,
 ) -> Generator[None, None, None]:
     with _override_env(_RESTORE_FLUSH_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_telemetry() -> Generator[None, None, None]:
+    with _override_env(_TELEMETRY_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_telemetry_dir(path: str) -> Generator[None, None, None]:
+    with _override_env(_TELEMETRY_DIR_ENV, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_prometheus_textfile(path: str) -> Generator[None, None, None]:
+    with _override_env(_PROM_FILE_ENV, path):
         yield
 
 

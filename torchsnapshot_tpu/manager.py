@@ -39,8 +39,9 @@ import logging
 import re
 from typing import Any, Dict, List, Optional, Set
 
-from . import knobs
+from . import knobs, telemetry
 from .event_loop import run_in_fresh_event_loop
+from .telemetry import names as metric_names
 from .io_types import ReadIO, StoragePlugin, WriteIO
 from .manifest import (
     ChunkedArrayEntry,
@@ -114,6 +115,7 @@ class _PendingManagedSnapshot:
             refs=lambda: referenced_steps(snapshot.metadata.manifest),
             metric=self._metric,
         )
+        telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         return snapshot
 
     def done(self) -> bool:
@@ -227,6 +229,7 @@ class CheckpointManager:
             refs=lambda: referenced_steps(snapshot.metadata.manifest),
             metric=metric,
         )
+        telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         return snapshot
 
     @staticmethod
@@ -296,6 +299,7 @@ class CheckpointManager:
 
     def restore(self, step: int, app_state: AppState) -> None:
         Snapshot(self.step_path(step), pg=self._pg_arg).restore(app_state)
+        telemetry.metrics().counter_inc(metric_names.MANAGER_RESTORES_TOTAL)
 
     def restore_latest(self, app_state: AppState) -> Optional[int]:
         """Restore the newest committed step into ``app_state``; returns
@@ -312,9 +316,13 @@ class CheckpointManager:
     def async_restore(self, step: int, app_state: AppState):
         """Pipelined restore of ``step`` (Snapshot.async_restore): reads
         run in the background; call ``.wait()`` to apply."""
-        return Snapshot(self.step_path(step), pg=self._pg_arg).async_restore(
+        pending = Snapshot(self.step_path(step), pg=self._pg_arg).async_restore(
             app_state
         )
+        # Counted at initiation (the wait handle is Snapshot-level):
+        # async resumes must move the same counter sync ones do.
+        telemetry.metrics().counter_inc(metric_names.MANAGER_RESTORES_TOTAL)
+        return pending
 
     def async_restore_latest(self, app_state: AppState):
         """Kick off a pipelined restore of the newest committed step;
@@ -487,6 +495,12 @@ class CheckpointManager:
             steps, storage, refs=refs_map, pinned=sorted(pinned),
             metrics=metrics, evicted=sorted(evicted),
         )
+        registry = telemetry.metrics()
+        registry.gauge_set(metric_names.MANAGER_RETAINED_STEPS, len(steps))
+        if to_delete:
+            registry.counter_inc(
+                metric_names.MANAGER_GC_STEPS_TOTAL, len(to_delete)
+            )
         for old in to_delete:
             try:
                 await self._delete_step_async(old)
@@ -636,8 +650,13 @@ class CheckpointManager:
                     pass
 
             # Commit marker first (deletion discipline shared with
-            # _delete_step_async), then data, then the journal.
+            # _delete_step_async), then data, then the journal. The
+            # telemetry event log is not manifest-named; drop it
+            # explicitly or every evicted step leaks one file.
+            from .telemetry.sink import SNAPSHOT_EVENTS_BASENAME
+
             await _drop(SNAPSHOT_METADATA_FNAME)
+            await _drop(SNAPSHOT_EVENTS_BASENAME)
             slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
 
             async def _drop_slotted(location: str) -> None:
@@ -772,6 +791,15 @@ class CheckpointManager:
                 from .tiered.journal import MirrorJournal
 
                 await MirrorJournal(blobs={}).delete(storage.fast)
+            # The snapshot-adjacent telemetry log is not named by the
+            # manifest; remove it with the step or GC leaks one file per
+            # dropped step.
+            from .telemetry.sink import SNAPSHOT_EVENTS_BASENAME
+
+            try:
+                await storage.delete(SNAPSHOT_EVENTS_BASENAME)
+            except FileNotFoundError:
+                pass  # sink was never enabled for this step
 
             locations: Set[str] = set()
             manifest: Manifest = metadata.manifest

@@ -31,7 +31,7 @@ from typing import Callable, List, Optional
 
 import psutil
 
-from . import knobs
+from . import knobs, telemetry
 from .integrity import (
     ChecksumTable,
     compute_checksum_entry,
@@ -43,29 +43,34 @@ from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 
 logger: logging.Logger = logging.getLogger(__name__)
 
-# Observability hook: wall-clock phase completions (seconds since the
+# Observability: wall-clock phase completions (seconds since the
 # pipeline's reporter started) of the most recent write/read pipeline run
 # in this process, keyed by phase name ("staging"/"writing"/"loading").
-# The reporter already logs these numbers (report_phase_done) but not
-# machine-readably; bench.py's in-take stall diagnosis reads them here.
-# Last-writer-wins across concurrent pipelines — callers that care run
-# one pipeline at a time.
-_LAST_PHASE_S: dict = {}
+# Historically a module-level dict here; now a compatibility shim over
+# the telemetry registry's phase-timing channel (telemetry/registry.py),
+# which also feeds the snapshot_phase_seconds histogram. Semantics are
+# unchanged: last-writer-wins across concurrent pipelines — callers that
+# care (bench.py's in-take stall diagnosis) run one pipeline at a time.
 
 
 def reset_phase_timings() -> None:
-    _LAST_PHASE_S.clear()
+    telemetry.metrics().reset_phase_timings()
 
 
 def last_phase_timings() -> dict:
-    return dict(_LAST_PHASE_S)
+    return telemetry.metrics().last_phase_timings()
 
 
 def record_phase_timing(phase: str, elapsed_s: float) -> None:
     """Publish a phase completion into the machine-readable channel from
     outside the pipeline (the tiered mirror records its "mirroring" phase
     here, next to the pipeline's staging/writing/loading entries)."""
-    _LAST_PHASE_S[phase] = round(elapsed_s, 3)
+    telemetry.record_phase(phase, elapsed_s)
+
+
+# Near-zero-elapsed throughput guard (div-by-~0 would print inf MB/s);
+# one shared threshold with the snapshot-stats renderer.
+safe_rate_mb_s = telemetry.safe_rate_mb_s
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES: int = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER: float = 0.6
@@ -116,18 +121,37 @@ class MemoryBudget:
         self.available_bytes = total_bytes
         self.inflight = 0
         self._cond: asyncio.Condition = asyncio.Condition()
+        # Telemetry: cumulative admission-wait seconds (how long requests
+        # sat blocked on the budget — the FastPersist-style signal for
+        # "the budget, not the storage, is the bottleneck") and the peak
+        # concurrently-reserved bytes this budget ever carried.
+        self.wait_s = 0.0
+        self.peak_reserved_bytes = 0
+
+    def _note_reserved(self) -> None:
+        reserved = self.total_bytes - self.available_bytes
+        if reserved > self.peak_reserved_bytes:
+            self.peak_reserved_bytes = reserved
 
     async def acquire(self, cost_bytes: int) -> None:
+        t0 = time.monotonic()
         async with self._cond:
             await self._cond.wait_for(
                 lambda: cost_bytes <= self.available_bytes or self.inflight == 0
             )
             self.available_bytes -= cost_bytes
             self.inflight += 1
+            self._note_reserved()
+        waited = time.monotonic() - t0
+        self.wait_s += waited
+        telemetry.metrics().histogram_observe(
+            telemetry.names.MEMORY_BUDGET_WAIT_SECONDS, waited
+        )
 
     async def adjust(self, delta_bytes: int) -> None:
         async with self._cond:
             self.available_bytes -= delta_bytes
+            self._note_reserved()
             if delta_bytes < 0:
                 self._cond.notify_all()
 
@@ -165,6 +189,10 @@ class _ProgressReporter:
         self.stats = stats
         self.budget = budget
         self.rank = rank
+        # Per-pipeline phase completions (phase -> seconds since start):
+        # unlike the process-global last_phase_timings channel this can
+        # never leak a previous run's phases into this run's report.
+        self.phase_s: dict = {}
         self.begin_ts = time.monotonic()
         self._process = psutil.Process()
         self.baseline_rss = self._process.memory_info().rss
@@ -209,14 +237,25 @@ class _ProgressReporter:
 
     def report_phase_done(self, phase: str) -> None:
         elapsed = time.monotonic() - self.begin_ts
-        _LAST_PHASE_S[phase] = round(elapsed, 3)
-        mbps = self.stats.bytes_moved / 1024**2 / elapsed if elapsed > 0 else 0.0
+        self.phase_s[phase] = round(elapsed, 3)
+        telemetry.record_phase(phase, elapsed)
+        mbps = safe_rate_mb_s(self.stats.bytes_moved, elapsed)
         msg = (
             f"Rank {self.rank} completed {phase} in {elapsed:.2f}s "
             f"(throughput {mbps:.2f} MB/s)"
         )
         pad = max(0, len(self._header) - len(msg) - 2) / 2
         logger.info(f"{'-' * math.ceil(pad)} {msg} {'-' * math.floor(pad)}")
+
+    def pipeline_telemetry(self) -> dict:
+        """This run's exact numbers for SnapshotReport assembly."""
+        return {
+            "phases": dict(self.phase_s),
+            "bytes_moved": self.stats.bytes_moved,
+            "blobs": self.stats.done,
+            "budget_wait_s": round(self.budget.wait_s, 6),
+            "peak_staged_bytes": self.budget.peak_reserved_bytes,
+        }
 
 
 class PendingIOWork:
@@ -249,6 +288,11 @@ class PendingIOWork:
             finally:
                 self.checksum_finalizer = None
 
+    def pipeline_telemetry(self) -> dict:
+        """The write pipeline's exact per-run numbers (phases, bytes,
+        blob count, budget wait, peak staged); stable after complete()."""
+        return self.reporter.pipeline_telemetry()
+
     async def complete(self) -> None:
         try:
             if self.io_tasks:
@@ -268,6 +312,10 @@ class PendingIOWork:
         finally:
             self._executor.shutdown(wait=False)
         self.reporter.report_phase_done("writing")
+        telemetry.metrics().gauge_set(
+            telemetry.names.MEMORY_BUDGET_PEAK_STAGED_BYTES,
+            self.reporter.budget.peak_reserved_bytes,
+        )
 
     def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
         event_loop.run_until_complete(self.complete())
@@ -437,9 +485,11 @@ async def execute_read_reqs(
     rank: int,
     checksum_table: Optional[ChecksumTable] = None,
     on_req_complete: Optional[Callable[[ReadReq], None]] = None,
-) -> None:
+) -> dict:
     """Read pipeline: storage read -> deserialize/copy, budgeted by each
-    request's consuming cost (reference scheduler.py:357-444).
+    request's consuming cost (reference scheduler.py:357-444). Returns
+    the run's pipeline-telemetry dict (phases, bytes, budget wait) for
+    SnapshotReport assembly.
 
     ``on_req_complete`` fires on the event loop after a request's bytes
     are verified and consumed — the hook streaming restore placement
@@ -591,6 +641,7 @@ async def execute_read_reqs(
             len(read_reqs),
         )
     reporter.report_phase_done("loading")
+    return reporter.pipeline_telemetry()
 
 
 def sync_execute_read_reqs(
@@ -601,8 +652,8 @@ def sync_execute_read_reqs(
     event_loop: asyncio.AbstractEventLoop,
     checksum_table: Optional[ChecksumTable] = None,
     on_req_complete: Optional[Callable[[ReadReq], None]] = None,
-) -> None:
-    event_loop.run_until_complete(
+) -> dict:
+    return event_loop.run_until_complete(
         execute_read_reqs(
             read_reqs=read_reqs,
             storage=storage,

@@ -34,7 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from . import knobs
+from . import knobs, telemetry
 from .dist_store import LinearBarrier
 from .flatten import flatten, inflate
 from .io_preparer import (
@@ -109,6 +109,94 @@ def _reporting_to(barrier: Optional["LinearBarrier"], what: str):
         raise
 
 
+def _mirror_state_for(path: str) -> Dict[str, Any]:
+    """The process mirror's queue/lag state, for reports about tiered
+    paths ({} otherwise): at take-report time the step's upload job was
+    just enqueued, so this is the durability backlog the take added to."""
+    from .storage_plugin import split_tiered_url
+
+    try:
+        if split_tiered_url(path) is None:
+            return {}
+    except ValueError:
+        return {}
+    from .tiered.mirror import get_mirror
+
+    return dict(get_mirror().metrics())
+
+
+def _emit_snapshot_report(
+    kind: str,
+    path: str,
+    pg_wrapper: "PGWrapper",
+    pipeline: Optional[dict],
+    counter_baseline: Dict[str, float],
+    nonce: Optional[str],
+    error: Optional[BaseException] = None,
+) -> None:
+    """Assemble this rank's SnapshotReport, aggregate across ranks, and
+    hand it to the sinks. Best-effort — telemetry must never fail a
+    checkpoint — EXCEPT that the cross-rank gather is unconditionally
+    symmetric: every rank that reaches this function participates
+    (whether or not a sink is configured locally), so a sink knob set on
+    rank 0 only can never strand the gather. Store-based, not a
+    collective: safe on the async-take commit thread."""
+    try:
+        registry = telemetry.metrics()
+        report = telemetry.build_report(
+            kind=kind,
+            path=path,
+            rank=pg_wrapper.get_rank(),
+            world_size=pg_wrapper.get_world_size(),
+            pipeline=pipeline,
+            counter_deltas=registry.counters_delta_since(counter_baseline),
+            mirror=_mirror_state_for(path),
+            error=repr(error) if error is not None else None,
+        )
+        if (
+            nonce
+            and pg_wrapper.get_world_size() > 1
+            and pg_wrapper.store is not None
+        ):
+            # Separately guarded with a bounded timeout: every rank that
+            # commits reaches this gather, but a rank dying in the tiny
+            # window after the commit barrier must cost rank 0 seconds
+            # (and only the aggregation), never the 300 s store timeout
+            # or the local report.
+            try:
+                gathered = pg_wrapper.store.gather(
+                    f"__telemetry/{kind}/{nonce}",
+                    pg_wrapper.get_rank(),
+                    pg_wrapper.get_world_size(),
+                    report.to_dict(),
+                    timeout=60.0,
+                )
+            except Exception as e:  # noqa: BLE001 - emit unaggregated
+                logger.warning(
+                    "telemetry: cross-rank gather for %s failed (%r); "
+                    "emitting the unaggregated rank-local report",
+                    kind,
+                    e,
+                )
+                gathered = None
+            if gathered is not None:
+                report.aggregated = telemetry.aggregate_across_ranks(gathered)
+                for metric, spread in sorted(report.aggregated.items()):
+                    logger.info(
+                        "telemetry %s %s: min=%s median=%s max=%s "
+                        "straggler=rank %s",
+                        kind,
+                        metric,
+                        spread["min"],
+                        spread["median"],
+                        spread["max"],
+                        spread["straggler"],
+                    )
+        telemetry.emit_report(report, registry)
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the op
+        logger.warning("telemetry: %s report emission failed: %r", kind, e)
+
+
 class Snapshot:
     """A reference to an existing or to-be-created snapshot at ``path``."""
 
@@ -157,12 +245,14 @@ class Snapshot:
         # abandon (no commit marker anywhere). The nonce keeps barrier
         # keys from aliasing any earlier take to the same path.
         barrier = None
+        commit_nonce = ""
         if pg_wrapper.get_world_size() > 1:
             commit_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
             barrier = _nonce_barrier(
                 f"__snapshot_commit/{commit_nonce}", pg_wrapper
             )
         event_loop = asyncio.new_event_loop()
+        counter_baseline = telemetry.metrics().counters_snapshot()
         try:
             storage = url_to_storage_plugin(path)
             with _reporting_to(barrier, "take"):
@@ -200,6 +290,17 @@ class Snapshot:
                 if barrier is not None:
                     barrier.depart()
             event_loop.run_until_complete(storage.close())
+            # Post-close on purpose: a tiered plugin enqueues its mirror
+            # job at close, so the report's mirror state reflects the
+            # durability backlog this take just created.
+            _emit_snapshot_report(
+                kind="take",
+                path=path,
+                pg_wrapper=pg_wrapper,
+                pipeline=pending_io_work.pipeline_telemetry(),
+                counter_baseline=counter_baseline,
+                nonce=commit_nonce,
+            )
         finally:
             event_loop.close()
         snapshot = cls(path=path, pg=pg)
@@ -240,6 +341,7 @@ class Snapshot:
             f"__snapshot_commit/{commit_nonce}", pg_wrapper
         )
         event_loop = asyncio.new_event_loop()
+        counter_baseline = telemetry.metrics().counters_snapshot()
         storage = url_to_storage_plugin(path)
         try:
             with _reporting_to(barrier, "async take staging"):
@@ -272,6 +374,7 @@ class Snapshot:
             storage=storage,
             event_loop=event_loop,
             commit_nonce=commit_nonce,
+            counter_baseline=counter_baseline,
         )
 
     @classmethod
@@ -504,6 +607,8 @@ class Snapshot:
         restore_nonce = None
         if pg_wrapper.get_world_size() > 1:
             restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+        counter_baseline = telemetry.metrics().counters_snapshot()
+        pipeline_sink: List[dict] = []
 
         def key_barrier(i: int) -> Optional[LinearBarrier]:
             if restore_nonce is None:
@@ -548,6 +653,7 @@ class Snapshot:
                             event_loop=event_loop,
                             rank=rank,
                             checksum_table=checksum_table,
+                            pipeline_sink=pipeline_sink,
                         )
                 if barrier is not None:
                     barrier.arrive()
@@ -566,8 +672,17 @@ class Snapshot:
                     event_loop=event_loop,
                     rank=rank,
                     checksum_table=checksum_table,
+                    pipeline_sink=pipeline_sink,
                 )
             event_loop.run_until_complete(storage.close())
+            _emit_snapshot_report(
+                kind="restore",
+                path=self.path,
+                pg_wrapper=pg_wrapper,
+                pipeline=telemetry.merge_pipeline_telemetry(pipeline_sink),
+                counter_baseline=counter_baseline,
+                nonce=restore_nonce,
+            )
         finally:
             event_loop.close()
 
@@ -660,6 +775,7 @@ class Snapshot:
             world_size=world_size,
             rng_key=rng_key,
             restore_nonce=restore_nonce,
+            counter_baseline=telemetry.metrics().counters_snapshot(),
         )
 
     def _load_stateful(
@@ -672,10 +788,13 @@ class Snapshot:
         event_loop: asyncio.AbstractEventLoop,
         rank: int,
         checksum_table=None,
+        pipeline_sink: Optional[List[dict]] = None,
     ) -> None:
         """Memory-frugal restore of one stateful: reuse the leaves already
         allocated in its current state dict as read destinations so peak
-        footprint stays ~1x (reference snapshot.py:668-766)."""
+        footprint stays ~1x (reference snapshot.py:668-766).
+        ``pipeline_sink`` collects the read pipeline's telemetry for the
+        caller's SnapshotReport."""
         plan = self._plan_stateful_load(
             key, stateful, available, memory_budget_bytes
         )
@@ -690,7 +809,7 @@ class Snapshot:
         # remaining reads are still in flight.
         placer = _StreamingPlacer()
         placer.register_plan(plan)
-        sync_execute_read_reqs(
+        pipeline_telemetry = sync_execute_read_reqs(
             read_reqs=read_reqs,
             storage=storage,
             memory_budget_bytes=memory_budget_bytes,
@@ -699,6 +818,8 @@ class Snapshot:
             checksum_table=checksum_table,
             on_req_complete=placer.on_req_complete,
         )
+        if pipeline_sink is not None:
+            pipeline_sink.append(pipeline_telemetry)
         placer.flush()
         plan.finish_reads()
         plan.apply()
@@ -1101,6 +1222,7 @@ class PendingSnapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         commit_nonce: str = "",
+        counter_baseline: Optional[Dict[str, float]] = None,
     ) -> None:
         import threading
 
@@ -1111,6 +1233,7 @@ class PendingSnapshot:
         self._storage = storage
         self._event_loop = event_loop
         self._pending_io_work = pending_io_work
+        self._counter_baseline = counter_baseline or {}
         self._exc_info: Optional[BaseException] = None
         self._done = threading.Event()
         self._thread = threading.Thread(
@@ -1141,6 +1264,18 @@ class PendingSnapshot:
             if barrier is not None:
                 barrier.depart()
             self._event_loop.run_until_complete(self._storage.close())
+            # Store-based gather + local file append only — safe on this
+            # background thread (no collectives), same rule the commit
+            # barrier follows. Post-close so a tiered take's report sees
+            # its just-enqueued mirror job.
+            _emit_snapshot_report(
+                kind="async_take",
+                path=self.path,
+                pg_wrapper=self.pg,
+                pipeline=self._pending_io_work.pipeline_telemetry(),
+                counter_baseline=self._counter_baseline,
+                nonce=self.commit_nonce,
+            )
         except BaseException as e:  # noqa: BLE001 - must propagate via wait()
             # Record the failure before telling peers: report_error talks to
             # the store and may itself fail, but wait() must still raise.
@@ -1193,6 +1328,7 @@ class PendingRestore:
         world_size: int,
         rng_key: Optional[str] = None,
         restore_nonce: Optional[str] = None,
+        counter_baseline: Optional[Dict[str, float]] = None,
     ) -> None:
         import threading
 
@@ -1205,6 +1341,8 @@ class PendingRestore:
         self._memory_budget_bytes = memory_budget_bytes
         self._rank = rank
         self._world_size = world_size
+        self._counter_baseline = counter_baseline or {}
+        self._pipeline_telemetry: Optional[dict] = None
         self._exc_info: Optional[BaseException] = None
         self._applied = False
         self._done = threading.Event()
@@ -1233,7 +1371,7 @@ class PendingRestore:
             placer = _StreamingPlacer()
             for plan in self._plans.values():
                 placer.register_plan(plan)
-            sync_execute_read_reqs(
+            self._pipeline_telemetry = sync_execute_read_reqs(
                 read_reqs=read_reqs,
                 storage=storage,
                 memory_budget_bytes=self._memory_budget_bytes,
@@ -1318,6 +1456,17 @@ class PendingRestore:
         # handle un-applied, so a retried wait() re-applies from the start
         # (deterministic) instead of silently succeeding half-restored.
         self._applied = True
+        # Local report only (nonce=None -> no cross-rank gather): wait()
+        # call times are application-controlled, and the emission must
+        # not add a rendezvous of its own to the apply schedule.
+        _emit_snapshot_report(
+            kind="async_restore",
+            path=self.path,
+            pg_wrapper=self._pg,
+            pipeline=self._pipeline_telemetry,
+            counter_baseline=self._counter_baseline,
+            nonce=None,
+        )
         # Release the checkpoint-sized host buffers the plans hold; the
         # handle itself may outlive the restore (done()-polling callers).
         self._plans = {}

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time
 from typing import Set
 
 try:
@@ -31,6 +32,7 @@ except ImportError:
 
 from .. import _native
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..telemetry import observe_io
 from ..utils.tracing import trace_annotation
 
 
@@ -56,6 +58,16 @@ class FSStoragePlugin(StoragePlugin):
             self._dir_cache.add(parent)
 
     async def write(self, write_io: WriteIO) -> None:
+        t0 = time.monotonic()
+        await self._write_impl(write_io)
+        observe_io(
+            "fs",
+            "write",
+            memoryview(write_io.buf).cast("B").nbytes,
+            time.monotonic() - t0,
+        )
+
+    async def _write_impl(self, write_io: WriteIO) -> None:
         full_path = self._full_path(write_io.path)
         await self._ensure_parent_dir(full_path)
         if self._native:
@@ -106,9 +118,30 @@ class FSStoragePlugin(StoragePlugin):
                 pages, memoryview(write_io.buf).cast("B").nbytes
             )
 
-        return await loop.run_in_executor(None, _write_crc)
+        t0 = time.monotonic()
+        entry = await loop.run_in_executor(None, _write_crc)
+        if entry is not None:
+            # A declined fused write wrote nothing; the scheduler's
+            # two-step fallback lands in write(), which accounts itself.
+            observe_io(
+                "fs",
+                "write",
+                memoryview(write_io.buf).cast("B").nbytes,
+                time.monotonic() - t0,
+            )
+        return entry
 
     async def read(self, read_io: ReadIO) -> None:
+        t0 = time.monotonic()
+        await self._read_dispatch(read_io)
+        observe_io(
+            "fs",
+            "read",
+            memoryview(read_io.buf).nbytes if read_io.buf is not None else 0,
+            time.monotonic() - t0,
+        )
+
+    async def _read_dispatch(self, read_io: ReadIO) -> None:
         full_path = self._full_path(read_io.path)
         if self._native:
             loop = asyncio.get_running_loop()
@@ -184,11 +217,15 @@ class FSStoragePlugin(StoragePlugin):
                     return None
                 return out, pages
 
+        t0 = time.monotonic()
         res = await loop.run_in_executor(None, _read_crc)
         if res is None:
             return None
         out, pages = res
         read_io.buf = out if out is read_io.dest else memoryview(out)
+        observe_io(
+            "fs", "read", memoryview(out).nbytes, time.monotonic() - t0
+        )
         return pages
 
     def _native_read(self, full_path: str, read_io: ReadIO):

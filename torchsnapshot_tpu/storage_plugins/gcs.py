@@ -23,8 +23,10 @@ import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
-from .. import knobs
+from .. import knobs, telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..telemetry import names as metric_names
+from ..telemetry import observe_io
 from .retry import CollectiveProgressRetryStrategy
 
 logger = logging.getLogger(__name__)
@@ -114,7 +116,7 @@ class GCSStoragePlugin(StoragePlugin):
             max_workers=knobs.get_per_rank_io_concurrency(),
             thread_name_prefix="gcs-io",
         )
-        self._retry = CollectiveProgressRetryStrategy()
+        self._retry = CollectiveProgressRetryStrategy(scope="gcs")
 
     # ------------------------------------------------------------------
 
@@ -170,6 +172,13 @@ class GCSStoragePlugin(StoragePlugin):
                 )
                 upload.recover(self._session)
                 recover_attempts += 1
+                # Session-recover attempts were previously counted here
+                # and dropped; the registry keeps them (they are the
+                # leading indicator of a browning-out backend, visible
+                # well before the collective deadline trips).
+                telemetry.metrics().counter_inc(
+                    metric_names.GCS_RECOVER_ATTEMPTS_TOTAL
+                )
 
     def _download_sync(
         self, path: str, byte_range: Optional[Tuple[int, int]]
@@ -240,7 +249,9 @@ class GCSStoragePlugin(StoragePlugin):
                 self._executor, self._upload_sync, write_io.path, data
             )
 
+        t0 = time.monotonic()
         await self._run_retrying(op)
+        observe_io("gcs", "write", len(data), time.monotonic() - t0)
 
     async def read(self, read_io: ReadIO) -> None:
         loop = asyncio.get_running_loop()
@@ -253,7 +264,9 @@ class GCSStoragePlugin(StoragePlugin):
                 read_io.byte_range,
             )
 
+        t0 = time.monotonic()
         read_io.buf = memoryview(await self._run_retrying(op))
+        observe_io("gcs", "read", read_io.buf.nbytes, time.monotonic() - t0)
 
     async def delete(self, path: str) -> None:
         loop = asyncio.get_running_loop()

@@ -18,6 +18,9 @@ import random
 import time
 from typing import Awaitable, Callable, Tuple, Type, TypeVar
 
+from .. import telemetry
+from ..telemetry import names as metric_names
+
 T = TypeVar("T")
 
 DEFAULT_PROGRESS_WINDOW_SECONDS = 128.0
@@ -33,7 +36,9 @@ class CollectiveProgressRetryStrategy:
     """Shared-deadline retry coordinator for one storage plugin instance."""
 
     def __init__(
-        self, progress_window_seconds: float = DEFAULT_PROGRESS_WINDOW_SECONDS
+        self,
+        progress_window_seconds: float = DEFAULT_PROGRESS_WINDOW_SECONDS,
+        scope: str = "",
     ) -> None:
         self.progress_window_seconds = progress_window_seconds
         # The window only starts ticking at the first observed failure (not
@@ -41,6 +46,14 @@ class CollectiveProgressRetryStrategy:
         # staging/collectives before its first storage op, and that quiet
         # period must not count against the retry budget.
         self._deadline: "float | None" = None
+        # Telemetry: which subsystem this strategy serves (labels the
+        # registry counters: "s3" | "gcs" | "mirror"), plus per-instance
+        # totals so a caller holding the strategy can read its own
+        # attempt/backoff history without registry arithmetic.
+        self.scope = scope
+        self.attempts_total = 0
+        self.backoff_s_total = 0.0
+        self.exhausted_total = 0
 
     def record_progress(self) -> None:
         """Any completed operation pushes the collective deadline out."""
@@ -64,11 +77,22 @@ class CollectiveProgressRetryStrategy:
             try:
                 result = await op()
             except retriable_exceptions as e:
+                registry = telemetry.metrics()
+                self.attempts_total += 1
+                registry.counter_inc(
+                    metric_names.STORAGE_RETRY_ATTEMPTS_TOTAL,
+                    scope=self.scope,
+                )
                 if self._deadline is None:
                     self._deadline = (
                         time.monotonic() + self.progress_window_seconds
                     )
                 if self.deadline_passed:
+                    self.exhausted_total += 1
+                    registry.counter_inc(
+                        metric_names.STORAGE_RETRIES_EXHAUSTED_TOTAL,
+                        scope=self.scope,
+                    )
                     raise RetriesExhausted(
                         f"No concurrent operation progressed within "
                         f"{self.progress_window_seconds:.0f}s; giving up "
@@ -76,8 +100,14 @@ class CollectiveProgressRetryStrategy:
                     ) from e
                 backoff = min(
                     _BACKOFF_MAX_SECONDS, _BACKOFF_BASE_SECONDS * (2**attempt)
+                ) * (0.5 + random.random() / 2)
+                self.backoff_s_total += backoff
+                registry.counter_inc(
+                    metric_names.STORAGE_RETRY_BACKOFF_SECONDS_TOTAL,
+                    backoff,
+                    scope=self.scope,
                 )
-                await asyncio.sleep(backoff * (0.5 + random.random() / 2))
+                await asyncio.sleep(backoff)
                 attempt += 1
             else:
                 self.record_progress()

@@ -13,9 +13,11 @@ from __future__ import annotations
 import asyncio
 import errno
 import logging
+import time
 
 from .. import knobs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..telemetry import observe_io
 from .retry import CollectiveProgressRetryStrategy
 
 logger = logging.getLogger(__name__)
@@ -76,7 +78,7 @@ class S3StoragePlugin(StoragePlugin):
         self._client_ctx = None
         self._client = None
         self._client_lock = asyncio.Lock()
-        self._retry = CollectiveProgressRetryStrategy()
+        self._retry = CollectiveProgressRetryStrategy(scope="s3")
 
     def _key(self, path: str) -> str:
         from ..storage_plugin import normalize_object_key
@@ -127,7 +129,14 @@ class S3StoragePlugin(StoragePlugin):
                 Body=MemoryviewStream(memoryview(write_io.buf)),
             )
 
+        t0 = time.monotonic()
         await self._run_retrying(op)
+        observe_io(
+            "s3",
+            "write",
+            memoryview(write_io.buf).cast("B").nbytes,
+            time.monotonic() - t0,
+        )
 
     async def read(self, read_io: ReadIO) -> None:
         client = await self._get_client()
@@ -170,7 +179,11 @@ class S3StoragePlugin(StoragePlugin):
             async with resp["Body"] as stream:
                 return await stream.read()
 
+        t0 = time.monotonic()
         read_io.buf = memoryview(await self._run_retrying(op))
+        observe_io(
+            "s3", "read", read_io.buf.nbytes, time.monotonic() - t0
+        )
 
     async def delete(self, path: str) -> None:
         client = await self._get_client()

@@ -1,0 +1,119 @@
+"""Unified checkpoint telemetry.
+
+One process-wide :class:`MetricsRegistry` (counters, gauges,
+fixed-bucket histograms — thread/asyncio-safe, always recording) that
+every layer instruments directly: the scheduler's phase completions and
+memory-budget waits, the storage plugins' byte/latency counters, the
+retry strategies' attempt counts, the tiered mirror's queue/lag gauges.
+Each ``Snapshot.take``/``async_take``/``restore`` and each mirror job
+additionally assembles a :class:`SnapshotReport` — a JSON-serializable
+per-operation record, cross-rank aggregated via ``dist_store.Store.gather``
+— and hands it to the knob-controlled sinks (JSONL event log,
+Prometheus text file). ``python -m torchsnapshot_tpu.telemetry`` /
+``tools/snapshot_stats.py`` render the event log as per-step tables.
+
+See docs/observability.md for the metric inventory, report schema,
+sink knobs, and CLI.
+"""
+
+from __future__ import annotations
+
+from . import names
+from .registry import (
+    DEFAULT_SECONDS_BUCKETS,
+    MetricsRegistry,
+    parse_series_key,
+    series_key,
+)
+from .report import (
+    SnapshotReport,
+    aggregate_across_ranks,
+    build_report,
+    merge_pipeline_telemetry,
+)
+from .sink import (
+    emit_report,
+    events_path_for,
+    load_events,
+    render_prometheus,
+    write_prometheus_textfile,
+)
+
+__all__ = [
+    "DEFAULT_SECONDS_BUCKETS",
+    "MetricsRegistry",
+    "SnapshotReport",
+    "aggregate_across_ranks",
+    "build_report",
+    "emit_report",
+    "events_path_for",
+    "load_events",
+    "merge_pipeline_telemetry",
+    "metrics",
+    "names",
+    "observe_io",
+    "parse_series_key",
+    "record_phase",
+    "render_prometheus",
+    "reset_metrics",
+    "safe_rate_mb_s",
+    "series_key",
+    "write_prometheus_textfile",
+]
+
+_REGISTRY = MetricsRegistry()
+
+# Below this elapsed time a bytes/elapsed rate is numerical noise: the
+# first report tick of an empty or instant phase would otherwise print
+# an effectively-infinite MB/s. One threshold for every rate renderer
+# (scheduler progress lines, snapshot-stats tables).
+MIN_RATE_ELAPSED_S = 1e-3
+
+
+def safe_rate_mb_s(nbytes: float, elapsed_s: float) -> float:
+    """Throughput in MB/s, 0.0 when the elapsed time is zero or too
+    small to carry signal (guards the div-by-~0 -> inf MB/s report)."""
+    if elapsed_s < MIN_RATE_ELAPSED_S:
+        return 0.0
+    return nbytes / 1024**2 / elapsed_s
+
+
+def metrics() -> MetricsRegistry:
+    """The process-wide registry every instrumented layer records into."""
+    return _REGISTRY
+
+
+def reset_metrics() -> None:
+    """Drop all recorded metrics (tests simulating a fresh process)."""
+    _REGISTRY.reset()
+
+
+def record_phase(phase: str, elapsed_s: float) -> None:
+    """Publish one pipeline-phase completion: feeds the registry's phase
+    histogram AND the last-writer-wins phase-timing channel that
+    ``scheduler.last_phase_timings()`` serves as a compatibility shim."""
+    _REGISTRY.record_phase_timing(phase, elapsed_s)
+    _REGISTRY.histogram_observe(
+        names.SNAPSHOT_PHASE_SECONDS, elapsed_s, phase=phase
+    )
+
+
+def observe_io(plugin: str, op: str, nbytes: int, seconds: float) -> None:
+    """One storage operation's accounting (op: "write" | "read"); the
+    shared instrumentation hook for the fs/s3/gcs plugins."""
+    if op == "write":
+        _REGISTRY.counter_inc(
+            names.STORAGE_WRITE_BYTES_TOTAL, nbytes, plugin=plugin
+        )
+        _REGISTRY.counter_inc(names.STORAGE_WRITE_OPS_TOTAL, plugin=plugin)
+        _REGISTRY.histogram_observe(
+            names.STORAGE_WRITE_SECONDS, seconds, plugin=plugin
+        )
+    else:
+        _REGISTRY.counter_inc(
+            names.STORAGE_READ_BYTES_TOTAL, nbytes, plugin=plugin
+        )
+        _REGISTRY.counter_inc(names.STORAGE_READ_OPS_TOTAL, plugin=plugin)
+        _REGISTRY.histogram_observe(
+            names.STORAGE_READ_SECONDS, seconds, plugin=plugin
+        )

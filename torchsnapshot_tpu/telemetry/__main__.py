@@ -1,0 +1,8 @@
+"""``python -m torchsnapshot_tpu.telemetry <events.jsonl>``."""
+
+import sys
+
+from .stats import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Canonical metric names — the single registration point.
+
+Every metric the package emits is declared here exactly once, as a
+snake_case constant, and call sites reference the constant (never a
+string literal). ``tools/check_metric_names.py`` enforces both halves
+statically: a literal metric name at a call site, a non-snake_case
+value, or a duplicate declaration fails the lane. This is what keeps
+the exposition namespace stable enough for dashboards to key off.
+
+Label conventions (labels are free-form at call sites, but keep them
+small and low-cardinality):
+
+- ``phase``:  staging | writing | loading | mirroring
+- ``plugin``: fs | s3 | gcs | memory | tiered
+- ``scope``:  which retry strategy instance (s3 | gcs | mirror)
+- ``kind``:   take | async_take | restore | async_restore | mirror
+"""
+
+# -- pipeline (scheduler.py) -------------------------------------------------
+
+SNAPSHOT_PHASE_SECONDS = "snapshot_phase_seconds"
+MEMORY_BUDGET_WAIT_SECONDS = "memory_budget_wait_seconds"
+MEMORY_BUDGET_PEAK_STAGED_BYTES = "memory_budget_peak_staged_bytes"
+
+# -- storage plugins (storage_plugins/{fs,s3,gcs}.py) ------------------------
+
+STORAGE_WRITE_BYTES_TOTAL = "storage_write_bytes_total"
+STORAGE_WRITE_OPS_TOTAL = "storage_write_ops_total"
+STORAGE_WRITE_SECONDS = "storage_write_seconds"
+STORAGE_READ_BYTES_TOTAL = "storage_read_bytes_total"
+STORAGE_READ_OPS_TOTAL = "storage_read_ops_total"
+STORAGE_READ_SECONDS = "storage_read_seconds"
+
+# -- retry machinery (storage_plugins/retry.py, gcs.py) ----------------------
+
+STORAGE_RETRY_ATTEMPTS_TOTAL = "storage_retry_attempts_total"
+STORAGE_RETRY_BACKOFF_SECONDS_TOTAL = "storage_retry_backoff_seconds_total"
+STORAGE_RETRIES_EXHAUSTED_TOTAL = "storage_retries_exhausted_total"
+GCS_RECOVER_ATTEMPTS_TOTAL = "gcs_recover_attempts_total"
+
+# -- tiered mirror (tiered/mirror.py) ----------------------------------------
+
+MIRROR_BLOBS_PENDING = "mirror_blobs_pending"
+MIRROR_BLOBS_INFLIGHT = "mirror_blobs_inflight"
+MIRROR_BLOBS_DONE_TOTAL = "mirror_blobs_done_total"
+MIRROR_BYTES_TOTAL = "mirror_bytes_total"
+MIRROR_SNAPSHOTS_PENDING = "mirror_snapshots_pending"
+MIRROR_JOBS_DONE_TOTAL = "mirror_jobs_done_total"
+MIRROR_JOBS_FAILED_TOTAL = "mirror_jobs_failed_total"
+MIRROR_RESUME_TOTAL = "mirror_resume_total"
+MIRROR_UPLOAD_LAG_SECONDS = "mirror_upload_lag_seconds"
+
+# -- manager (manager.py) ----------------------------------------------------
+
+MANAGER_SAVES_TOTAL = "manager_saves_total"
+MANAGER_RESTORES_TOTAL = "manager_restores_total"
+MANAGER_GC_STEPS_TOTAL = "manager_gc_steps_total"
+MANAGER_RETAINED_STEPS = "manager_retained_steps"
+
+# -- reports / sinks (telemetry/sink.py) -------------------------------------
+
+SNAPSHOT_REPORTS_TOTAL = "snapshot_reports_total"
+
+# -- utilities (utils/rss_profiler.py) ---------------------------------------
+
+RSS_PEAK_DELTA_BYTES = "rss_peak_delta_bytes"

@@ -1,0 +1,219 @@
+"""SnapshotReport: one JSON-serializable record per checkpoint operation.
+
+Every ``Snapshot.take`` / ``async_take`` / ``restore`` /
+``async_restore`` and every tiered mirror job produces one of these.
+The record is assembled from two sources:
+
+- the **pipeline telemetry** the scheduler hands back per run (per-phase
+  wall-clock durations, bytes/blob counts, memory-budget wait time, peak
+  staged bytes) — exact for the operation;
+- **registry counter deltas** over the operation's window (per-plugin
+  byte/op counts, retry/recover attempts) — process-global, so
+  concurrent work (e.g. a mirror draining during the next take) lands
+  in the same window; the exact scheduler numbers are authoritative
+  where they overlap.
+
+Cross-rank: each rank builds its own report; rank 0 gathers the per-rank
+dicts over ``dist_store.Store.gather`` and attaches min/median/max and
+the straggler rank per phase (``aggregate_across_ranks``), which is what
+FastPersist-style stall hunting actually needs — a single wall-clock
+number per phase cannot show one slow rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import statistics
+import time
+from typing import Any, Dict, List, Optional
+
+from . import names
+from .registry import parse_series_key
+
+SCHEMA_VERSION = 1
+
+# Registry counter names folded into the report's per-plugin table.
+_PLUGIN_COUNTERS = {
+    names.STORAGE_WRITE_BYTES_TOTAL: "write_bytes",
+    names.STORAGE_WRITE_OPS_TOTAL: "write_ops",
+    names.STORAGE_READ_BYTES_TOTAL: "read_bytes",
+    names.STORAGE_READ_OPS_TOTAL: "read_ops",
+}
+# ...and into the retry table (summed across scopes/labels).
+_RETRY_COUNTERS = {
+    names.STORAGE_RETRY_ATTEMPTS_TOTAL: "attempts",
+    names.STORAGE_RETRY_BACKOFF_SECONDS_TOTAL: "backoff_s",
+    names.STORAGE_RETRIES_EXHAUSTED_TOTAL: "exhausted",
+    names.GCS_RECOVER_ATTEMPTS_TOTAL: "gcs_recover_attempts",
+}
+
+
+@dataclasses.dataclass
+class SnapshotReport:
+    """Schema (all fields JSON-serializable; see docs/observability.md):
+
+    - ``kind``: take | async_take | restore | async_restore | mirror
+    - ``phases``: phase -> seconds (pipeline wall-clock at completion)
+    - ``plugins``: plugin -> {write_bytes, write_ops, read_bytes,
+      read_ops} counter deltas over the operation
+    - ``retries``: {attempts, backoff_s, exhausted,
+      gcs_recover_attempts} deltas — always present, zero-filled
+    - ``mirror``: tiered operations only — the process mirror's state at
+      assembly (upload lag, queue depth); mirror-kind reports carry the
+      finished job's own numbers instead
+    - ``aggregated``: rank 0 only, world > 1 — per-phase
+      {min, median, max, straggler (rank)} across the gathered reports
+    """
+
+    kind: str
+    path: str
+    rank: int = 0
+    world_size: int = 1
+    unix_ts: float = 0.0
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    plugins: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+    bytes_moved: int = 0
+    blobs: int = 0
+    budget_wait_s: float = 0.0
+    peak_staged_bytes: int = 0
+    retries: Dict[str, float] = dataclasses.field(default_factory=dict)
+    mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    aggregated: Optional[Dict[str, Dict[str, float]]] = None
+    error: Optional[str] = None
+    schema_version: int = SCHEMA_VERSION
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "SnapshotReport":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def merge_pipeline_telemetry(
+    pipelines: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Fold several pipeline-telemetry dicts (a restore runs one read
+    pipeline per stateful) into one: bytes/blobs/wait sum, per-phase
+    durations sum (each pipeline's phase is its own wall-clock span),
+    peak staged bytes max."""
+    out: Dict[str, Any] = {
+        "phases": {},
+        "bytes_moved": 0,
+        "blobs": 0,
+        "budget_wait_s": 0.0,
+        "peak_staged_bytes": 0,
+    }
+    for p in pipelines:
+        for phase, s in p.get("phases", {}).items():
+            out["phases"][phase] = round(
+                out["phases"].get(phase, 0.0) + s, 3
+            )
+        out["bytes_moved"] += p.get("bytes_moved", 0)
+        out["blobs"] += p.get("blobs", 0)
+        out["budget_wait_s"] += p.get("budget_wait_s", 0.0)
+        out["peak_staged_bytes"] = max(
+            out["peak_staged_bytes"], p.get("peak_staged_bytes", 0)
+        )
+    out["budget_wait_s"] = round(out["budget_wait_s"], 6)
+    return out
+
+
+def plugins_from_deltas(
+    deltas: Dict[str, float]
+) -> Dict[str, Dict[str, float]]:
+    """Per-plugin table from flattened registry counter deltas."""
+    out: Dict[str, Dict[str, float]] = {}
+    for series, value in deltas.items():
+        name, labels = parse_series_key(series)
+        field = _PLUGIN_COUNTERS.get(name)
+        if field is None:
+            continue
+        plugin = labels.get("plugin", "unknown")
+        out.setdefault(plugin, {})[field] = value
+    return out
+
+
+def retries_from_deltas(deltas: Dict[str, float]) -> Dict[str, float]:
+    """Retry table from counter deltas; every key present (zero-filled)
+    so report consumers never need existence checks."""
+    out = {field: 0.0 for field in _RETRY_COUNTERS.values()}
+    for series, value in deltas.items():
+        name, _ = parse_series_key(series)
+        field = _RETRY_COUNTERS.get(name)
+        if field is not None:
+            out[field] += value
+    return out
+
+
+def build_report(
+    kind: str,
+    path: str,
+    rank: int,
+    world_size: int,
+    pipeline: Optional[Dict[str, Any]],
+    counter_deltas: Dict[str, float],
+    mirror: Optional[Dict[str, Any]] = None,
+    error: Optional[str] = None,
+) -> SnapshotReport:
+    pipeline = pipeline or {}
+    return SnapshotReport(
+        kind=kind,
+        path=path,
+        rank=rank,
+        world_size=world_size,
+        unix_ts=time.time(),
+        phases=dict(pipeline.get("phases", {})),
+        plugins=plugins_from_deltas(counter_deltas),
+        bytes_moved=int(pipeline.get("bytes_moved", 0)),
+        blobs=int(pipeline.get("blobs", 0)),
+        budget_wait_s=float(pipeline.get("budget_wait_s", 0.0)),
+        peak_staged_bytes=int(pipeline.get("peak_staged_bytes", 0)),
+        retries=retries_from_deltas(counter_deltas),
+        mirror=dict(mirror or {}),
+        error=error,
+    )
+
+
+def aggregate_across_ranks(
+    rank_reports: List[Dict[str, Any]]
+) -> Dict[str, Dict[str, float]]:
+    """Per-phase min/median/max/straggler across gathered report dicts
+    (rank order), plus the same spread for total bytes and budget wait.
+    The straggler is the *rank index* of the max — the number an
+    operator pages on."""
+    out: Dict[str, Dict[str, float]] = {}
+
+    def spread(metric: str, values: List[float]) -> None:
+        if not values:
+            return
+        out[metric] = {
+            "min": round(min(values), 3),
+            "median": round(statistics.median(values), 3),
+            "max": round(max(values), 3),
+            "straggler": values.index(max(values)),
+        }
+
+    phase_names = sorted(
+        {p for r in rank_reports for p in r.get("phases", {})}
+    )
+    for phase in phase_names:
+        spread(
+            f"phase_{phase}_s",
+            [float(r.get("phases", {}).get(phase, 0.0)) for r in rank_reports],
+        )
+    spread(
+        "bytes_moved", [float(r.get("bytes_moved", 0)) for r in rank_reports]
+    )
+    spread(
+        "budget_wait_s",
+        [float(r.get("budget_wait_s", 0.0)) for r in rank_reports],
+    )
+    return out

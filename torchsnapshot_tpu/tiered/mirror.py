@@ -35,11 +35,12 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .. import knobs
+from .. import knobs, telemetry
 from ..event_loop import run_in_fresh_event_loop
 from ..io_types import ReadIO, WriteIO
 from ..storage_plugin import split_tiered_url, url_to_storage_plugin
 from ..storage_plugins.retry import CollectiveProgressRetryStrategy
+from ..telemetry import names as metric_names
 from ..utils.tracing import trace_annotation
 from .journal import MirrorJournal
 
@@ -77,6 +78,10 @@ class MirrorJob:
         self.done_evt = threading.Event()
         self.error: Optional[BaseException] = None
         self.cancelled = False
+        # Per-job progress (this job only, unlike the Mirror's process
+        # totals): feeds the job's SnapshotReport at completion.
+        self.blobs_done = 0
+        self.bytes_done = 0
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done_evt.wait(timeout)
@@ -148,9 +153,13 @@ class Mirror:
         if plan is None:
             return None
         blobs, metadata_path = plan
-        return self.enqueue(
+        job = self.enqueue(
             fast_url, durable_url, blobs, metadata_path, fresh=False
         )
+        # Journal/manifest resume count: how often this process picked up
+        # interrupted mirrors — a restart-frequency signal on its own.
+        telemetry.metrics().counter_inc(metric_names.MIRROR_RESUME_TOTAL)
+        return job
 
     def cancel_path(self, fast_url: str) -> None:
         """Best-effort cancel of queued/running jobs for one fast root —
@@ -211,7 +220,7 @@ class Mirror:
                 lag = time.monotonic() - min(
                     j.created_ts for j in pending_jobs
                 )
-            return {
+            out = {
                 "blobs_pending": max(0, blobs_pending),
                 "blobs_inflight": self._blobs_inflight,
                 "blobs_done": self._blobs_done,
@@ -221,6 +230,27 @@ class Mirror:
                 "failures": self._failures,
                 "upload_lag_s": round(lag, 3),
             }
+        self._publish_gauges(out)
+        return out
+
+    @staticmethod
+    def _publish_gauges(m: Dict[str, float]) -> None:
+        """Mirror state -> registry gauges (queue depth / lag are the
+        operator's 'is durability keeping up with the take cadence'
+        signals). Called on every metrics() read and at job settle."""
+        registry = telemetry.metrics()
+        registry.gauge_set(
+            metric_names.MIRROR_BLOBS_PENDING, m["blobs_pending"]
+        )
+        registry.gauge_set(
+            metric_names.MIRROR_BLOBS_INFLIGHT, m["blobs_inflight"]
+        )
+        registry.gauge_set(
+            metric_names.MIRROR_SNAPSHOTS_PENDING, m["snapshots_pending"]
+        )
+        registry.gauge_set(
+            metric_names.MIRROR_UPLOAD_LAG_SECONDS, m["upload_lag_s"]
+        )
 
     # -- worker ----------------------------------------------------------
 
@@ -257,9 +287,63 @@ class Mirror:
             finally:
                 from ..scheduler import record_phase_timing
 
-                record_phase_timing("mirroring", time.monotonic() - began)
+                elapsed = time.monotonic() - began
+                record_phase_timing("mirroring", elapsed)
+                # Telemetry settles BEFORE the done event: a waiter that
+                # unblocks on wait_durable() must find the job's report
+                # already in the event log.
+                self._settle_telemetry(job, elapsed)
                 job.done_evt.set()
+                try:
+                    # Gauge refresh AFTER the event: the queue-depth/lag
+                    # gauges must not still count this settled job.
+                    self.metrics()
+                except Exception:  # noqa: BLE001 - telemetry is best-effort
+                    pass
                 self._queue.task_done()
+
+    def _settle_telemetry(self, job: MirrorJob, elapsed: float) -> None:
+        """Registry counters/gauges + the job's SnapshotReport (kind
+        "mirror"): the per-job record of what replication actually cost,
+        including the durability lag — how long the step's data existed
+        only on the fast tier. Best-effort: telemetry never fails a job."""
+        try:
+            registry = telemetry.metrics()
+            registry.counter_inc(metric_names.MIRROR_JOBS_DONE_TOTAL)
+            if job.error is not None and not job.cancelled:
+                # A GC-cancelled job is expected behavior (the step left
+                # both tiers), not a failure an operator should alert on.
+                registry.counter_inc(metric_names.MIRROR_JOBS_FAILED_TOTAL)
+            registry.counter_inc(
+                metric_names.MIRROR_BLOBS_DONE_TOTAL, job.blobs_done
+            )
+            registry.counter_inc(
+                metric_names.MIRROR_BYTES_TOTAL, job.bytes_done
+            )
+            if job.cancelled:
+                # No sink append for a cancelled job: the step is being
+                # GC'd and the snapshot-adjacent sink would resurrect the
+                # just-deleted fast step directory as an orphan (same
+                # hazard _run_job guards its journal.save against).
+                return
+            report = telemetry.SnapshotReport(
+                kind="mirror",
+                path=f"tiered://{job.fast_url}|{job.durable_url}",
+                unix_ts=time.time(),
+                phases={"mirroring": round(elapsed, 3)},
+                bytes_moved=job.bytes_done,
+                blobs=job.blobs_done,
+                mirror={
+                    "lag_s": round(time.monotonic() - job.created_ts, 3),
+                    "blobs_total": len(job.blobs),
+                    "cancelled": job.cancelled,
+                    "resumed": not job.fresh,
+                },
+                error=repr(job.error) if job.error is not None else None,
+            )
+            telemetry.emit_report(report, registry)
+        except Exception as e:  # noqa: BLE001 - telemetry is best-effort
+            logger.warning("mirror telemetry emission failed: %r", e)
 
     async def _run_job(self, job: MirrorJob) -> None:
         fast = url_to_storage_plugin(job.fast_url)
@@ -278,7 +362,8 @@ class Mirror:
             retry = CollectiveProgressRetryStrategy(
                 progress_window_seconds=(
                     knobs.get_mirror_progress_window_seconds()
-                )
+                ),
+                scope="mirror",
             )
             slots = asyncio.Semaphore(knobs.get_mirror_io_concurrency())
 
@@ -328,6 +413,8 @@ class Mirror:
                 for fut in asyncio.as_completed(tasks):
                     path, nbytes = await fut
                     journal.done.add(path)
+                    job.blobs_done += 1
+                    job.bytes_done += nbytes
                     with self._lock:
                         self._blobs_done += 1
                         self._bytes_mirrored += nbytes
@@ -356,6 +443,8 @@ class Mirror:
                 nbytes = await copy_one(meta)
                 journal.done.add(meta)
                 journal.durable_committed = True
+                job.blobs_done += 1
+                job.bytes_done += nbytes
                 with self._lock:
                     self._blobs_done += 1
                     self._bytes_mirrored += nbytes

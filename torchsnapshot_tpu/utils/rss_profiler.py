@@ -16,6 +16,9 @@ from typing import Generator, List
 
 import psutil
 
+from .. import telemetry
+from ..telemetry import names as metric_names
+
 _SAMPLE_PERIOD_SECONDS = 0.1
 
 
@@ -35,14 +38,25 @@ def measure_rss_deltas(
     rss_deltas: RSSDeltas,
     sample_period_seconds: float = _SAMPLE_PERIOD_SECONDS,
 ) -> Generator[None, None, None]:
-    """Sample RSS deltas into ``rss_deltas`` until the block exits."""
+    """Sample RSS deltas into ``rss_deltas`` until the block exits.
+
+    The sampler thread is joined on EVERY exit path (the block raising
+    included), and its peak delta feeds the telemetry registry's
+    ``rss_peak_delta_bytes`` gauge — bench runs and snapshot reports
+    read memory pressure from the same place."""
     process = psutil.Process()
     baseline = process.memory_info().rss
     stop = threading.Event()
 
     def sampler() -> None:
         while not stop.is_set():
-            rss_deltas.deltas.append(process.memory_info().rss - baseline)
+            try:
+                rss_deltas.deltas.append(
+                    process.memory_info().rss - baseline
+                )
+            except Exception:  # noqa: BLE001 - a failed sample must not
+                # wedge the thread (join below would then hang forever)
+                break
             stop.wait(sample_period_seconds)
 
     thread = threading.Thread(
@@ -52,6 +66,13 @@ def measure_rss_deltas(
     try:
         yield
     finally:
+        # Unconditional stop+join FIRST: nothing before the join may
+        # raise, or an exception in the block would leak the sampler.
         stop.set()
         thread.join()
-        rss_deltas.deltas.append(process.memory_info().rss - baseline)
+        try:
+            rss_deltas.deltas.append(process.memory_info().rss - baseline)
+        finally:
+            telemetry.metrics().gauge_set(
+                metric_names.RSS_PEAK_DELTA_BYTES, rss_deltas.peak_bytes
+            )
