@@ -641,6 +641,13 @@ def main() -> None:
                 f"({probe_streams}x{chunk} MiB): {mc:.3f} GB/s"
             )
 
+        # Flight-recorder trace export ON for the timed takes: a trial
+        # that trips the in-take stall heuristic embeds its own span
+        # evidence in the record (the recorder always runs; this knob
+        # only adds one small JSON dump per take — noise against the
+        # GiB-scale writes being timed).
+        os.environ.setdefault("TORCHSNAPSHOT_TPU_TRACE", "1")
+        stall_trace_info = {}
         matched_probe("before take 0")
         for i in range(trials):
             if i > 0 and not _have_budget(
@@ -658,6 +665,30 @@ def main() -> None:
                 f"(phases {take_phases[-1]})"
             )
             matched_probe(f"after take {i}")
+            # Stall self-diagnosis runs NOW, not after the loop: the
+            # snap dir (and its .trace-take-rank0.json) is deleted
+            # before the next trial, so the top spans must be read
+            # while the evidence exists. Same ratio formula as
+            # _bracketed_efficiency / the in_take_stall flag below.
+            a, b = matched_probes[i], matched_probes[i + 1]
+            stable = min(a, b) > 0 and max(a, b) / min(a, b) <= 1.5
+            if stable and (gib / take_times[-1]) / max(a, b) < 0.5:
+                # Resolve through the sink's own path logic: with
+                # TORCHSNAPSHOT_TPU_TRACE_DIR set, the export went there,
+                # not next to the snapshot.
+                from torchsnapshot_tpu.telemetry.trace import (
+                    longest_spans,
+                    trace_path_for,
+                )
+
+                trace_file = trace_path_for(path, "take", 0)
+                info = {"trace_file": trace_file}
+                try:
+                    info["top_spans"] = longest_spans(trace_file, 3)
+                except Exception as e:  # noqa: BLE001 - diagnosis is
+                    # advisory; the stall flag itself must survive
+                    info["top_spans_error"] = repr(e)
+                stall_trace_info[i] = info
             # Partial records carry the raw series as it lands — a kill
             # mid-loop still leaves every completed trial in the record.
             RESULT["take_times_s"] = [round(t, 2) for t in take_times]
@@ -690,18 +721,21 @@ def main() -> None:
             a, b = matched_probes[i], matched_probes[i + 1]
             stable = min(a, b) > 0 and max(a, b) / min(a, b) <= 1.5
             phases = take_phases[i] or {}
-            diagnostics.append(
-                {
-                    "take_s": round(t, 2),
-                    "bracket_gbps": [round(a, 3), round(b, 3)],
-                    "ratio": round(ratios[i], 3) if i < len(ratios) else None,
-                    "in_take_stall": bool(
-                        stable and i < len(ratios) and ratios[i] < 0.5
-                    ),
-                    "staging_done_s": phases.get("staging"),
-                    "writing_done_s": phases.get("writing"),
-                }
-            )
+            diag = {
+                "take_s": round(t, 2),
+                "bracket_gbps": [round(a, 3), round(b, 3)],
+                "ratio": round(ratios[i], 3) if i < len(ratios) else None,
+                "in_take_stall": bool(
+                    stable and i < len(ratios) and ratios[i] < 0.5
+                ),
+                "staging_done_s": phases.get("staging"),
+                "writing_done_s": phases.get("writing"),
+            }
+            # Flight-recorder evidence captured at trial time: the trace
+            # file path and its top-3 longest spans make a stalled
+            # BENCH_r*.json self-explaining.
+            diag.update(stall_trace_info.get(i, {}))
+            diagnostics.append(diag)
         _log(
             f"bench: matched-probe series "
             f"{[round(c, 3) for c in matched_probes]} GB/s "

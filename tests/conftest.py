@@ -15,6 +15,12 @@ chip instead.
 
 import os
 
+# Stall watchdog off by default in the suite (0 disables): the fast
+# lane must never pay for (or get flagged by) a 60 s-deadline scanner.
+# Tests that exercise the watchdog opt back in via
+# knobs.override_watchdog_deadline_seconds().
+os.environ.setdefault("TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS", "0")
+
 if os.environ.get("TS_TEST_ON_TPU") != "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")

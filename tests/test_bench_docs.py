@@ -110,6 +110,63 @@ def test_metric_names_are_lane_correct(capsys):
     assert rc == 0, capsys.readouterr().out
 
 
+def test_span_names_are_lane_correct(capsys):
+    """Flight-recorder span/instant names: colon-case, declared exactly
+    once in telemetry/names.py, call sites use the constants."""
+    rc = _run_tool("check_span_names.py")
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_span_name_check_catches_violations(tmp_path):
+    mod = _load_tool("check_span_names.py")
+    names = tmp_path / "names.py"
+    # non-colon-case value + duplicate value + duplicate constant; the
+    # metric constant is ignored by this checker.
+    names.write_text(
+        'SPAN_GOOD = "layer:op"\n'
+        'SPAN_BAD = "no_colons_here"\n'
+        'SPAN_DUP = "layer:op"\n'
+        'SPAN_GOOD = "other:op"\n'
+        'SOME_METRIC = "a_metric"\n'
+    )
+    errors = mod.check_names_file(names)
+    assert any("colon-case" in e for e in errors)
+    assert any("registered twice" in e for e in errors)
+    assert any("assigned twice" in e for e in errors)
+    assert mod.check_names_file(tmp_path / "absent.py") == [
+        "absent.py: missing (span names must be declared here)"
+    ]
+    # A literal span name at a call site is flagged; constants are not,
+    # and non-trace callables are ignored.
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'with trace_annotation("literal:name"):\n    pass\n'
+        "with trace_annotation(names.SPAN_GOOD):\n    pass\n"
+        'rec.span("another:literal")\n'
+        'rec.instant(names.SPAN_GOOD, note="x")\n'
+        'other.method("not:checked")\n'
+    )
+    errors = mod.check_call_sites(pkg, exempt=set())
+    assert len(errors) == 2
+    assert any("literal:name" in e for e in errors)
+    assert any("another:literal" in e for e in errors)
+
+
+def test_metric_name_check_accepts_colon_case_span_constants(tmp_path):
+    """check_metric_names shares names.py with the span constants: a
+    SPAN_/INSTANT_ value is linted colon-case, not snake_case."""
+    mod = _load_tool("check_metric_names.py")
+    names = tmp_path / "names.py"
+    names.write_text(
+        'GOOD = "good_metric"\n'
+        'SPAN_OK = "layer:op"\n'
+        'SPAN_BAD = "NotColonCase"\n'
+    )
+    errors = mod.check_names_file(names)
+    assert len(errors) == 1 and "colon-case" in errors[0]
+
+
 def test_metric_name_check_catches_violations(tmp_path):
     mod = _load_tool("check_metric_names.py")
     names = tmp_path / "names.py"

@@ -27,11 +27,18 @@ PACKAGE = ROOT / "torchsnapshot_tpu"
 NAMES_FILE = PACKAGE / "telemetry" / "names.py"
 
 _SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+# Flight-recorder span/instant names (SPAN_/INSTANT_ constants) use a
+# colon-case "layer:operation" convention; tools/check_span_names.py
+# owns their call-site rules, but declaration hygiene (declared once,
+# well-formed) is enforced here alongside the metrics.
+_COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
+_SPAN_PREFIXES = ("SPAN_", "INSTANT_")
 _REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
 
 
 def check_names_file(path: Path):
-    """Errors in the declaration file: non-snake_case values, duplicate
+    """Errors in the declaration file: malformed values (snake_case for
+    metrics, colon-case for SPAN_/INSTANT_ trace names), duplicate
     constants, duplicate values."""
     errors = []
     if not path.exists():
@@ -54,7 +61,14 @@ def check_names_file(path: Path):
                 )
                 continue
             value = node.value.value
-            if not _SNAKE_CASE.match(value):
+            if target.id.startswith(_SPAN_PREFIXES):
+                if not _COLON_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"colon-case (span/instant names look like "
+                        f"'layer:operation')"
+                    )
+            elif not _SNAKE_CASE.match(value):
                 errors.append(
                     f"{path.name}:{node.lineno}: {value!r} is not "
                     f"snake_case"

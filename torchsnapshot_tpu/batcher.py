@@ -37,6 +37,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import _native, knobs
+from .telemetry import names as metric_names
+from .telemetry.trace import get_recorder as _trace_recorder
 from .io_types import (
     BufferConsumer,
     BufferStager,
@@ -219,6 +221,18 @@ class BatchedBufferStager(BufferStager):
         view[offset : offset + size] = mv
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        # Recorder-only span (awaits inside): the slab's whole
+        # pack+memcpy assembly as one timeline block.
+        with _trace_recorder().span(
+            metric_names.SPAN_BATCHER_STAGE_SLAB,
+            members=len(self.members),
+            bytes=self.total,
+        ):
+            return await self._stage_buffer_impl(executor)
+
+    async def _stage_buffer_impl(
+        self, executor: Optional[Executor] = None
+    ) -> BufferType:
         slab = bytearray(self.total)
         view = memoryview(slab)
         loop = asyncio.get_running_loop()
@@ -348,15 +362,22 @@ class BatchedBufferConsumer(BufferConsumer):
         mv = memoryview(buf)
         if mv.format != "B" or mv.ndim != 1:
             mv = mv.cast("B")
-        await asyncio.gather(
-            *(
-                member.buffer_consumer.consume_buffer(
-                    mv[member.byte_range[0] - self.base : member.byte_range[1] - self.base],
-                    executor,
+        # Recorder-only span: the spanning read's fan-out to member
+        # consumers, previously invisible on any timeline.
+        with _trace_recorder().span(
+            metric_names.SPAN_BATCHER_CONSUME_SPANNING,
+            members=len(self.members),
+            bytes=self.span_bytes,
+        ):
+            await asyncio.gather(
+                *(
+                    member.buffer_consumer.consume_buffer(
+                        mv[member.byte_range[0] - self.base : member.byte_range[1] - self.base],
+                        executor,
+                    )
+                    for member in self.members
                 )
-                for member in self.members
             )
-        )
 
     def get_consuming_cost_bytes(self) -> int:
         # The spanning buffer itself (gap bytes included) dominates; the

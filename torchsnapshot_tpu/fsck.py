@@ -419,6 +419,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = verify_snapshot(args.path, deep=args.deep, tier=args.tier)
     if args.stats:
         from .telemetry.stats import find_events_for, render_summary
+        from .telemetry.trace import find_trace_files, longest_spans
 
         events = find_events_for(args.path)
         print()
@@ -431,6 +432,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "it with TORCHSNAPSHOT_TPU_TELEMETRY=1 for the "
                 "snapshot-adjacent sink, or run this command with the "
                 "same TORCHSNAPSHOT_TPU_TELEMETRY_DIR the take used)"
+            )
+        trace_files = find_trace_files(args.path)
+        if trace_files:
+            print()
+            print(f"flight-recorder traces ({len(trace_files)} file(s)):")
+            for tf in trace_files:
+                try:
+                    tops = longest_spans(tf, 3)
+                except Exception as e:  # noqa: BLE001 - stats are advisory
+                    print(f"  {tf}: unreadable ({e!r})")
+                    continue
+                top_str = ", ".join(
+                    f"{t['name']}={t['dur_ms']}ms" for t in tops
+                )
+                print(f"  {tf}: {top_str}")
+            print(
+                "  merge + straggler summary: "
+                "python -m torchsnapshot_tpu.telemetry trace <snapshot>"
             )
         print()
     for prob in report.problems:

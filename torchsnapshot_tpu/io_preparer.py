@@ -56,6 +56,7 @@ from .serialization import (
     pickle_save_as_bytes,
 )
 
+from .telemetry import names as metric_names
 from .utils.tracing import trace_annotation
 
 ArrayPrepareFunc = Callable[[Any, bool], Any]
@@ -174,7 +175,7 @@ class ArrayBufferStager(BufferStager):
         return await loop.run_in_executor(executor, self._stage_sync)
 
     def _stage_sync(self) -> BufferType:
-        with trace_annotation("ts:stage"):
+        with trace_annotation(metric_names.SPAN_LEAF_STAGE):
             return self._stage_sync_impl()
 
     def _stage_sync_impl(self) -> BufferType:
@@ -246,7 +247,7 @@ class ArrayBufferConsumer(BufferConsumer):
         await loop.run_in_executor(executor, self._consume_sync, buf)
 
     def _consume_sync(self, buf: BufferType) -> None:
-        with trace_annotation("ts:consume"):
+        with trace_annotation(metric_names.SPAN_LEAF_CONSUME):
             src = array_from_memoryview(buf, self.dtype, self.shape)
             np.copyto(self.dst, src, casting="no")
 

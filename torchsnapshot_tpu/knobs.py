@@ -33,6 +33,13 @@ _MIRROR_PROGRESS_WINDOW_ENV = (
 _TELEMETRY_ENV = "TORCHSNAPSHOT_TPU_TELEMETRY"
 _TELEMETRY_DIR_ENV = "TORCHSNAPSHOT_TPU_TELEMETRY_DIR"
 _PROM_FILE_ENV = "TORCHSNAPSHOT_TPU_PROM_FILE"
+_TRACE_ENV = "TORCHSNAPSHOT_TPU_TRACE"
+_TRACE_DIR_ENV = "TORCHSNAPSHOT_TPU_TRACE_DIR"
+_TRACE_BUFFER_EVENTS_ENV = "TORCHSNAPSHOT_TPU_TRACE_BUFFER_EVENTS"
+_WATCHDOG_SECONDS_ENV = "TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS"
+
+_DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
+_DEFAULT_WATCHDOG_SECONDS: float = 60.0
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -165,6 +172,42 @@ def is_telemetry_sink_enabled() -> bool:
     return _TELEMETRY_ENV in os.environ or get_telemetry_dir() is not None
 
 
+def get_trace_dir() -> Optional[str]:
+    """Local directory for flight-recorder Chrome-trace exports
+    (``<dir>/trace-<kind>-rank<r>.json``). Takes precedence over the
+    snapshot-adjacent trace files; unset = no directory sink."""
+    return os.environ.get(_TRACE_DIR_ENV) or None
+
+
+def is_trace_sink_enabled() -> bool:
+    """Trace-export toggle: with the env var present, every
+    take/restore/mirror against a *local* snapshot path writes its span
+    timeline to ``<snapshot>/.trace-<kind>-rank<r>.json``. A trace dir
+    (above) also counts as enablement. The flight recorder itself
+    always records into its bounded ring; these knobs only control
+    whether timelines are written out."""
+    return _TRACE_ENV in os.environ or get_trace_dir() is not None
+
+
+def get_trace_buffer_events() -> int:
+    """Flight-recorder ring capacity, in completed events. Oldest
+    events evict first; the recorder counts what it dropped."""
+    return _get_int_env(_TRACE_BUFFER_EVENTS_ENV, _DEFAULT_TRACE_BUFFER_EVENTS)
+
+
+def get_watchdog_deadline_seconds() -> float:
+    """Open-span age past which the stall watchdog fires (emits a
+    ``watchdog:stall`` instant event, logs the open-span tree + thread
+    stacks, bumps ``watchdog_stalls_total``). <= 0 disables the
+    watchdog; the test suite's conftest sets 0 so only opted-in tests
+    exercise it. Re-read on every watchdog scan, so overrides apply to
+    a live watchdog thread."""
+    val = os.environ.get(_WATCHDOG_SECONDS_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_WATCHDOG_SECONDS
+
+
 def get_prometheus_textfile() -> Optional[str]:
     """Prometheus text-exposition file, rewritten (atomically) after
     every report emission — the node-exporter textfile-collector
@@ -271,6 +314,32 @@ def override_telemetry_dir(path: str) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_prometheus_textfile(path: str) -> Generator[None, None, None]:
     with _override_env(_PROM_FILE_ENV, path):
+        yield
+
+
+@contextlib.contextmanager
+def enable_trace() -> Generator[None, None, None]:
+    with _override_env(_TRACE_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_trace_dir(path: str) -> Generator[None, None, None]:
+    with _override_env(_TRACE_DIR_ENV, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_trace_buffer_events(n: int) -> Generator[None, None, None]:
+    with _override_env(_TRACE_BUFFER_EVENTS_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_watchdog_deadline_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_WATCHDOG_SECONDS_ENV, str(seconds)):
         yield
 
 

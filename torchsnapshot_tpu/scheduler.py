@@ -32,6 +32,7 @@ from typing import Callable, List, Optional
 import psutil
 
 from . import knobs, telemetry
+from .telemetry.trace import get_recorder as _trace_recorder
 from .integrity import (
     ChecksumTable,
     compute_checksum_entry,
@@ -294,6 +295,13 @@ class PendingIOWork:
         return self.reporter.pipeline_telemetry()
 
     async def complete(self) -> None:
+        # Recorder-only span (not trace_annotation): this coroutine
+        # awaits across the whole I/O drain and a thread-local jax
+        # annotation would mis-nest with interleaved tasks.
+        drain_span = _trace_recorder().begin(
+            telemetry.names.SPAN_PIPELINE_WRITE_DRAIN,
+            tasks=len(self.io_tasks),
+        )
         try:
             if self.io_tasks:
                 try:
@@ -310,6 +318,7 @@ class PendingIOWork:
                     await asyncio.gather(*self.io_tasks, return_exceptions=True)
                     raise
         finally:
+            _trace_recorder().end(drain_span)
             self._executor.shutdown(wait=False)
         self.reporter.report_phase_done("writing")
         telemetry.metrics().gauge_set(
@@ -422,17 +431,31 @@ async def execute_write_reqs(
 
     async def stage_one(req: WriteReq) -> None:
         """Budget-admitted staging; hands the staged buffer straight to a
-        background write task so I/O overlaps other requests' staging."""
+        background write task so I/O overlaps other requests' staging.
+        Recorder spans per phase (budget wait, then the D2H/serialize
+        stage itself): the per-request timeline the flight recorder
+        exports. Recorder-only — these spans cross awaits."""
+        recorder = _trace_recorder()
         cost = req.buffer_stager.get_staging_cost_bytes()
-        await budget.acquire(cost)
+        with recorder.span(
+            telemetry.names.SPAN_PIPELINE_BUDGET_ACQUIRE,
+            blob=req.path,
+            bytes=cost,
+        ):
+            await budget.acquire(cost)
         stats.pending -= 1
         stats.staging += 1
+        stage_span = recorder.begin(
+            telemetry.names.SPAN_PIPELINE_STAGE, blob=req.path, bytes=cost
+        )
         try:
             buf = await req.buffer_stager.stage_buffer(executor)
         except BaseException:
+            recorder.end(stage_span)
             stats.staging -= 1
             await budget.release(cost)
             raise
+        recorder.end(stage_span, staged_bytes=len(buf))
         stats.staging -= 1
         stats.waiting_io += 1
         # Re-price the reservation: actual buffer size can differ from the
@@ -611,7 +634,12 @@ async def execute_read_reqs(
             else:
                 stats.staging += 1
                 try:
-                    await req.buffer_consumer.consume_buffer(buf, executor)
+                    with _trace_recorder().span(
+                        telemetry.names.SPAN_PIPELINE_CONSUME,
+                        blob=req.path,
+                        bytes=memoryview(buf).nbytes,
+                    ):
+                        await req.buffer_consumer.consume_buffer(buf, executor)
                 finally:
                     stats.staging -= 1
             stats.done += 1

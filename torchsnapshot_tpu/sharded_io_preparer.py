@@ -49,6 +49,7 @@ from .serialization import (
     array_size_bytes,
     dtype_to_string,
 )
+from .telemetry import names as metric_names
 from .utils.tracing import trace_annotation
 
 
@@ -88,7 +89,7 @@ class _OverlapConsumer(BufferConsumer):
         await loop.run_in_executor(executor, self._consume_sync, buf)
 
     def _consume_sync(self, buf: BufferType) -> None:
-        with trace_annotation("ts:consume"):
+        with trace_annotation(metric_names.SPAN_LEAF_CONSUME):
             src = array_from_memoryview(buf, self.dtype, self.buf_shape)
             for dst_view, src_slices in self.copies:
                 np.copyto(dst_view, src[src_slices], casting="no")

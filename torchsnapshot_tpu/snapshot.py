@@ -30,11 +30,17 @@ import asyncio
 import contextlib
 import fnmatch
 import logging
+import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from . import knobs, telemetry
+from .telemetry.trace import (
+    TraceMark,
+    export_op_trace,
+    get_recorder as _trace_recorder,
+)
 from .dist_store import LinearBarrier
 from .flatten import flatten, inflate
 from .io_preparer import (
@@ -133,6 +139,7 @@ def _emit_snapshot_report(
     counter_baseline: Dict[str, float],
     nonce: Optional[str],
     error: Optional[BaseException] = None,
+    trace_mark: Optional[TraceMark] = None,
 ) -> None:
     """Assemble this rank's SnapshotReport, aggregate across ranks, and
     hand it to the sinks. Best-effort — telemetry must never fail a
@@ -140,7 +147,13 @@ def _emit_snapshot_report(
     symmetric: every rank that reaches this function participates
     (whether or not a sink is configured locally), so a sink knob set on
     rank 0 only can never strand the gather. Store-based, not a
-    collective: safe on the async-take commit thread."""
+    collective: safe on the async-take commit thread.
+
+    With ``trace_mark`` (the flight-recorder cursor captured at op
+    start), the operation's span window is also exported as a Chrome
+    trace file when the trace sink knob is on; the cross-rank gather
+    doubles as the clock-offset measurement the trace merge uses to
+    align per-rank timelines."""
     try:
         registry = telemetry.metrics()
         report = telemetry.build_report(
@@ -164,11 +177,17 @@ def _emit_snapshot_report(
             # (and only the aggregation), never the 300 s store timeout
             # or the local report.
             try:
+                # Every rank stamps its wall clock at gather entry —
+                # moments after the same commit barrier on every rank —
+                # which is what makes the per-rank deltas usable as
+                # clock offsets for the trace merge.
+                own = report.to_dict()
+                own["gather_unix_ts"] = time.time()
                 gathered = pg_wrapper.store.gather(
                     f"__telemetry/{kind}/{nonce}",
                     pg_wrapper.get_rank(),
                     pg_wrapper.get_world_size(),
-                    report.to_dict(),
+                    own,
                     timeout=60.0,
                 )
             except Exception as e:  # noqa: BLE001 - emit unaggregated
@@ -181,6 +200,9 @@ def _emit_snapshot_report(
                 gathered = None
             if gathered is not None:
                 report.aggregated = telemetry.aggregate_across_ranks(gathered)
+                report.clock_offsets_s = telemetry.clock_offsets_from_gather(
+                    gathered
+                )
                 for metric, spread in sorted(report.aggregated.items()):
                     logger.info(
                         "telemetry %s %s: min=%s median=%s max=%s "
@@ -193,6 +215,8 @@ def _emit_snapshot_report(
                         spread["straggler"],
                     )
         telemetry.emit_report(report, registry)
+        if trace_mark is not None:
+            export_op_trace(kind, path, pg_wrapper.get_rank(), trace_mark)
     except Exception as e:  # noqa: BLE001 - telemetry must not fail the op
         logger.warning("telemetry: %s report emission failed: %r", kind, e)
 
@@ -253,6 +277,11 @@ class Snapshot:
             )
         event_loop = asyncio.new_event_loop()
         counter_baseline = telemetry.metrics().counters_snapshot()
+        recorder = _trace_recorder()
+        trace_mark = recorder.mark()
+        take_span = recorder.begin(
+            telemetry.names.SPAN_TAKE, path=path, rank=pg_wrapper.get_rank()
+        )
         try:
             storage = url_to_storage_plugin(path)
             with _reporting_to(barrier, "take"):
@@ -290,6 +319,9 @@ class Snapshot:
                 if barrier is not None:
                     barrier.depart()
             event_loop.run_until_complete(storage.close())
+            # The envelope span closes before the report/trace emission
+            # so the exported timeline carries the take's full extent.
+            recorder.end(take_span)
             # Post-close on purpose: a tiered plugin enqueues its mirror
             # job at close, so the report's mirror state reflects the
             # durability backlog this take just created.
@@ -300,8 +332,10 @@ class Snapshot:
                 pipeline=pending_io_work.pipeline_telemetry(),
                 counter_baseline=counter_baseline,
                 nonce=commit_nonce,
+                trace_mark=trace_mark,
             )
         finally:
+            recorder.end(take_span)  # no-op if already closed
             event_loop.close()
         snapshot = cls(path=path, pg=pg)
         snapshot._metadata = metadata
@@ -342,9 +376,15 @@ class Snapshot:
         )
         event_loop = asyncio.new_event_loop()
         counter_baseline = telemetry.metrics().counters_snapshot()
+        recorder = _trace_recorder()
+        trace_mark = recorder.mark()
         storage = url_to_storage_plugin(path)
         try:
-            with _reporting_to(barrier, "async take staging"):
+            with recorder.span(
+                telemetry.names.SPAN_ASYNC_TAKE_STAGE,
+                path=path,
+                rank=pg_wrapper.get_rank(),
+            ), _reporting_to(barrier, "async take staging"):
                 pending_io_work, metadata = cls._take_impl(
                     path=path,
                     app_state=app_state,
@@ -375,6 +415,7 @@ class Snapshot:
             event_loop=event_loop,
             commit_nonce=commit_nonce,
             counter_baseline=counter_baseline,
+            trace_mark=trace_mark,
         )
 
     @classmethod
@@ -608,6 +649,11 @@ class Snapshot:
         if pg_wrapper.get_world_size() > 1:
             restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
         counter_baseline = telemetry.metrics().counters_snapshot()
+        recorder = _trace_recorder()
+        trace_mark = recorder.mark()
+        restore_span = recorder.begin(
+            telemetry.names.SPAN_RESTORE, path=self.path, rank=rank
+        )
         pipeline_sink: List[dict] = []
 
         def key_barrier(i: int) -> Optional[LinearBarrier]:
@@ -675,6 +721,7 @@ class Snapshot:
                     pipeline_sink=pipeline_sink,
                 )
             event_loop.run_until_complete(storage.close())
+            recorder.end(restore_span)
             _emit_snapshot_report(
                 kind="restore",
                 path=self.path,
@@ -682,8 +729,10 @@ class Snapshot:
                 pipeline=telemetry.merge_pipeline_telemetry(pipeline_sink),
                 counter_baseline=counter_baseline,
                 nonce=restore_nonce,
+                trace_mark=trace_mark,
             )
         finally:
+            recorder.end(restore_span)  # no-op if already closed
             event_loop.close()
 
     def async_restore(self, app_state: AppState) -> "PendingRestore":
@@ -710,6 +759,7 @@ class Snapshot:
         _validate_app_state(app_state)
         pg_wrapper = PGWrapper(self._pg_arg)
         rank = pg_wrapper.get_rank()
+        trace_mark = _trace_recorder().mark()
         memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
 
         rng_key_and_state = _pop_rng_state(app_state)
@@ -776,6 +826,7 @@ class Snapshot:
             rng_key=rng_key,
             restore_nonce=restore_nonce,
             counter_baseline=telemetry.metrics().counters_snapshot(),
+            trace_mark=trace_mark,
         )
 
     def _load_stateful(
@@ -1223,6 +1274,7 @@ class PendingSnapshot:
         event_loop: asyncio.AbstractEventLoop,
         commit_nonce: str = "",
         counter_baseline: Optional[Dict[str, float]] = None,
+        trace_mark: Optional[TraceMark] = None,
     ) -> None:
         import threading
 
@@ -1234,6 +1286,7 @@ class PendingSnapshot:
         self._event_loop = event_loop
         self._pending_io_work = pending_io_work
         self._counter_baseline = counter_baseline or {}
+        self._trace_mark = trace_mark
         self._exc_info: Optional[BaseException] = None
         self._done = threading.Event()
         self._thread = threading.Thread(
@@ -1243,6 +1296,12 @@ class PendingSnapshot:
 
     def _complete_snapshot(self) -> None:
         barrier = None
+        recorder = _trace_recorder()
+        commit_span = recorder.begin(
+            telemetry.names.SPAN_ASYNC_TAKE_COMMIT,
+            path=self.path,
+            rank=self.pg.get_rank(),
+        )
         try:
             barrier = _nonce_barrier(
                 f"__snapshot_commit/{self.commit_nonce}", self.pg
@@ -1264,6 +1323,7 @@ class PendingSnapshot:
             if barrier is not None:
                 barrier.depart()
             self._event_loop.run_until_complete(self._storage.close())
+            recorder.end(commit_span)
             # Store-based gather + local file append only — safe on this
             # background thread (no collectives), same rule the commit
             # barrier follows. Post-close so a tiered take's report sees
@@ -1275,6 +1335,7 @@ class PendingSnapshot:
                 pipeline=self._pending_io_work.pipeline_telemetry(),
                 counter_baseline=self._counter_baseline,
                 nonce=self.commit_nonce,
+                trace_mark=self._trace_mark,
             )
         except BaseException as e:  # noqa: BLE001 - must propagate via wait()
             # Record the failure before telling peers: report_error talks to
@@ -1289,6 +1350,7 @@ class PendingSnapshot:
                         "Failed to report snapshot error to peers: %r", report_exc
                     )
         finally:
+            recorder.end(commit_span)  # no-op if already closed
             self._event_loop.close()
             self._done.set()
 
@@ -1329,6 +1391,7 @@ class PendingRestore:
         rng_key: Optional[str] = None,
         restore_nonce: Optional[str] = None,
         counter_baseline: Optional[Dict[str, float]] = None,
+        trace_mark: Optional[TraceMark] = None,
     ) -> None:
         import threading
 
@@ -1342,6 +1405,7 @@ class PendingRestore:
         self._rank = rank
         self._world_size = world_size
         self._counter_baseline = counter_baseline or {}
+        self._trace_mark = trace_mark
         self._pipeline_telemetry: Optional[dict] = None
         self._exc_info: Optional[BaseException] = None
         self._applied = False
@@ -1353,6 +1417,11 @@ class PendingRestore:
 
     def _run_reads(self) -> None:
         event_loop = asyncio.new_event_loop()
+        reads_span = _trace_recorder().begin(
+            telemetry.names.SPAN_ASYNC_RESTORE_READS,
+            path=self.path,
+            rank=self._rank,
+        )
         try:
             storage = url_to_storage_plugin(self.path)
             read_reqs = [
@@ -1394,6 +1463,7 @@ class PendingRestore:
             self._exc_info = e
             logger.error("Async restore failed: %r", e)
         finally:
+            _trace_recorder().end(reads_span)
             event_loop.close()
             self._done.set()
 
@@ -1466,6 +1536,7 @@ class PendingRestore:
             pipeline=self._pipeline_telemetry,
             counter_baseline=self._counter_baseline,
             nonce=None,
+            trace_mark=self._trace_mark,
         )
         # Release the checkpoint-sized host buffers the plans hold; the
         # handle itself may outlive the restore (done()-polling callers).

@@ -32,7 +32,8 @@ except ImportError:
 
 from .. import _native
 from ..io_types import ReadIO, StoragePlugin, WriteIO
-from ..telemetry import observe_io
+from ..telemetry import names as metric_names, observe_io
+from ..telemetry.trace import io_span
 from ..utils.tracing import trace_annotation
 
 
@@ -58,14 +59,11 @@ class FSStoragePlugin(StoragePlugin):
             self._dir_cache.add(parent)
 
     async def write(self, write_io: WriteIO) -> None:
+        nbytes = memoryview(write_io.buf).cast("B").nbytes
         t0 = time.monotonic()
-        await self._write_impl(write_io)
-        observe_io(
-            "fs",
-            "write",
-            memoryview(write_io.buf).cast("B").nbytes,
-            time.monotonic() - t0,
-        )
+        with io_span("fs", "write", write_io.path, nbytes):
+            await self._write_impl(write_io)
+        observe_io("fs", "write", nbytes, time.monotonic() - t0)
 
     async def _write_impl(self, write_io: WriteIO) -> None:
         full_path = self._full_path(write_io.path)
@@ -76,7 +74,9 @@ class FSStoragePlugin(StoragePlugin):
             # write_file returns False (wrote nothing) if the native lib
             # became unavailable after construction — fall through then.
             def _write_native() -> bool:
-                with trace_annotation("ts:write"):
+                with trace_annotation(
+                    metric_names.SPAN_FS_NATIVE_WRITE, blob=write_io.path
+                ):
                     return _native.write_file(full_path, write_io.buf)
 
             if await loop.run_in_executor(None, _write_native):
@@ -108,7 +108,9 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
 
         def _write_crc():
-            with trace_annotation("ts:write"):
+            with trace_annotation(
+                metric_names.SPAN_FS_NATIVE_WRITE, blob=write_io.path
+            ):
                 pages = _native.write_file_crc(
                     full_path, write_io.buf, PAGE_SIZE
                 )
@@ -118,22 +120,20 @@ class FSStoragePlugin(StoragePlugin):
                 pages, memoryview(write_io.buf).cast("B").nbytes
             )
 
+        nbytes = memoryview(write_io.buf).cast("B").nbytes
         t0 = time.monotonic()
-        entry = await loop.run_in_executor(None, _write_crc)
+        with io_span("fs", "write", write_io.path, nbytes):
+            entry = await loop.run_in_executor(None, _write_crc)
         if entry is not None:
             # A declined fused write wrote nothing; the scheduler's
             # two-step fallback lands in write(), which accounts itself.
-            observe_io(
-                "fs",
-                "write",
-                memoryview(write_io.buf).cast("B").nbytes,
-                time.monotonic() - t0,
-            )
+            observe_io("fs", "write", nbytes, time.monotonic() - t0)
         return entry
 
     async def read(self, read_io: ReadIO) -> None:
         t0 = time.monotonic()
-        await self._read_dispatch(read_io)
+        with io_span("fs", "read", read_io.path, byte_range=read_io.byte_range):
+            await self._read_dispatch(read_io)
         observe_io(
             "fs",
             "read",
@@ -204,7 +204,9 @@ class FSStoragePlugin(StoragePlugin):
         loop = asyncio.get_running_loop()
 
         def _read_crc():
-            with trace_annotation("ts:read"):
+            with trace_annotation(
+                metric_names.SPAN_FS_NATIVE_READ, blob=read_io.path
+            ):
                 length = _native.file_size(full_path)
                 if length is None:
                     return None
@@ -218,7 +220,8 @@ class FSStoragePlugin(StoragePlugin):
                 return out, pages
 
         t0 = time.monotonic()
-        res = await loop.run_in_executor(None, _read_crc)
+        with io_span("fs", "read", read_io.path):
+            res = await loop.run_in_executor(None, _read_crc)
         if res is None:
             return None
         out, pages = res
@@ -230,7 +233,9 @@ class FSStoragePlugin(StoragePlugin):
 
     def _native_read(self, full_path: str, read_io: ReadIO):
         """Read via the native lib; None if it became unavailable."""
-        with trace_annotation("ts:read"):
+        with trace_annotation(
+            metric_names.SPAN_FS_NATIVE_READ, blob=read_io.path
+        ):
             return self._native_read_impl(full_path, read_io)
 
     def _native_read_impl(self, full_path: str, read_io: ReadIO):

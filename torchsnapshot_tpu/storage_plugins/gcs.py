@@ -27,6 +27,8 @@ from .. import knobs, telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..telemetry import names as metric_names
 from ..telemetry import observe_io
+from ..telemetry.trace import get_recorder as _trace_recorder, io_span
+from ..utils.tracing import trace_annotation
 from .retry import CollectiveProgressRetryStrategy
 
 logger = logging.getLogger(__name__)
@@ -126,6 +128,18 @@ class GCSStoragePlugin(StoragePlugin):
         return normalize_object_key(self.prefix, path)
 
     def _upload_sync(self, path: str, data: bytes) -> None:
+        # Dual annotation (recorder + jax timeline): this runs on a
+        # gcs-io executor thread, where the thread-local jax side nests
+        # correctly.
+        with trace_annotation(
+            metric_names.SPAN_STORAGE_WRITE,
+            plugin="gcs",
+            blob=path,
+            bytes=len(data),
+        ):
+            self._upload_sync_impl(path, data)
+
+    def _upload_sync_impl(self, path: str, data: bytes) -> None:
         blob = self._blob_name(path)
         url = (
             f"{self._base_url}/upload/storage/v1/b/"
@@ -179,8 +193,26 @@ class GCSStoragePlugin(StoragePlugin):
                 telemetry.metrics().counter_inc(
                     metric_names.GCS_RECOVER_ATTEMPTS_TOTAL
                 )
+                # Instant event: places each brownout-recover on the
+                # timeline, inside the upload span it interrupted.
+                _trace_recorder().instant(
+                    metric_names.INSTANT_GCS_RECOVER,
+                    blob=blob,
+                    attempt=recover_attempts,
+                )
 
     def _download_sync(
+        self, path: str, byte_range: Optional[Tuple[int, int]]
+    ) -> bytes:
+        # Ranged reads were previously invisible to any timeline; the
+        # dual annotation covers both whole-blob and ranged downloads.
+        args = {"plugin": "gcs", "blob": path}
+        if byte_range is not None:
+            args["range"] = [int(byte_range[0]), int(byte_range[1])]
+        with trace_annotation(metric_names.SPAN_STORAGE_READ, **args):
+            return self._download_sync_impl(path, byte_range)
+
+    def _download_sync_impl(
         self, path: str, byte_range: Optional[Tuple[int, int]]
     ) -> bytes:
         blob = urllib.parse.quote(self._blob_name(path), safe="")

@@ -20,6 +20,7 @@ from typing import Awaitable, Callable, Tuple, Type, TypeVar
 
 from .. import telemetry
 from ..telemetry import names as metric_names
+from ..telemetry.trace import get_recorder as _trace_recorder
 
 T = TypeVar("T")
 
@@ -106,6 +107,14 @@ class CollectiveProgressRetryStrategy:
                     metric_names.STORAGE_RETRY_BACKOFF_SECONDS_TOTAL,
                     backoff,
                     scope=self.scope,
+                )
+                # Instant event: each retry lands on the flight-recorder
+                # timeline inside the span of the operation it delays.
+                _trace_recorder().instant(
+                    metric_names.INSTANT_STORAGE_RETRY,
+                    scope=self.scope,
+                    attempt=attempt + 1,
+                    backoff_s=round(backoff, 3),
                 )
                 await asyncio.sleep(backoff)
                 attempt += 1

@@ -18,6 +18,7 @@ import time
 from .. import knobs
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..telemetry import observe_io
+from ..telemetry.trace import io_span
 from .retry import CollectiveProgressRetryStrategy
 
 logger = logging.getLogger(__name__)
@@ -129,14 +130,13 @@ class S3StoragePlugin(StoragePlugin):
                 Body=MemoryviewStream(memoryview(write_io.buf)),
             )
 
+        nbytes = memoryview(write_io.buf).cast("B").nbytes
         t0 = time.monotonic()
-        await self._run_retrying(op)
-        observe_io(
-            "s3",
-            "write",
-            memoryview(write_io.buf).cast("B").nbytes,
-            time.monotonic() - t0,
-        )
+        # Recorder-only span (io_span): this coroutine suspends across
+        # the upload, so a thread-local jax annotation would mis-nest.
+        with io_span("s3", "write", write_io.path, nbytes):
+            await self._run_retrying(op)
+        observe_io("s3", "write", nbytes, time.monotonic() - t0)
 
     async def read(self, read_io: ReadIO) -> None:
         client = await self._get_client()
@@ -180,7 +180,10 @@ class S3StoragePlugin(StoragePlugin):
                 return await stream.read()
 
         t0 = time.monotonic()
-        read_io.buf = memoryview(await self._run_retrying(op))
+        with io_span(
+            "s3", "read", read_io.path, byte_range=read_io.byte_range
+        ):
+            read_io.buf = memoryview(await self._run_retrying(op))
         observe_io(
             "s3", "read", read_io.buf.nbytes, time.monotonic() - t0
         )

@@ -12,13 +12,19 @@ per-operation record, cross-rank aggregated via ``dist_store.Store.gather``
 Prometheus text file). ``python -m torchsnapshot_tpu.telemetry`` /
 ``tools/snapshot_stats.py`` render the event log as per-step tables.
 
-See docs/observability.md for the metric inventory, report schema,
-sink knobs, and CLI.
+Alongside the registry's aggregates, the **flight recorder**
+(trace.py) keeps an always-on, bounded span timeline of the same
+layers — exported per operation as Chrome trace JSON (knob-gated, like
+the sinks), merged cross-rank by ``python -m torchsnapshot_tpu.telemetry
+trace``, and patrolled by the stall watchdog (watchdog.py).
+
+See docs/observability.md for the metric inventory, span inventory,
+report schema, sink knobs, and CLI.
 """
 
 from __future__ import annotations
 
-from . import names
+from . import names, trace, watchdog
 from .registry import (
     DEFAULT_SECONDS_BUCKETS,
     MetricsRegistry,
@@ -29,6 +35,7 @@ from .report import (
     SnapshotReport,
     aggregate_across_ranks,
     build_report,
+    clock_offsets_from_gather,
     merge_pipeline_telemetry,
 )
 from .sink import (
@@ -45,6 +52,7 @@ __all__ = [
     "SnapshotReport",
     "aggregate_across_ranks",
     "build_report",
+    "clock_offsets_from_gather",
     "emit_report",
     "events_path_for",
     "load_events",
@@ -56,8 +64,11 @@ __all__ = [
     "record_phase",
     "render_prometheus",
     "reset_metrics",
+    "reset_trace",
     "safe_rate_mb_s",
     "series_key",
+    "trace",
+    "watchdog",
     "write_prometheus_textfile",
 ]
 
@@ -86,6 +97,12 @@ def metrics() -> MetricsRegistry:
 def reset_metrics() -> None:
     """Drop all recorded metrics (tests simulating a fresh process)."""
     _REGISTRY.reset()
+
+
+def reset_trace() -> None:
+    """Drop the flight recorder's ring and open-span table (tests
+    simulating a fresh process)."""
+    trace.get_recorder().reset()
 
 
 def record_phase(phase: str, elapsed_s: float) -> None:

@@ -64,3 +64,60 @@ SNAPSHOT_REPORTS_TOTAL = "snapshot_reports_total"
 # -- utilities (utils/rss_profiler.py) ---------------------------------------
 
 RSS_PEAK_DELTA_BYTES = "rss_peak_delta_bytes"
+
+# -- stall watchdog (telemetry/watchdog.py) ----------------------------------
+
+WATCHDOG_STALLS_TOTAL = "watchdog_stalls_total"
+
+# ---------------------------------------------------------------------------
+# Flight-recorder span/instant names (telemetry/trace.py).
+#
+# Same single-registration rule as the metrics above, with a colon-case
+# convention (``layer:operation``) so a Perfetto timeline groups by
+# layer. ``SPAN_``-prefixed constants name begin/end spans,
+# ``INSTANT_``-prefixed ones point-in-time events.
+# ``tools/check_span_names.py`` lints both halves: declared exactly once
+# here, colon/snake-case values, no string literals at
+# ``trace_annotation``/``span``/``instant`` call sites.
+# ---------------------------------------------------------------------------
+
+# snapshot.py operation envelopes
+SPAN_TAKE = "snapshot:take"
+SPAN_RESTORE = "snapshot:restore"
+SPAN_ASYNC_TAKE_STAGE = "snapshot:async_take:stage"
+SPAN_ASYNC_TAKE_COMMIT = "snapshot:async_take:commit"
+SPAN_ASYNC_RESTORE_READS = "snapshot:async_restore:reads"
+
+# scheduler.py pipeline stages
+SPAN_PIPELINE_BUDGET_ACQUIRE = "pipeline:budget_acquire"
+SPAN_PIPELINE_STAGE = "pipeline:stage"
+SPAN_PIPELINE_WRITE_DRAIN = "pipeline:write_drain"
+SPAN_PIPELINE_CONSUME = "pipeline:consume"
+
+# io_preparer / sharded_io_preparer per-leaf executor kernels (the
+# D2H+serialize and deserialize+copy inside the pipeline spans above)
+SPAN_LEAF_STAGE = "stage:leaf"
+SPAN_LEAF_CONSUME = "consume:leaf"
+
+# storage plugins (fs/s3/gcs); the fs native fast path additionally
+# stamps its executor-thread kernel I/O
+SPAN_STORAGE_WRITE = "storage:write"
+SPAN_STORAGE_READ = "storage:read"
+SPAN_FS_NATIVE_WRITE = "storage:fs_native_write"
+SPAN_FS_NATIVE_READ = "storage:fs_native_read"
+INSTANT_STORAGE_RETRY = "storage:retry"
+INSTANT_GCS_RECOVER = "storage:gcs_recover"
+
+# batcher.py slab staging / spanning-read dispatch
+SPAN_BATCHER_STAGE_SLAB = "batcher:stage_slab"
+SPAN_BATCHER_CONSUME_SPANNING = "batcher:consume_spanning"
+
+# tiered mirror
+SPAN_MIRROR_JOB = "mirror:job"
+SPAN_MIRROR_BLOB = "mirror:blob"
+
+# utils/rss_profiler.py: a new peak RSS delta was observed
+INSTANT_RSS_PEAK = "rss:peak"
+
+# telemetry/watchdog.py: an open span outlived the stall deadline
+INSTANT_WATCHDOG_STALL = "watchdog:stall"

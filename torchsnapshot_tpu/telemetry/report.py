@@ -64,6 +64,13 @@ class SnapshotReport:
       finished job's own numbers instead
     - ``aggregated``: rank 0 only, world > 1 — per-phase
       {min, median, max, straggler (rank)} across the gathered reports
+    - ``clock_offsets_s``: rank 0 only, world > 1 — each rank's
+      wall-clock at gather entry minus rank 0's (rank order). Every
+      rank reaches the gather within moments of the same commit
+      barrier, so this approximates per-rank clock skew; the trace
+      merge (telemetry/trace.py) subtracts it to align per-rank
+      timelines. Includes barrier-exit jitter — see
+      docs/observability.md for the caveat.
     """
 
     kind: str
@@ -82,6 +89,7 @@ class SnapshotReport:
     retries: Dict[str, float] = dataclasses.field(default_factory=dict)
     mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
     aggregated: Optional[Dict[str, Dict[str, float]]] = None
+    clock_offsets_s: Optional[List[float]] = None
     error: Optional[str] = None
     schema_version: int = SCHEMA_VERSION
 
@@ -180,6 +188,25 @@ def build_report(
         mirror=dict(mirror or {}),
         error=error,
     )
+
+
+def clock_offsets_from_gather(
+    rank_reports: List[Dict[str, Any]]
+) -> Optional[List[float]]:
+    """Per-rank clock offsets against rank 0 (rank order), from the
+    ``gather_unix_ts`` each rank stamps into its gathered report dict
+    moments after the shared commit barrier. None when the stamps are
+    missing (older-schema peers). A rank with no stamp reports 0.0."""
+    if not rank_reports:
+        return None
+    base = rank_reports[0].get("gather_unix_ts")
+    if base is None:
+        return None
+    out: List[float] = []
+    for r in rank_reports:
+        ts = r.get("gather_unix_ts")
+        out.append(round(float(ts) - float(base), 6) if ts is not None else 0.0)
+    return out
 
 
 def aggregate_across_ranks(

@@ -8,6 +8,8 @@ CLI (also ``python -m torchsnapshot_tpu.telemetry`` and
 ``tools/snapshot_stats.py``)::
 
     snapshot-stats <events.jsonl> [--kind take] [--path-contains step_]
+    snapshot-stats trace <snapshot-dir>   # merge per-rank flight-recorder
+                                          # traces (telemetry/trace.py)
 
 Output: one row per (path, kind, rank) record — phase durations,
 bytes, throughput, budget wait, retries — followed by a per-tier
@@ -170,6 +172,15 @@ def render_summary(events: Sequence[dict]) -> str:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "trace":
+        # ``python -m torchsnapshot_tpu.telemetry trace <snapshot>``:
+        # cross-rank trace merge + straggler summary.
+        from .trace import main as trace_main
+
+        return trace_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="snapshot-stats",

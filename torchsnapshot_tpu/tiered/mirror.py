@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import queue
 import threading
 import time
@@ -41,7 +42,7 @@ from ..io_types import ReadIO, WriteIO
 from ..storage_plugin import split_tiered_url, url_to_storage_plugin
 from ..storage_plugins.retry import CollectiveProgressRetryStrategy
 from ..telemetry import names as metric_names
-from ..utils.tracing import trace_annotation
+from ..telemetry.trace import export_op_trace, get_recorder as _trace_recorder
 from .journal import MirrorJournal
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -82,6 +83,9 @@ class MirrorJob:
         # totals): feeds the job's SnapshotReport at completion.
         self.blobs_done = 0
         self.bytes_done = 0
+        # Flight-recorder cursor, set by the worker at dequeue: the
+        # job's span window for the per-job trace export.
+        self.trace_mark = 0
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done_evt.wait(timeout)
@@ -268,6 +272,14 @@ class Mirror:
             if job is None:
                 return
             began = time.monotonic()
+            recorder = _trace_recorder()
+            job.trace_mark = recorder.mark()
+            job_span = recorder.begin(
+                metric_names.SPAN_MIRROR_JOB,
+                fast=job.fast_url,
+                durable=job.durable_url,
+                blobs=len(job.blobs),
+            )
             try:
                 if not job.cancelled:
                     run_in_fresh_event_loop(self._run_job(job))
@@ -287,6 +299,7 @@ class Mirror:
             finally:
                 from ..scheduler import record_phase_timing
 
+                recorder.end(job_span)
                 elapsed = time.monotonic() - began
                 record_phase_timing("mirroring", elapsed)
                 # Telemetry settles BEFORE the done event: a waiter that
@@ -342,6 +355,16 @@ class Mirror:
                 error=repr(job.error) if job.error is not None else None,
             )
             telemetry.emit_report(report, registry)
+            # Per-job trace export: the mirror's span window (job span,
+            # per-blob spans, retry instants) lands next to the fast
+            # tier's take trace. The Mirror has no rank (plugins are
+            # rank-agnostic), so the filename is pid-disambiguated —
+            # co-hosted ranks sharing a fast root must not clobber each
+            # other's mirror timelines; the merge assigns each file its
+            # own pid regardless of the claimed rank.
+            export_op_trace(
+                f"mirror-pid{os.getpid()}", report.path, 0, job.trace_mark
+            )
         except Exception as e:  # noqa: BLE001 - telemetry is best-effort
             logger.warning("mirror telemetry emission failed: %r", e)
 
@@ -391,7 +414,14 @@ class Mirror:
                     with self._lock:
                         self._blobs_inflight += 1
                     try:
-                        with trace_annotation("ts:mirror"):
+                        # Recorder-only span: blob uploads interleave as
+                        # coroutines on one event-loop thread, where a
+                        # thread-local jax annotation would mis-nest
+                        # (utils/tracing.py module note). The plugin-level
+                        # I/O spans underneath still reach both sinks.
+                        with _trace_recorder().span(
+                            metric_names.SPAN_MIRROR_BLOB, blob=path
+                        ):
                             return await retry.run(
                                 guarded,
                                 retriable_exceptions=(_TransientMirrorError,),

@@ -18,6 +18,7 @@ import psutil
 
 from .. import telemetry
 from ..telemetry import names as metric_names
+from ..telemetry.trace import get_recorder as _trace_recorder
 
 _SAMPLE_PERIOD_SECONDS = 0.1
 
@@ -43,17 +44,27 @@ def measure_rss_deltas(
     The sampler thread is joined on EVERY exit path (the block raising
     included), and its peak delta feeds the telemetry registry's
     ``rss_peak_delta_bytes`` gauge — bench runs and snapshot reports
-    read memory pressure from the same place."""
+    read memory pressure from the same place. Each NEW peak also lands
+    as an ``rss:peak`` instant event in the flight recorder, so the
+    moment host memory crested is placeable on the span timeline
+    (which write/stage was in flight when RSS peaked)."""
     process = psutil.Process()
     baseline = process.memory_info().rss
     stop = threading.Event()
+    peak_seen = [0]
+
+    def note(delta: int) -> None:
+        rss_deltas.deltas.append(delta)
+        if delta > peak_seen[0]:
+            peak_seen[0] = delta
+            _trace_recorder().instant(
+                metric_names.INSTANT_RSS_PEAK, delta_bytes=delta
+            )
 
     def sampler() -> None:
         while not stop.is_set():
             try:
-                rss_deltas.deltas.append(
-                    process.memory_info().rss - baseline
-                )
+                note(process.memory_info().rss - baseline)
             except Exception:  # noqa: BLE001 - a failed sample must not
                 # wedge the thread (join below would then hang forever)
                 break
@@ -71,7 +82,7 @@ def measure_rss_deltas(
         stop.set()
         thread.join()
         try:
-            rss_deltas.deltas.append(process.memory_info().rss - baseline)
+            note(process.memory_info().rss - baseline)
         finally:
             telemetry.metrics().gauge_set(
                 metric_names.RSS_PEAK_DELTA_BYTES, rss_deltas.peak_bytes

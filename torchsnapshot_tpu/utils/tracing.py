@@ -1,21 +1,38 @@
-"""Profiler trace annotations for the checkpoint pipeline.
+"""Dual-sink trace annotations for the checkpoint pipeline.
 
 Reference parity: the reference emits progress/throughput lines
-(scheduler.py:96-175) but no timeline tracing; the TPU-native equivalent
-of choice is ``jax.profiler`` — when a profiler session is active
-(``jax.profiler.start_trace`` or the TensorBoard plugin), these
-annotations place the checkpointer's stage/write/read/consume spans on
-the same XPlane timeline as device compute, making D2H/compute/I-O
-overlap directly visible. With no session active, TraceAnnotation is a
-couple of cheap TraceMe calls; without jax importable at all it degrades
-to a nullcontext. jax availability is resolved once at import time —
-these annotations sit on the per-buffer hot path.
+(scheduler.py:96-175) but no timeline tracing. Here every annotation
+lands in TWO places at once:
+
+- the **flight recorder** (telemetry/trace.py) — always on, bounded
+  ring, exported per-operation as Chrome trace JSON; this is what the
+  stall watchdog and ``python -m torchsnapshot_tpu.telemetry trace``
+  consume, profiler session or not;
+- the **jax profiler timeline** — when a session is active
+  (``jax.profiler.start_trace`` or the TensorBoard plugin), the same
+  span appears on the XPlane timeline next to device compute, making
+  D2H/compute/I-O overlap directly visible. With no session active the
+  TraceAnnotation is a couple of cheap TraceMe calls; without jax
+  importable it degrades away entirely.
+
+jax availability is resolved once at import time — these annotations
+sit on the per-buffer hot path. Span names are declared once in
+``telemetry/names.py`` (``tools/check_span_names.py`` lints call
+sites); keyword args become the recorder span's args (the jax side
+carries the name only).
+
+NOTE: the jax annotation is thread-local begin/end, so call sites that
+hold a span across an ``await`` should use the recorder directly
+(``telemetry.trace.get_recorder().span(...)``, which tracks per
+asyncio task) rather than this helper — an interleaved task on the
+same thread would otherwise mis-nest the XPlane timeline.
 """
 
 from __future__ import annotations
 
-import contextlib
-from typing import ContextManager
+from typing import Any, ContextManager
+
+from ..telemetry.trace import get_recorder
 
 try:
     from jax.profiler import TraceAnnotation as _TraceAnnotation
@@ -23,9 +40,35 @@ except Exception:  # pragma: no cover - jax always present in this repo
     _TraceAnnotation = None
 
 
-def trace_annotation(name: str) -> ContextManager[None]:
-    """A context manager placing ``name`` on the active jax profiler
-    timeline (thread-local, safe on executor threads)."""
-    if _TraceAnnotation is None:
-        return contextlib.nullcontext()
-    return _TraceAnnotation(name)
+class _DualAnnotation:
+    """Flight-recorder span + jax TraceAnnotation, one context manager
+    (hand-rolled: this wraps every buffer's staging/write/read, and a
+    generator-based contextmanager costs ~3x per entry)."""
+
+    __slots__ = ("_name", "_args", "_token", "_jax")
+
+    def __init__(self, name: str, args: dict) -> None:
+        self._name = name
+        self._args = args
+        self._token = 0
+        self._jax = None
+
+    def __enter__(self) -> None:
+        self._token = get_recorder().begin(self._name, **self._args)
+        if _TraceAnnotation is not None:
+            self._jax = _TraceAnnotation(self._name)
+            self._jax.__enter__()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if self._jax is not None:
+                self._jax.__exit__(exc_type, exc, tb)
+        finally:
+            get_recorder().end(self._token)
+
+
+def trace_annotation(name: str, **args: Any) -> ContextManager[None]:
+    """A context manager placing ``name`` on the flight recorder AND
+    the active jax profiler timeline (thread-local on the jax side —
+    safe on executor threads; see module note for coroutines)."""
+    return _DualAnnotation(name, args)
