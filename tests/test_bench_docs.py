@@ -3,11 +3,21 @@ driver-recorded signal of record (committed-number drift like round 2's
 0.92-vs-0.646 efficiency headline fails here), and every relative doc
 link must resolve. A timed-out driver run records ``parsed: null``
 (round 4 did) — the checker must fall back to the newest round that
-parsed, never pass vacuously."""
+parsed, never pass vacuously.
+
+The metric-name, span-name, and tiered-marker checkers are now thin
+shims over ``tools.snaplint`` rules; their behavioral tests below
+exercise the shared implementations, and the snaplint lane test runs
+the whole framework over the package."""
 
 import importlib.util
 import json
 import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
 
 def _load_tool(name: str):
@@ -94,6 +104,31 @@ def test_no_records_and_no_block_is_clean(tmp_path):
     mod = _load_tool("check_bench_docs.py")
     (tmp_path / "BENCH.md").write_text("# bench\nno block here\n")
     assert mod.main(root=tmp_path) == 0
+
+
+def test_snaplint_lane_is_clean(capsys):
+    """The default-lane analyzer run: every snaplint rule (the five
+    concurrency/correctness rules plus the metric/span/tiered checkers
+    it absorbed) over the whole package, empty baseline, exit 0."""
+    from tools.snaplint.__main__ import main
+
+    rc = main(["torchsnapshot_tpu"])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_checkers_are_snaplint_shims():
+    """The three pre-snaplint checkers must stay thin shims over the
+    framework's rule implementations — one implementation, two entry
+    points, no drift."""
+    from tools.snaplint.rules import names_lint, tiered_markers
+
+    metric = _load_tool("check_metric_names.py")
+    span = _load_tool("check_span_names.py")
+    tiered = _load_tool("check_tiered_markers.py")
+    assert metric.check_names_file is names_lint.check_metric_names_file
+    assert metric.check_call_sites is names_lint.check_metric_call_sites
+    assert span.check_names_file is names_lint.check_span_names_file
+    assert tiered.check is tiered_markers.check
 
 
 def test_tiered_tests_are_lane_correct(capsys):

@@ -385,3 +385,19 @@ def test_jax_process_group_is_cached(monkeypatch) -> None:
     assert pg1 is pg2
     assert pg1.store is sentinel_store
     monkeypatch.setattr(ds, "_JAX_PG", None)
+
+
+def test_tcp_store_connect_timeout_is_a_clear_error() -> None:
+    """A client whose rank-0 store server never comes up must fail with
+    a deadline-bounded StoreTimeoutError naming the address — not a raw
+    ECONNREFUSED escaping from deep inside a collective (snaplint
+    satellite: every dist_store poll loop is deadline-bounded with a
+    clear timeout error)."""
+    port = get_free_port()  # freed immediately: nothing listens on it
+    client = TCPStore(
+        "127.0.0.1", port, is_server=False, connect_timeout=0.3
+    )
+    t0 = time.monotonic()
+    with pytest.raises(StoreTimeoutError, match="Timed out connecting"):
+        client.try_get("anything")
+    assert time.monotonic() - t0 < 10.0

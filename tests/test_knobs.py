@@ -64,3 +64,24 @@ def test_env_values_read_lazily() -> None:
         del os.environ["TORCHSNAPSHOT_TPU_STAGING_THREADS"]
     assert knobs.get_per_rank_io_concurrency() == 16
     assert knobs.get_staging_threads() == 4
+
+
+def test_native_disable_knob() -> None:
+    """The native-runtime kill-switch moved onto the knob surface
+    (snaplint knob-env-literal: no TORCHSNAPSHOT_TPU_* env reads
+    outside knobs.py); _native.lib() honors it before touching its
+    load cache."""
+    from torchsnapshot_tpu import _native
+
+    assert not knobs.is_native_disabled()
+    with knobs.disable_native():
+        assert knobs.is_native_disabled()
+        assert _native.lib() is None
+    assert not knobs.is_native_disabled()
+
+
+def test_wait_durable_timeout_knob() -> None:
+    assert knobs.get_wait_durable_timeout_seconds() == 1800.0
+    with knobs.override_wait_durable_timeout_seconds(0.25):
+        assert knobs.get_wait_durable_timeout_seconds() == 0.25
+    assert knobs.get_wait_durable_timeout_seconds() == 1800.0

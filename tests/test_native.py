@@ -15,6 +15,7 @@ from torchsnapshot_tpu import _native
 from torchsnapshot_tpu.event_loop import run_in_fresh_event_loop
 from torchsnapshot_tpu.io_types import ReadIO, WriteIO
 from torchsnapshot_tpu.knobs import _override_env
+from torchsnapshot_tpu.knobs import disable_native as _disable_native
 from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
 
 native_only = pytest.mark.skipif(
@@ -113,7 +114,7 @@ def _fs_roundtrip(root: str) -> bytes:
 
 def test_fs_plugin_native_and_fallback_parity(tmp_path) -> None:
     _fs_roundtrip(str(tmp_path / "native"))
-    with _override_env("TORCHSNAPSHOT_TPU_DISABLE_NATIVE", "1"):
+    with _disable_native():
         plugin = FSStoragePlugin(str(tmp_path / "fallback"))
         assert plugin._native is False
         _fs_roundtrip(str(tmp_path / "fallback"))
@@ -126,7 +127,7 @@ def test_fs_ranged_read_past_eof_raises_both_paths(
     """Short blobs are corruption: ranged reads past EOF must fail the same
     way (OSError) whether or not the native lib is in play."""
     ctx = (
-        _override_env("TORCHSNAPSHOT_TPU_DISABLE_NATIVE", "1")
+        _disable_native()
         if disable_native
         else _override_env("_TS_NOOP", None)
     )
@@ -148,7 +149,7 @@ def test_fs_write_falls_back_when_native_vanishes_mid_process(
     """A plugin constructed with native available must still write correctly
     if the disable knob flips afterwards (lib() re-checks env every call)."""
     plugin = FSStoragePlugin(str(tmp_path))
-    with _override_env("TORCHSNAPSHOT_TPU_DISABLE_NATIVE", "1"):
+    with _disable_native():
 
         async def go():
             data = os.urandom(4096)

@@ -1,0 +1,553 @@
+"""snaplint framework tests: one failing fixture per rule (the
+detection that would have caught the bug class before its paired fix),
+the suppression and baseline round-trips, and the repo-wide "analyzer
+is clean on HEAD" lane check that keeps it that way.
+
+Each rule's fixture pair is (bad, fixed): the bad snippet must produce
+a finding and the fixed snippet must not — proving the rule detects the
+violation AND accepts the repo's blessed idiom for it.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.snaplint import Analyzer  # noqa: E402
+from tools.snaplint.core import load_baseline, write_baseline  # noqa: E402
+
+
+def _run(tmp_path, source, rule, baseline=None, filename="mod.py"):
+    f = tmp_path / filename
+    f.write_text(source)
+    analyzer = Analyzer(root=tmp_path, select=[rule])
+    return analyzer.run([f], baseline=baseline)
+
+
+def _messages(result):
+    return [f.message for f in result.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# collective-under-conditional
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_BAD = """
+from torchsnapshot_tpu import knobs
+
+def emit_report(store, rank, world, payload):
+    if knobs.is_telemetry_sink_enabled():
+        store.gather("reports", rank, world, payload)
+    if rank == 0:
+        store.barrier("commit", rank, world)
+"""
+
+# The PR 2 fix shape: the collective is unconditional; only the payload
+# (and the sink write) stay knob-gated.
+_COLLECTIVE_FIXED = """
+from torchsnapshot_tpu import knobs
+
+def emit_report(store, rank, world, payload):
+    gathered = store.gather("reports", rank, world, payload)
+    store.barrier("commit", rank, world)
+    if knobs.is_telemetry_sink_enabled() and gathered is not None:
+        write_out(gathered)
+"""
+
+
+def test_collective_under_conditional_detects_and_accepts_fix(tmp_path):
+    bad = _run(tmp_path, _COLLECTIVE_BAD, "collective-under-conditional")
+    assert len(bad.new_findings) == 2
+    assert any("knob/env" in m for m in _messages(bad))
+    assert any("rank" in m for m in _messages(bad))
+    fixed = _run(
+        tmp_path, _COLLECTIVE_FIXED, "collective-under-conditional"
+    )
+    assert fixed.new_findings == []
+
+
+def test_collective_rule_tracks_taint_through_assignment(tmp_path):
+    source = """
+import os
+
+def sync(store, rank, world):
+    enabled = os.environ.get("TORCHSNAPSHOT_TPU_X") is not None
+    flag = enabled
+    if flag:
+        store.exchange("e", rank, world, None)
+"""
+    result = _run(tmp_path, source, "collective-under-conditional")
+    assert len(result.new_findings) == 1
+
+
+def test_collective_rule_ignores_uniform_and_unrelated_guards(tmp_path):
+    source = """
+def sync(store, rank, world, barrier):
+    if world > 1:
+        store.barrier("b", rank, world)
+    if barrier is not None:
+        barrier.arrive()
+    import asyncio
+    async def go(tasks):
+        if some_flag():
+            await asyncio.gather(*tasks)
+"""
+    result = _run(tmp_path, source, "collective-under-conditional")
+    assert result.new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# async-blocking-call
+# ---------------------------------------------------------------------------
+
+_ASYNC_BAD = """
+import time
+import subprocess
+
+async def drain(fut):
+    time.sleep(0.1)
+    out = subprocess.run(["true"])
+    return fut.result()
+"""
+
+_ASYNC_FIXED = """
+import asyncio
+
+async def drain(fut, loop, executor):
+    await asyncio.sleep(0.1)
+    out = await loop.run_in_executor(executor, run_child)
+    return await fut
+
+
+def sync_helper(fut):
+    # Blocking calls in SYNC functions are fine (executor work).
+    import time
+    time.sleep(0.1)
+    return fut.result()
+"""
+
+
+def test_async_blocking_call_detects_and_accepts_fix(tmp_path):
+    bad = _run(tmp_path, _ASYNC_BAD, "async-blocking-call")
+    msgs = _messages(bad)
+    assert len(bad.new_findings) == 3
+    assert any("time.sleep" in m for m in msgs)
+    assert any(".result()" in m for m in msgs)
+    assert any("subprocess.run" in m for m in msgs)
+    fixed = _run(tmp_path, _ASYNC_FIXED, "async-blocking-call")
+    assert fixed.new_findings == []
+
+
+def test_async_rule_allows_result_with_timeout(tmp_path):
+    source = """
+async def bounded(fut):
+    return fut.result(timeout=5)
+"""
+    assert _run(tmp_path, source, "async-blocking-call").new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# span-and-budget-balance
+# ---------------------------------------------------------------------------
+
+_SPAN_BAD = """
+def timed(recorder):
+    tok = recorder.begin("layer:op")
+    work()
+    recorder.end(tok)
+
+
+async def admit(budget, cost):
+    await budget.acquire(cost)
+    await stage()
+    await budget.release(cost)
+"""
+
+_SPAN_FIXED = """
+def timed(recorder):
+    tok = recorder.begin("layer:op")
+    try:
+        work()
+    finally:
+        recorder.end(tok)
+
+
+def timed_except_idiom(recorder):
+    # The scheduler's stage/except/re-raise shape is also balanced.
+    tok = recorder.begin("layer:op")
+    try:
+        work()
+    except BaseException:
+        recorder.end(tok)
+        raise
+    recorder.end(tok)
+
+
+async def admit(budget, cost):
+    await budget.acquire(cost)
+    try:
+        await stage()
+    finally:
+        await budget.release(cost)
+
+
+async def transfer(budget, cost, tasks):
+    # Acquire-only: ownership moves to a completion task that releases.
+    await budget.acquire(cost)
+    tasks.append(spawn(cost))
+"""
+
+
+def test_span_budget_balance_detects_and_accepts_fix(tmp_path):
+    bad = _run(tmp_path, _SPAN_BAD, "span-and-budget-balance")
+    msgs = _messages(bad)
+    assert len(bad.new_findings) == 2
+    assert any("span 'tok'" in m for m in msgs)
+    assert any("budget.acquire()" in m for m in msgs)
+    fixed = _run(tmp_path, _SPAN_FIXED, "span-and-budget-balance")
+    assert fixed.new_findings == []
+
+
+def test_span_rule_flags_begin_with_no_end_at_all(tmp_path):
+    source = """
+def leaky(recorder):
+    tok = recorder.begin("layer:op")
+    work()
+"""
+    result = _run(tmp_path, source, "span-and-budget-balance")
+    assert len(result.new_findings) == 1
+    assert "never end()ed" in result.new_findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# knob-env-literal
+# ---------------------------------------------------------------------------
+
+_ENV_BAD = """
+import os
+
+_FLAG_ENV = "TORCHSNAPSHOT_TPU_MY_FLAG"
+
+def enabled():
+    return _FLAG_ENV in os.environ
+
+def value():
+    return os.environ.get("TORCHSNAPSHOT_TPU_MY_VALUE", "0")
+
+def via_getenv():
+    return os.getenv("TORCHSNAPSHOT_TPU_OTHER")
+"""
+
+_ENV_FIXED = """
+import os
+from torchsnapshot_tpu import knobs
+
+def enabled():
+    return knobs.is_native_disabled()
+
+def unrelated():
+    # Non-knob env vars are out of scope for this rule.
+    return os.environ.get("JAX_PLATFORMS")
+"""
+
+
+def test_knob_env_literal_detects_and_accepts_fix(tmp_path):
+    bad = _run(tmp_path, _ENV_BAD, "knob-env-literal")
+    msgs = _messages(bad)
+    assert len(bad.new_findings) == 3
+    assert any("TORCHSNAPSHOT_TPU_MY_FLAG" in m for m in msgs)
+    assert any("TORCHSNAPSHOT_TPU_MY_VALUE" in m for m in msgs)
+    assert any("TORCHSNAPSHOT_TPU_OTHER" in m for m in msgs)
+    fixed = _run(tmp_path, _ENV_FIXED, "knob-env-literal")
+    assert fixed.new_findings == []
+
+
+def test_knob_env_literal_exempts_knobs_py_and_writes(tmp_path):
+    knobs_src = """
+import os
+_X = "TORCHSNAPSHOT_TPU_X"
+def get_x():
+    return os.environ.get(_X)
+"""
+    assert (
+        _run(
+            tmp_path, knobs_src, "knob-env-literal", filename="knobs.py"
+        ).new_findings
+        == []
+    )
+    writes = """
+import os
+def set_for_subprocess():
+    os.environ["TORCHSNAPSHOT_TPU_X"] = "1"
+"""
+    assert _run(tmp_path, writes, "knob-env-literal").new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# executor-thread-leak
+# ---------------------------------------------------------------------------
+
+_LEAK_BAD = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+def stage_all(reqs):
+    ex = ThreadPoolExecutor(max_workers=4)
+    for r in reqs:
+        ex.submit(r.run)
+
+def watch():
+    t = threading.Thread(target=poll)
+    t.start()
+"""
+
+_LEAK_FIXED = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+def stage_all(reqs):
+    ex = ThreadPoolExecutor(max_workers=4)
+    try:
+        for r in reqs:
+            ex.submit(r.run)
+    finally:
+        ex.shutdown(wait=False)
+
+def stage_with(reqs):
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        for r in reqs:
+            ex.submit(r.run)
+
+def stage_transfer(reqs):
+    # Ownership escapes to the handle that completes the drain.
+    ex = ThreadPoolExecutor(max_workers=4)
+    return PendingWork(executor=ex)
+
+def watch():
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+
+class Owner:
+    def __init__(self):
+        # Attribute storage: lifecycle owned by the object.
+        self._thread = threading.Thread(target=poll)
+"""
+
+
+def test_executor_thread_leak_detects_and_accepts_fix(tmp_path):
+    bad = _run(tmp_path, _LEAK_BAD, "executor-thread-leak")
+    msgs = _messages(bad)
+    assert len(bad.new_findings) == 2
+    assert any("ThreadPoolExecutor 'ex'" in m for m in msgs)
+    assert any("Thread 't'" in m for m in msgs)
+    fixed = _run(tmp_path, _LEAK_FIXED, "executor-thread-leak")
+    assert fixed.new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions & baseline
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_silences_one_rule(tmp_path):
+    source = """
+import time
+
+async def wait_out():
+    time.sleep(0.1)  # snaplint: disable=async-blocking-call
+"""
+    result = _run(tmp_path, source, "async-blocking-call")
+    assert result.new_findings == []
+    assert len(result.suppressed) == 1
+    # The wrong rule name does NOT suppress.
+    source_wrong = source.replace("async-blocking-call", "some-other-rule")
+    result = _run(tmp_path, source_wrong, "async-blocking-call")
+    assert len(result.new_findings) == 1
+
+
+def test_preceding_line_suppression(tmp_path):
+    source = """
+import time
+
+async def wait_out():
+    # snaplint: disable=async-blocking-call
+    time.sleep(0.1)
+"""
+    result = _run(tmp_path, source, "async-blocking-call")
+    assert result.new_findings == []
+    assert len(result.suppressed) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(_ENV_BAD)
+    analyzer = Analyzer(root=tmp_path, select=["knob-env-literal"])
+    first = analyzer.run([f])
+    assert len(first.new_findings) == 3
+
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(baseline_file, first.findings)
+    baseline = load_baseline(baseline_file)
+    assert len(baseline) == 3
+
+    # Grandfathered findings no longer fail the run...
+    second = analyzer.run([f], baseline=baseline)
+    assert second.new_findings == []
+    assert second.exit_code == 0
+
+    # ...but a NEW violation still does, alone.
+    f.write_text(
+        _ENV_BAD + '\ndef fresh():\n    import os\n'
+        '    return os.getenv("TORCHSNAPSHOT_TPU_BRAND_NEW")\n'
+    )
+    third = analyzer.run([f], baseline=baseline)
+    assert len(third.new_findings) == 1
+    assert "TORCHSNAPSHOT_TPU_BRAND_NEW" in third.new_findings[0].message
+    assert third.exit_code == 1
+
+
+def test_baseline_is_a_multiset_not_a_set(tmp_path):
+    """One grandfathered finding excuses exactly one occurrence: a NEW
+    identical violation in the same file (same rule, same message, a
+    different line) still fails the run."""
+    f = tmp_path / "mod.py"
+    one = (
+        "import os\n"
+        'def a():\n    return os.getenv("TORCHSNAPSHOT_TPU_X")\n'
+    )
+    f.write_text(one)
+    analyzer = Analyzer(root=tmp_path, select=["knob-env-literal"])
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(baseline_file, analyzer.run([f]).findings)
+    baseline = load_baseline(baseline_file)
+
+    f.write_text(
+        one + 'def b():\n    return os.getenv("TORCHSNAPSHOT_TPU_X")\n'
+    )
+    result = analyzer.run([f], baseline=baseline)
+    assert len(result.new_findings) == 1  # the duplicate is NOT masked
+
+
+def test_baseline_key_survives_line_shifts(tmp_path):
+    """Finding keys exclude line numbers — including line references
+    embedded in messages ("guard (line 42)") — so a comment added above
+    a grandfathered finding doesn't churn the baseline."""
+    f = tmp_path / "mod.py"
+    f.write_text(_COLLECTIVE_BAD)
+    analyzer = Analyzer(root=tmp_path, select=["collective-under-conditional"])
+    first = analyzer.run([f])
+    assert len(first.new_findings) == 2
+    assert any("(line " in m for m in _messages(first))
+
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(baseline_file, first.findings)
+    f.write_text("# pushed down\n# two lines\n" + _COLLECTIVE_BAD)
+    shifted = analyzer.run([f], baseline=load_baseline(baseline_file))
+    assert shifted.new_findings == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    result = _run(tmp_path, "def broken(:\n", "knob-env-literal")
+    assert len(result.new_findings) == 1
+    assert result.new_findings[0].rule == "parse-error"
+
+
+# ---------------------------------------------------------------------------
+# repo-wide lane: the analyzer is clean on HEAD and wired into CI
+# ---------------------------------------------------------------------------
+
+
+def test_analyzer_clean_on_head_with_empty_baseline():
+    """Every rule, whole package, no baseline: stays clean. A finding
+    here is either a real concurrency/correctness bug (fix it) or a
+    justified exception (suppress inline with a comment)."""
+    analyzer = Analyzer(root=REPO)
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == [], "\n".join(
+        f.render() for f in result.new_findings
+    )
+
+
+def test_shipped_baseline_is_empty():
+    baseline = load_baseline(REPO / "tools" / "snaplint" / "baseline.json")
+    assert baseline == []
+
+
+def test_cli_default_lane_invocation():
+    """The exact command the default lane runs: module entry point over
+    the package, exit 0, stdlib-only (no jax import needed)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.snaplint", "torchsnapshot_tpu"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "snaplint: clean" in proc.stdout
+
+
+def test_cli_json_output_and_rule_listing():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "tools.snaplint",
+            "torchsnapshot_tpu",
+            "--json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["new_findings"] == []
+
+    listing = subprocess.run(
+        [sys.executable, "-m", "tools.snaplint", "--list-rules"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert listing.returncode == 0
+    for rule in (
+        "collective-under-conditional",
+        "async-blocking-call",
+        "span-and-budget-balance",
+        "knob-env-literal",
+        "executor-thread-leak",
+        "metric-name-literal",
+        "span-name-literal",
+        "tiered-test-markers",
+    ):
+        assert rule in listing.stdout
+
+
+def test_unknown_rule_name_is_an_error():
+    with pytest.raises(ValueError, match="unknown rule"):
+        Analyzer(root=REPO, select=["no-such-rule"])
+
+
+def test_legacy_rules_run_inside_the_framework():
+    """The three pre-snaplint checkers are rules in the same registry;
+    their project-level checks execute in a default run (clean on
+    HEAD)."""
+    analyzer = Analyzer(
+        root=REPO,
+        select=[
+            "metric-name-literal",
+            "span-name-literal",
+            "tiered-test-markers",
+        ],
+    )
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == []

@@ -510,3 +510,61 @@ def test_slow_end_to_end_tiered_training_loop(tmp_path):
         assert verify_snapshot(
             mgr2.step_path(step), deep=True, tier="durable"
         ).ok
+
+
+# ---------------------------------------------------------------------------
+# wait_durable default deadline (snaplint satellite: no unbounded polls)
+# ---------------------------------------------------------------------------
+
+
+def test_wait_durable_default_timeout_is_knob_bounded(tmp_path, monkeypatch):
+    """timeout=None is no longer an unbounded poll: it resolves to the
+    TORCHSNAPSHOT_TPU_WAIT_DURABLE_TIMEOUT_SECONDS knob and surfaces a
+    clear TimeoutError when durability never arrives."""
+    import threading
+    import time as time_mod
+
+    from torchsnapshot_tpu.tiered import mirror as mirror_mod
+
+    _, _, url = _tiers(tmp_path)
+
+    class _SettledFailureFreeJob:
+        def __init__(self):
+            self.done_evt = threading.Event()
+            self.done_evt.set()
+            self.error = None
+
+    class _StubMirror:
+        def jobs_for(self, fast_url):
+            return [_SettledFailureFreeJob()]
+
+        def metrics(self):
+            return {}
+
+    monkeypatch.setattr(mirror_mod, "is_durable", lambda p: False)
+    monkeypatch.setattr(mirror_mod, "get_mirror", lambda: _StubMirror())
+    with knobs.override_wait_durable_timeout_seconds(0.3):
+        t0 = time_mod.monotonic()
+        with pytest.raises(TimeoutError, match="not durable within"):
+            mirror_mod.wait_durable(url, timeout=None)
+        assert time_mod.monotonic() - t0 < 10.0
+
+
+def test_manager_wait_durable_default_deadline_is_knob_bounded(tmp_path):
+    """Manager-level durability barrier with no explicit timeout: a
+    durable index that never names the step times out at the knob
+    deadline with an error naming the step — the watchdog is no longer
+    the only escape hatch."""
+    fast, durable, root = _tiers(tmp_path)
+    mgr = ts.CheckpointManager(root, keep_last_n=3)
+    arr = np.arange(16, dtype=np.float32)
+    mgr.save(1, {"m": ts.PyTreeState({"w": arr})})
+    mgr.wait_durable(1, timeout=60)
+    # Sabotage: the durable tier's index vanishes (misconfigured remote
+    # GC); the step's own blobs stay durable, so only the index poll
+    # can block.
+    os.remove(os.path.join(durable, ".manager_index"))
+    os.remove(os.path.join(durable, ".manager_index.backup"))
+    with knobs.override_wait_durable_timeout_seconds(0.4):
+        with pytest.raises(TimeoutError, match="does not name it"):
+            mgr.wait_durable(1)  # no explicit timeout: the knob bounds it

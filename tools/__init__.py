@@ -1,0 +1,3 @@
+"""Repo lint/check tooling. ``tools.snaplint`` is the AST analysis
+framework; the ``check_*.py`` scripts are standalone entry points (the
+name/marker checkers are thin shims over snaplint rules)."""

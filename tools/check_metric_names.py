@@ -1,125 +1,33 @@
 #!/usr/bin/env python
-"""Metric-name lint for the telemetry registry.
+"""Metric-name lint for the telemetry registry — thin shim.
 
-The exposition namespace (dashboards, alerts, the Prometheus text file)
-only stays stable if metric names are declared in exactly one place.
-This check enforces, statically (AST, stdlib-only — same shape as
-``check_tiered_markers.py``):
-
-- ``torchsnapshot_tpu/telemetry/names.py`` declares every metric name as
-  a module-level string constant: snake_case value, no constant assigned
-  twice, no value declared twice (registered exactly once);
-- no other file under ``torchsnapshot_tpu/`` passes a string literal as
-  the metric name to ``counter_inc``/``gauge_set``/``histogram_observe``
-  — call sites must reference the ``names.py`` constants, so renames are
-  one-line and greppable.
+The implementation moved into the snaplint framework
+(``tools/snaplint/rules/names_lint.py``, rule ``metric-name-literal``);
+this entry point survives so existing invocations and CI lanes keep
+working:
 
     python tools/check_metric_names.py
+
+Prefer the framework run, which applies every rule at once:
+
+    python -m tools.snaplint torchsnapshot_tpu
 """
 
-import ast
-import re
 import sys
 from pathlib import Path
+
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.snaplint.rules.names_lint import (  # noqa: E402
+    check_metric_call_sites as check_call_sites,
+    check_metric_names_file as check_names_file,
+)
 
 ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "torchsnapshot_tpu"
 NAMES_FILE = PACKAGE / "telemetry" / "names.py"
-
-_SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
-# Flight-recorder span/instant names (SPAN_/INSTANT_ constants) use a
-# colon-case "layer:operation" convention; tools/check_span_names.py
-# owns their call-site rules, but declaration hygiene (declared once,
-# well-formed) is enforced here alongside the metrics.
-_COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
-_SPAN_PREFIXES = ("SPAN_", "INSTANT_")
-_REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
-
-
-def check_names_file(path: Path):
-    """Errors in the declaration file: malformed values (snake_case for
-    metrics, colon-case for SPAN_/INSTANT_ trace names), duplicate
-    constants, duplicate values."""
-    errors = []
-    if not path.exists():
-        return [f"{path.name}: missing (metric names must be declared here)"]
-    tree = ast.parse(path.read_text())
-    seen_targets = {}
-    seen_values = {}
-    for node in tree.body:
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if not isinstance(target, ast.Name):
-                continue
-            if not isinstance(node.value, ast.Constant) or not isinstance(
-                node.value.value, str
-            ):
-                errors.append(
-                    f"{path.name}:{node.lineno}: {target.id} is not a "
-                    f"string literal"
-                )
-                continue
-            value = node.value.value
-            if target.id.startswith(_SPAN_PREFIXES):
-                if not _COLON_CASE.match(value):
-                    errors.append(
-                        f"{path.name}:{node.lineno}: {value!r} is not "
-                        f"colon-case (span/instant names look like "
-                        f"'layer:operation')"
-                    )
-            elif not _SNAKE_CASE.match(value):
-                errors.append(
-                    f"{path.name}:{node.lineno}: {value!r} is not "
-                    f"snake_case"
-                )
-            if target.id in seen_targets:
-                errors.append(
-                    f"{path.name}:{node.lineno}: constant {target.id} "
-                    f"assigned twice (first at line "
-                    f"{seen_targets[target.id]})"
-                )
-            seen_targets[target.id] = node.lineno
-            if value in seen_values:
-                errors.append(
-                    f"{path.name}:{node.lineno}: metric {value!r} "
-                    f"registered twice (first at line {seen_values[value]})"
-                )
-            seen_values[value] = node.lineno
-    if not seen_values and not errors:
-        errors.append(f"{path.name}: no metric names declared")
-    return errors
-
-
-def check_call_sites(package: Path, names_file: Path):
-    """Errors at registry call sites: string-literal metric names
-    outside names.py."""
-    errors = []
-    for py in sorted(package.rglob("*.py")):
-        if py == names_file:
-            continue
-        try:
-            tree = ast.parse(py.read_text())
-        except SyntaxError as e:
-            errors.append(f"{py.relative_to(package.parent)}: {e}")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            method = func.attr if isinstance(func, ast.Attribute) else None
-            if method not in _REGISTRY_METHODS or not node.args:
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(
-                first.value, str
-            ):
-                errors.append(
-                    f"{py.relative_to(package.parent)}:{node.lineno}: "
-                    f"literal metric name {first.value!r} in {method}() — "
-                    f"use a telemetry/names.py constant"
-                )
-    return errors
 
 
 def check(package: Path = PACKAGE, names_file: Path = NAMES_FILE):
@@ -136,7 +44,7 @@ def main() -> int:
         print(
             "check_metric_names: metric names are snake_case, registered "
             "exactly once in telemetry/names.py, and call sites use the "
-            "constants"
+            "constants (rule metric-name-literal via tools.snaplint)"
         )
     return 1 if errors else 0
 

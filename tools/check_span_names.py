@@ -1,30 +1,29 @@
 #!/usr/bin/env python
-"""Span-name lint for the flight recorder.
+"""Span-name lint for the flight recorder — thin shim.
 
-The trace timeline (Perfetto queries, the merge CLI's straggler tables,
-the watchdog's stall attribution) keys off span/instant names exactly
-like dashboards key off metric names, so the same single-registration
-rule applies. This check enforces, statically (AST, stdlib-only — same
-shape as ``check_metric_names.py``, which owns the declaration-file
-hygiene for the SPAN_/INSTANT_ constants):
-
-- ``torchsnapshot_tpu/telemetry/names.py`` declares at least one
-  ``SPAN_``/``INSTANT_`` constant, each a colon-case string
-  (``layer:operation``), no constant or value declared twice;
-- no file under ``torchsnapshot_tpu/`` passes a string literal as the
-  name to ``trace_annotation(...)`` or to the recorder's
-  ``span(...)``/``instant(...)``/``begin(...)`` — call sites must
-  reference the ``names.py`` constants, so renames are one-line and
-  timelines never fork spellings. ``telemetry/trace.py`` itself (which
-  receives names as parameters) is exempt.
+The implementation moved into the snaplint framework
+(``tools/snaplint/rules/names_lint.py``, rule ``span-name-literal``);
+this entry point survives so existing invocations and CI lanes keep
+working:
 
     python tools/check_span_names.py
+
+Prefer the framework run, which applies every rule at once:
+
+    python -m tools.snaplint torchsnapshot_tpu
 """
 
-import ast
-import re
 import sys
 from pathlib import Path
+
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.snaplint.rules.names_lint import (  # noqa: E402
+    check_span_call_sites,
+    check_span_names_file as check_names_file,
+)
 
 ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "torchsnapshot_tpu"
@@ -33,97 +32,11 @@ NAMES_FILE = PACKAGE / "telemetry" / "names.py"
 # own span()/instant() machinery; it declares nothing itself.
 EXEMPT = {PACKAGE / "telemetry" / "trace.py"}
 
-_COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
-_SPAN_PREFIXES = ("SPAN_", "INSTANT_")
-_TRACE_CALLABLES = {"trace_annotation", "span", "instant", "begin"}
-
-
-def check_names_file(path: Path):
-    """Errors in the declaration file: no span constants at all,
-    non-colon-case values, duplicate constants/values."""
-    if not path.exists():
-        return [f"{path.name}: missing (span names must be declared here)"]
-    errors = []
-    seen_targets = {}
-    seen_values = {}
-    tree = ast.parse(path.read_text())
-    for node in tree.body:
-        if not isinstance(node, ast.Assign):
-            continue
-        for target in node.targets:
-            if not isinstance(target, ast.Name) or not target.id.startswith(
-                _SPAN_PREFIXES
-            ):
-                continue
-            if not isinstance(node.value, ast.Constant) or not isinstance(
-                node.value.value, str
-            ):
-                errors.append(
-                    f"{path.name}:{node.lineno}: {target.id} is not a "
-                    f"string literal"
-                )
-                continue
-            value = node.value.value
-            if not _COLON_CASE.match(value):
-                errors.append(
-                    f"{path.name}:{node.lineno}: {value!r} is not "
-                    f"colon-case ('layer:operation')"
-                )
-            if target.id in seen_targets:
-                errors.append(
-                    f"{path.name}:{node.lineno}: constant {target.id} "
-                    f"assigned twice (first at line "
-                    f"{seen_targets[target.id]})"
-                )
-            seen_targets[target.id] = node.lineno
-            if value in seen_values:
-                errors.append(
-                    f"{path.name}:{node.lineno}: span {value!r} "
-                    f"registered twice (first at line {seen_values[value]})"
-                )
-            seen_values[value] = node.lineno
-    if not seen_values and not errors:
-        errors.append(f"{path.name}: no span/instant names declared")
-    return errors
-
-
-def _called_name(func) -> str:
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
 
 def check_call_sites(package: Path, exempt=None):
-    """Errors at trace call sites: string-literal span names passed to
-    trace_annotation/span/instant/begin."""
-    exempt = set(exempt or EXEMPT)
-    errors = []
-    for py in sorted(package.rglob("*.py")):
-        if py in exempt:
-            continue
-        try:
-            tree = ast.parse(py.read_text())
-        except SyntaxError as e:
-            errors.append(f"{py.relative_to(package.parent)}: {e}")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            if _called_name(node.func) not in _TRACE_CALLABLES:
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(
-                first.value, str
-            ):
-                errors.append(
-                    f"{py.relative_to(package.parent)}:{node.lineno}: "
-                    f"literal span name {first.value!r} in "
-                    f"{_called_name(node.func)}() — use a "
-                    f"telemetry/names.py constant"
-                )
-    return errors
+    return check_span_call_sites(
+        package, exempt=EXEMPT if exempt is None else exempt
+    )
 
 
 def check(package: Path = PACKAGE, names_file: Path = NAMES_FILE, exempt=None):
@@ -138,7 +51,8 @@ def main() -> int:
         print(
             "check_span_names: span/instant names are colon-case, "
             "registered exactly once in telemetry/names.py, and call "
-            "sites use the constants"
+            "sites use the constants (rule span-name-literal via "
+            "tools.snaplint)"
         )
     return 1 if errors else 0
 

@@ -1,87 +1,43 @@
 #!/usr/bin/env python
-"""Marker-lane check for the tiered-checkpointing tests.
+"""Marker-lane check for the tiered-checkpointing tests — thin shim.
 
-The tiered crash-consistency and latency properties are tier-1 signal:
-they must be collected in the default ``-m 'not slow'`` lane, while the
-end-to-end mirror sweep stays out of it. This check enforces both
-statically (AST, stdlib-only), so a stray module-level ``slow`` mark —
-or an unmarked end-to-end test creeping into the fast lane — fails CI
-instead of silently reshaping the lane:
-
-- ``tests/test_tiered.py`` exists and defines at least one test
-  function WITHOUT ``@pytest.mark.slow`` (the tier-1 lane collects it);
-- every test whose name marks it end-to-end (``end_to_end`` in the
-  name) carries ``@pytest.mark.slow``;
-- the module applies no module-level ``pytestmark`` slow marking (which
-  would empty the fast lane wholesale).
+The implementation moved into the snaplint framework
+(``tools/snaplint/rules/tiered_markers.py``, rule
+``tiered-test-markers``); this entry point survives so existing
+invocations and CI lanes keep working:
 
     python tools/check_tiered_markers.py
+
+Prefer the framework run, which applies every rule at once:
+
+    python -m tools.snaplint torchsnapshot_tpu
 """
 
-import ast
 import sys
 from pathlib import Path
+
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.snaplint.rules.tiered_markers import (  # noqa: E402
+    _has_slow_marker,  # noqa: F401  (kept for import compatibility)
+    check,
+)
 
 ROOT = Path(__file__).resolve().parent.parent
 TIERED_TESTS = ROOT / "tests" / "test_tiered.py"
 
 
-def _has_slow_marker(fn: ast.FunctionDef) -> bool:
-    for dec in fn.decorator_list:
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        if (
-            isinstance(target, ast.Attribute)
-            and target.attr == "slow"
-            and isinstance(target.value, ast.Attribute)
-            and target.value.attr == "mark"
-        ):
-            return True
-    return False
-
-
-def check(path: Path = TIERED_TESTS):
-    errors = []
-    if not path.exists():
-        return [f"{path.name}: missing (tiered tests are tier-1 signal)"]
-    tree = ast.parse(path.read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "pytestmark"
-            for t in node.targets
-        ):
-            errors.append(
-                f"{path.name}: module-level pytestmark would reshape the "
-                f"tier-1 lane; mark individual tests instead"
-            )
-    tests = [
-        n
-        for n in tree.body
-        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
-    ]
-    if not tests:
-        errors.append(f"{path.name}: no test functions found")
-    fast = [t for t in tests if not _has_slow_marker(t)]
-    if not fast:
-        errors.append(
-            f"{path.name}: every test is marked slow — nothing reaches the "
-            f"default -m 'not slow' lane"
-        )
-    for t in tests:
-        if "end_to_end" in t.name and not _has_slow_marker(t):
-            errors.append(
-                f"{path.name}: {t.name} is end-to-end but not marked slow"
-            )
-    return errors
-
-
 def main() -> int:
-    errors = check()
+    errors = check(TIERED_TESTS)
     for e in errors:
         print(e)
     if not errors:
         print(
             "check_tiered_markers: tiered tests are lane-correct "
-            "(fast-lane tests present; end-to-end marked slow)"
+            "(fast-lane tests present; end-to-end marked slow) "
+            "(rule tiered-test-markers via tools.snaplint)"
         )
     return 1 if errors else 0
 

@@ -1,0 +1,53 @@
+"""snaplint: AST-based concurrency & correctness analysis for the
+checkpoint stack.
+
+One shared pass (module loader, scope/taint tracking, rule registry,
+inline suppressions, baseline file) with codebase-specific rules — the
+structural invariants TorchSnapshot's hardest bugs violate:
+
+- ``collective-under-conditional`` — a dist-store collective reachable
+  only under a knob/env/rank guard strands the cross-rank rendezvous
+  when the guard's value skews across ranks (the PR 2 SnapshotReport
+  gather bug class).
+- ``async-blocking-call`` — ``time.sleep`` / no-timeout ``.result()`` /
+  subprocess calls inside ``async def`` bodies stall the event loop the
+  whole overlapped DtoH/IO scheduler runs on.
+- ``span-and-budget-balance`` — a flight-recorder ``begin`` or
+  ``MemoryBudget.acquire`` whose matching ``end``/``release`` is not on
+  every exception path leaks an open span (false watchdog stalls) or
+  budget bytes (pipeline deadlock).
+- ``knob-env-literal`` — ``TORCHSNAPSHOT_TPU_*`` env reads outside
+  ``knobs.py`` fork the knob surface and dodge the test override
+  context managers.
+- ``executor-thread-leak`` — a ``ThreadPoolExecutor``/``Thread`` with
+  no shutdown/join on exception paths (and no daemon flag) leaks OS
+  threads per failed checkpoint.
+
+The pre-existing metric-name, span-name, and tiered-marker checkers are
+rules in the same registry (their ``tools/check_*.py`` entry points are
+kept as thin shims).
+
+Run over the package::
+
+    python -m tools.snaplint torchsnapshot_tpu
+
+Suppress a single finding with a trailing (or preceding-line) comment::
+
+    risky_call()  # snaplint: disable=collective-under-conditional
+
+Accept existing findings wholesale with a baseline::
+
+    python -m tools.snaplint torchsnapshot_tpu --write-baseline
+
+Exit status is non-zero only on findings not in the baseline.
+"""
+
+from .core import (  # noqa: F401
+    Analyzer,
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    all_rules,
+    register,
+)

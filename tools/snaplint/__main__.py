@@ -1,0 +1,151 @@
+"""CLI driver: ``python -m tools.snaplint [paths...]``.
+
+Exit status 1 only on findings not covered by the baseline file
+(default ``tools/snaplint/baseline.json`` when present — the shipped
+baseline is empty: the tree is clean and must stay clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import (
+    Analyzer,
+    all_rules,
+    load_baseline,
+    write_baseline,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.snaplint",
+        description=(
+            "AST-based concurrency & correctness analysis for the "
+            "checkpoint stack"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["torchsnapshot_tpu"],
+        help="files/directories to analyze (default: torchsnapshot_tpu)",
+    )
+    parser.add_argument(
+        "--root",
+        default=str(REPO_ROOT),
+        help="repo root for relative paths (default: this repo)",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule names to run (default: all)",
+    )
+    parser.add_argument(
+        "--disable",
+        help="comma-separated rule names to skip",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help="baseline JSON of accepted findings "
+        "(default: tools/snaplint/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: every finding fails the run",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {cls.description}")
+        return 0
+
+    root = Path(args.root)
+    select = args.select.split(",") if args.select else None
+    disable = args.disable.split(",") if args.disable else None
+    try:
+        analyzer = Analyzer(root=root, select=select, disable=disable)
+    except ValueError as e:
+        print(f"snaplint: {e}", file=sys.stderr)
+        return 2
+
+    paths = [
+        (root / p) if not Path(p).is_absolute() else Path(p)
+        for p in args.paths
+    ]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(
+            f"snaplint: no such path(s): "
+            f"{', '.join(str(m) for m in missing)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    baseline = (
+        [] if args.no_baseline else load_baseline(Path(args.baseline))
+    )
+    result = analyzer.run(paths, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(Path(args.baseline), result.findings)
+        print(
+            f"snaplint: wrote {len(result.findings)} finding(s) to "
+            f"{args.baseline}"
+        )
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "new_findings": [
+                        f.as_dict() for f in result.new_findings
+                    ],
+                    "baselined": len(result.findings)
+                    - len(result.new_findings),
+                    "suppressed": len(result.suppressed),
+                },
+                indent=2,
+            )
+        )
+        return result.exit_code
+
+    for f in result.new_findings:
+        print(f.render())
+    baselined = len(result.findings) - len(result.new_findings)
+    if result.new_findings:
+        print(
+            f"snaplint: {len(result.new_findings)} new finding(s) "
+            f"({baselined} baselined, {len(result.suppressed)} suppressed)"
+        )
+    else:
+        print(
+            f"snaplint: clean — {len(analyzer.rules)} rule(s) over "
+            f"{len(result.project.modules)} file(s) "
+            f"({baselined} baselined, {len(result.suppressed)} suppressed)"
+        )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

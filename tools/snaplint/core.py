@@ -1,0 +1,313 @@
+"""snaplint framework: findings, rule registry, module loader,
+suppressions, baseline, and the analyzer driver.
+
+Everything is stdlib-only (``ast`` + ``json``) so the analyzer runs in
+any lane — including ones where jax itself cannot import.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Type
+
+# A suppression names the rule(s) it silences on its own line or the
+# line directly above the finding:  # snaplint: disable=rule-a,rule-b
+_SUPPRESS_RE = re.compile(r"#\s*snaplint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+# Messages may reference other lines ("guard (line 42)", "first at line
+# 17"); those drift with unrelated edits just like the finding's own
+# line, so they are normalized out of the baseline key.
+_LINE_REF_RE = re.compile(r"\bline \d+\b")
+
+PARSE_ERROR_RULE = "parse-error"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    path: str  # repo-root-relative POSIX path when possible
+    line: int
+    message: str
+    col: int = 0
+
+    def key(self) -> str:
+        """Baseline identity: line numbers — the finding's own AND any
+        referenced in the message — are excluded so unrelated edits
+        above a grandfathered finding don't churn the baseline."""
+        normalized = _LINE_REF_RE.sub("line _", self.message)
+        return f"{self.rule}::{self.path}::{normalized}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ModuleInfo:
+    """A parsed source file shared by every rule (one parse per file)."""
+
+    path: Path
+    relpath: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    _parents: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def parents(self) -> dict:
+        """Child -> parent AST map, built once and shared by every rule
+        (four structural rules walking 69 files must not each rebuild
+        it)."""
+        if self._parents is None:
+            from . import scopes
+
+            self._parents = scopes.parent_map(self.tree)
+        return self._parents
+
+    @classmethod
+    def load(cls, path: Path, root: Path) -> "ModuleInfo":
+        source = path.read_text()
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        tree = ast.parse(source, filename=str(path))
+        return cls(
+            path=path,
+            relpath=rel,
+            source=source,
+            tree=tree,
+            lines=source.splitlines(),
+        )
+
+    def suppressed_rules(self, line: int) -> set:
+        """Rules disabled at 1-indexed ``line`` (same line or the line
+        above)."""
+        out: set = set()
+        for lineno in (line, line - 1):
+            if 1 <= lineno <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[lineno - 1])
+                if m:
+                    out.update(
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    )
+        return out
+
+
+@dataclass
+class Project:
+    """What a rule sees: the repo root plus every loaded module."""
+
+    root: Path
+    modules: List[ModuleInfo]
+    parse_errors: List[Finding] = field(default_factory=list)
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+
+class Rule:
+    """Base rule. Subclasses set ``name``/``description`` and override
+    ``check_module`` (called once per file) and/or ``check_project``
+    (called once per run, for cross-file invariants)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check_module(
+        self, module: ModuleInfo, project: Project
+    ) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(rule_cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the global registry."""
+    if not rule_cls.name:
+        raise ValueError(f"{rule_cls.__name__} has no rule name")
+    if rule_cls.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule_cls.name!r}")
+    _REGISTRY[rule_cls.name] = rule_cls
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    # Importing the rules package populates the registry exactly once.
+    from . import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+def load_project(paths: Sequence[Path], root: Path) -> Project:
+    """Parse every ``.py`` under ``paths`` once; syntax errors become
+    ``parse-error`` findings rather than aborting the run."""
+    files: List[Path] = []
+    seen: set = set()
+    for p in paths:
+        p = Path(p)
+        candidates: List[Path]
+        if p.is_dir():
+            candidates = [
+                f
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            ]
+        elif p.suffix == ".py":
+            candidates = [p]
+        else:
+            candidates = []
+        for f in candidates:
+            resolved = f.resolve()
+            if resolved not in seen:  # overlapping args load a file once
+                seen.add(resolved)
+                files.append(f)
+    modules: List[ModuleInfo] = []
+    parse_errors: List[Finding] = []
+    for f in files:
+        try:
+            modules.append(ModuleInfo.load(f, root))
+        except SyntaxError as e:
+            try:
+                rel = f.resolve().relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            parse_errors.append(
+                Finding(
+                    rule=PARSE_ERROR_RULE,
+                    path=rel,
+                    line=e.lineno or 1,
+                    message=f"syntax error: {e.msg}",
+                )
+            )
+    return Project(root=root, modules=modules, parse_errors=parse_errors)
+
+
+def load_baseline(path: Optional[Path]) -> List[str]:
+    if path is None or not Path(path).exists():
+        return []
+    data = json.loads(Path(path).read_text())
+    return list(data.get("findings", []))
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    # Duplicates are kept on purpose: the baseline is a multiset, so a
+    # grandfathered finding excuses exactly ONE occurrence of its key —
+    # a new identical violation in the same file still fails the run.
+    payload = {
+        "version": 1,
+        "findings": sorted(f.key() for f in findings),
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+class Analyzer:
+    """Load → run rules → suppress → baseline-filter."""
+
+    def __init__(
+        self,
+        root: Path,
+        select: Optional[Sequence[str]] = None,
+        disable: Optional[Sequence[str]] = None,
+    ) -> None:
+        self.root = Path(root)
+        rules = all_rules()
+        unknown = [
+            r for r in list(select or []) + list(disable or []) if r not in rules
+        ]
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        names = list(select) if select else list(rules)
+        names = [n for n in names if n not in set(disable or ())]
+        self.rules: List[Rule] = [rules[n]() for n in names]
+
+    def run(
+        self,
+        paths: Sequence[Path],
+        baseline: Optional[Sequence[str]] = None,
+    ) -> "RunResult":
+        project = load_project(paths, self.root)
+        raw: List[Finding] = list(project.parse_errors)
+        for rule in self.rules:
+            for module in project.modules:
+                raw.extend(rule.check_module(module, project))
+            raw.extend(rule.check_project(project))
+        raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+        kept: List[Finding] = []
+        suppressed: List[Finding] = []
+        side_loaded: Dict[str, Optional[ModuleInfo]] = {}
+        for f in raw:
+            module = project.module(f.path)
+            if module is None:
+                # Project-level rules (the names/marker lints) can
+                # report on files outside the scanned paths; load those
+                # on demand so their inline suppressions still apply.
+                if f.path not in side_loaded:
+                    candidate = self.root / f.path
+                    try:
+                        side_loaded[f.path] = ModuleInfo.load(
+                            candidate, self.root
+                        )
+                    except (OSError, SyntaxError):
+                        side_loaded[f.path] = None
+                module = side_loaded[f.path]
+            rules_off = (
+                module.suppressed_rules(f.line) if module is not None else set()
+            )
+            if f.rule in rules_off or "all" in rules_off:
+                suppressed.append(f)
+            else:
+                kept.append(f)
+
+        # Multiset matching: each baseline entry excuses one occurrence
+        # of its key, so a second identical violation in the same file
+        # is NOT masked by a single grandfathered entry.
+        allowance = Counter(baseline or ())
+        new: List[Finding] = []
+        for f in kept:
+            key = f.key()
+            if allowance[key] > 0:
+                allowance[key] -= 1
+            else:
+                new.append(f)
+        return RunResult(
+            findings=kept,
+            new_findings=new,
+            suppressed=suppressed,
+            project=project,
+        )
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding]  # after suppression, before baseline
+    new_findings: List[Finding]  # after baseline filter: these fail the run
+    suppressed: List[Finding]
+    project: Project
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new_findings else 0

@@ -1,0 +1,13 @@
+"""Rule registry population: importing this package registers every
+rule module. Add a new rule by dropping a module here that defines a
+``@register``-decorated ``Rule`` subclass and importing it below."""
+
+from . import (  # noqa: F401
+    async_blocking_call,
+    collective_under_conditional,
+    executor_thread_leak,
+    knob_env_literal,
+    names_lint,
+    span_budget_balance,
+    tiered_markers,
+)

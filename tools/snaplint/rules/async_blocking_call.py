@@ -1,0 +1,99 @@
+"""async-blocking-call: synchronous blocking calls inside ``async def``.
+
+The whole write/read pipeline multiplexes on one event loop
+(``scheduler.py``); a single ``time.sleep`` or no-timeout
+``Future.result()`` inside a coroutine freezes every in-flight request
+(budget waits, I/O slots, the staging overlap) for its whole duration —
+and unlike a slow await, nothing else runs meanwhile. Flagged inside
+``async def`` bodies:
+
+- ``time.sleep(...)`` (coroutines must ``await asyncio.sleep``),
+- ``<future>.result()`` with no timeout argument (unbounded block on
+  the loop thread; executor hops must be awaited via
+  ``run_in_executor``),
+- ``subprocess.run/call/check_call/check_output`` (block until the
+  child exits).
+
+A sync helper *defined* inside an async function is not flagged — the
+repo pattern is to hand those to an executor.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from ..core import Finding, ModuleInfo, Project, Rule, register
+from .. import scopes
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+
+
+def _time_sleep_aliases(tree: ast.Module) -> Set[str]:
+    """Bare names that mean ``time.sleep`` (``from time import sleep``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    out.add(a.asname or "sleep")
+    return out
+
+
+@register
+class AsyncBlockingCall(Rule):
+    name = "async-blocking-call"
+    description = (
+        "blocking call (time.sleep / no-timeout .result() / subprocess) "
+        "inside an async def body stalls the event loop"
+    )
+
+    def check_module(
+        self, module: ModuleInfo, project: Project
+    ) -> Iterable[Finding]:
+        parents = module.parents
+        sleep_aliases = _time_sleep_aliases(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = scopes.enclosing_function(node, parents)
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            chain = scopes.call_chain(node)
+            reason = None
+            if chain == ["time", "sleep"] or (
+                len(chain) == 1 and chain[0] in sleep_aliases
+            ):
+                reason = (
+                    "time.sleep() blocks the event loop; await "
+                    "asyncio.sleep() instead"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "result"
+                and not node.args
+                and not node.keywords
+                and not isinstance(parents.get(node), ast.Await)
+            ):
+                reason = (
+                    ".result() with no timeout blocks the event loop "
+                    "unboundedly; await the future (or run_in_executor) "
+                    "instead"
+                )
+            elif (
+                len(chain) == 2
+                and chain[0] == "subprocess"
+                and chain[1] in _SUBPROCESS_BLOCKING
+            ):
+                reason = (
+                    f"subprocess.{chain[1]}() blocks until the child "
+                    f"exits; use an executor or asyncio.subprocess"
+                )
+            if reason is not None:
+                yield Finding(
+                    rule=self.name,
+                    path=module.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"in async def {fn.name}(): {reason}",
+                )

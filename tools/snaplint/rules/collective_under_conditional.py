@@ -1,0 +1,109 @@
+"""collective-under-conditional: a dist-store collective reachable only
+under a knob/env/rank guard.
+
+Every collective (``gather``/``exchange``/``broadcast``/``scatter``/
+``barrier``/``arrive``/``depart``/PGWrapper object collectives) is a
+cross-rank rendezvous: EVERY rank must reach it or the participants
+poll out the full store timeout. A knob or env guard can skew across
+ranks (one worker restarted with a different environment), and a rank
+guard around a collective is wrong by construction — so any such call
+whose reachability depends on one is flagged. This is the PR 2 bug
+class: a knob-gated SnapshotReport gather stranded the rendezvous until
+the gather was made unconditional.
+
+Not modeled (see docs/static-analysis.md): a guarded *early return*
+above an unconditional collective (same bug, needs a CFG), and guards
+whose skew is provably uniform (``world_size > 1`` is fine and is not
+flagged — world size is not rank/knob taint).
+
+The modules that *implement* the collectives (``dist_store.py``,
+``pg_wrapper.py``) are exempt: rank-conditional key traffic inside a
+collective's own implementation is its protocol, not a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, ModuleInfo, Project, Rule, register
+from .. import scopes
+
+COLLECTIVE_METHODS = {
+    "gather",
+    "exchange",
+    "broadcast",
+    "scatter",
+    "barrier",
+    "arrive",
+    "depart",
+    "all_gather_object",
+    "broadcast_object",
+    "gather_object",
+    "scatter_object",
+}
+
+# Receivers whose same-named methods are NOT cross-rank collectives.
+_NON_COLLECTIVE_ROOTS = {"asyncio", "mp", "multiprocessing", "np", "numpy"}
+
+EXEMPT_SUFFIXES = (
+    "torchsnapshot_tpu/dist_store.py",
+    "torchsnapshot_tpu/pg_wrapper.py",
+)
+
+
+@register
+class CollectiveUnderConditional(Rule):
+    name = "collective-under-conditional"
+    description = (
+        "dist-store collective reachable only under a knob/env/rank guard "
+        "(cross-rank rendezvous can strand when the guard skews)"
+    )
+
+    def check_module(
+        self, module: ModuleInfo, project: Project
+    ) -> Iterable[Finding]:
+        if module.relpath.endswith(EXEMPT_SUFFIXES):
+            return
+        parents = module.parents
+        knob_names = scopes.knob_import_names(module.tree)
+        taint_cache = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                not isinstance(func, ast.Attribute)
+                or func.attr not in COLLECTIVE_METHODS
+            ):
+                continue
+            chain = scopes.attr_chain(func)
+            if chain and chain[0] in _NON_COLLECTIVE_ROOTS:
+                continue
+            fn = scopes.enclosing_function(node, parents)
+            scope = fn if fn is not None else module.tree
+            if scope not in taint_cache:
+                taint_cache[scope] = scopes.tainted_names(scope, knob_names)
+            knob_taint, rank_taint = taint_cache[scope]
+            for test, guard in scopes.guard_tests(node, parents, stop_at=fn):
+                kinds = []
+                if scopes.expr_knob_tainted(test, knob_taint, knob_names):
+                    kinds.append("knob/env")
+                if scopes.expr_rank_tainted(test, rank_taint):
+                    kinds.append("rank")
+                if kinds:
+                    recv = ".".join(chain) if chain else f"<expr>.{func.attr}"
+                    yield Finding(
+                        rule=self.name,
+                        path=module.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"collective {recv}() is reachable only under a "
+                            f"{'/'.join(kinds)}-dependent guard (line "
+                            f"{guard.lineno}); a skewed guard strands the "
+                            f"cross-rank rendezvous — make the collective "
+                            f"unconditional or gate only its payload"
+                        ),
+                    )
+                    break  # one finding per call is enough

@@ -1,0 +1,176 @@
+"""executor-thread-leak: executors/threads with no exception-path
+cleanup.
+
+A ``ThreadPoolExecutor`` created per checkpoint operation that is not
+shut down when the operation raises leaks its worker threads (and
+whatever buffers their closures pin) on every failed take — the slow
+leak that turns a flaky storage backend into an OOM. Same for a
+non-daemon ``threading.Thread`` that is never joined on the error path.
+
+A local ``ex = ThreadPoolExecutor(...)`` is accepted when:
+
+- it is used as a context manager (``with ThreadPoolExecutor(...)``),
+- some ``ex.shutdown(...)`` sits in a ``finally`` suite or ``except``
+  handler, or
+- ownership escapes the function (returned/yielded, passed as a call
+  argument, or stored into an attribute/container) — the owner's
+  lifecycle is then out of local-analysis reach.
+
+A local ``t = threading.Thread(...)`` is additionally accepted when
+constructed with ``daemon=True`` (or ``t.daemon = True`` before
+start): daemon threads cannot block interpreter exit. Attribute
+targets (``self._thread = ...``) are exempt — object lifecycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import Finding, ModuleInfo, Project, Rule, register
+from .. import scopes
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    chain = scopes.call_chain(call)
+    if not chain:
+        return None
+    if chain[-1] == "ThreadPoolExecutor":
+        return "executor"
+    if chain[-1] == "Thread" and (len(chain) == 1 or chain[0] == "threading"):
+        return "thread"
+    return None
+
+
+def _has_daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if (
+            kw.arg == "daemon"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+        ):
+            return True
+    return False
+
+
+def _escapes(name: str, fn: ast.AST, creating: ast.AST) -> bool:
+    """Does ``name`` leave the function's hands (return/yield, call
+    argument, attribute/container store)?"""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if node.value is not None and _mentions(node.value, name):
+                return True
+        elif isinstance(node, ast.Call) and node is not creating:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    # Method calls ON the object (name.submit(...)) are
+                    # not escapes; name as an argument to anything else
+                    # is.
+                    return True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id == name
+                ):
+                    return True
+    return False
+
+
+def _mentions(expr: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(expr)
+    )
+
+
+def _cleanup_calls(
+    fn: ast.AST, name: str, methods: List[str]
+) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in methods
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+        ):
+            out.append(node)
+    return out
+
+
+def _daemon_set_later(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "daemon"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == name
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True
+                ):
+                    return True
+    return False
+
+
+@register
+class ExecutorThreadLeak(Rule):
+    name = "executor-thread-leak"
+    description = (
+        "ThreadPoolExecutor/Thread without shutdown/join on exception "
+        "paths (and no daemon flag) leaks OS threads per failure"
+    )
+
+    def check_module(
+        self, module: ModuleInfo, project: Project
+    ) -> Iterable[Finding]:
+        parents = module.parents
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            kind = _ctor_kind(node.value)
+            if kind is None:
+                continue
+            if len(node.targets) != 1 or not isinstance(
+                node.targets[0], ast.Name
+            ):
+                continue  # attribute/container target: owner-managed
+            name = node.targets[0].id
+            fn = scopes.enclosing_function(node, parents) or module.tree
+            if kind == "thread" and (
+                _has_daemon_true(node.value) or _daemon_set_later(fn, name)
+            ):
+                continue
+            methods = ["shutdown"] if kind == "executor" else ["join"]
+            cleanup = _cleanup_calls(fn, name, methods)
+            protected = any(
+                scopes.in_finally(c, parents)
+                or scopes.in_except_handler(c, parents)
+                for c in cleanup
+            )
+            if protected or _escapes(name, fn, node.value):
+                continue
+            what = (
+                "ThreadPoolExecutor" if kind == "executor" else "Thread"
+            )
+            fix = (
+                "shutdown() it in a try/finally (or use `with`)"
+                if kind == "executor"
+                else "join() it in a try/finally or construct with "
+                "daemon=True"
+            )
+            yield Finding(
+                rule=self.name,
+                path=module.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"{what} {name!r} has no exception-path cleanup — "
+                    f"{fix}"
+                ),
+            )

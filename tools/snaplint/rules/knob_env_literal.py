@@ -1,0 +1,101 @@
+"""knob-env-literal: ``TORCHSNAPSHOT_TPU_*`` env reads outside knobs.py.
+
+``knobs.py`` is the single home for the knob surface: lazy re-reads,
+documented defaults, and the ``override_*`` context managers tests rely
+on. An env read elsewhere forks that surface — the knob works in
+production but silently ignores the test override (or vice versa), and
+renames miss it. Flags ``os.environ[...]`` / ``.get`` / ``in
+os.environ`` / ``os.getenv`` whose key is a ``TORCHSNAPSHOT_TPU_``
+literal or a module-level constant bound to one.
+
+Writes (``os.environ[...] = ...``) are not flagged: the override
+context managers in conftest-adjacent code legitimately set knob vars
+for subprocesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional
+
+from ..core import Finding, ModuleInfo, Project, Rule, register
+from .. import scopes
+
+PREFIX = "TORCHSNAPSHOT_TPU_"
+_ENV_READ_METHODS = {"get", "pop", "setdefault", "__contains__"}
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            if isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.value.value
+    return out
+
+
+def _key_value(expr: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id)
+    return None
+
+
+def _is_environ(expr: ast.AST) -> bool:
+    chain = scopes.attr_chain(expr)
+    return bool(chain) and chain[-1] == "environ"
+
+
+@register
+class KnobEnvLiteral(Rule):
+    name = "knob-env-literal"
+    description = (
+        "TORCHSNAPSHOT_TPU_* env read outside knobs.py forks the knob "
+        "surface (defaults, overrides, docs)"
+    )
+
+    def check_module(
+        self, module: ModuleInfo, project: Project
+    ) -> Iterable[Finding]:
+        if module.relpath.endswith("knobs.py"):
+            return
+        consts = _module_str_constants(module.tree)
+        for node in ast.walk(module.tree):
+            key = None
+            if isinstance(node, ast.Call):
+                chain = scopes.call_chain(node)
+                if chain and chain[-1] == "getenv" and node.args:
+                    key = _key_value(node.args[0], consts)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ENV_READ_METHODS
+                    and _is_environ(node.func.value)
+                    and node.args
+                ):
+                    key = _key_value(node.args[0], consts)
+            elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+                # Reads only: a Store assignment target has ctx=Store.
+                if isinstance(node.ctx, ast.Load):
+                    key = _key_value(node.slice, consts)
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+                if isinstance(
+                    node.ops[0], (ast.In, ast.NotIn)
+                ) and _is_environ(node.comparators[0]):
+                    key = _key_value(node.left, consts)
+            if key is not None and key.startswith(PREFIX):
+                yield Finding(
+                    rule=self.name,
+                    path=module.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"env var {key!r} read outside knobs.py — add a "
+                        f"knobs.py accessor (plus override context "
+                        f"manager) and call that instead"
+                    ),
+                )

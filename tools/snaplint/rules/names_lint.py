@@ -1,0 +1,370 @@
+"""metric-name-literal / span-name-literal: the PR 2/PR 3 name checkers
+as snaplint rules.
+
+The exposition namespace (dashboards, Prometheus text files) and the
+trace timeline (Perfetto queries, watchdog stall attribution) only stay
+stable if every metric/span name is declared exactly once in
+``telemetry/names.py`` and call sites reference the constants.
+
+Layering: the tree-level generators (``_iter_metric_literal_sites`` /
+``_iter_span_literal_sites``) are the single implementation of the
+call-site checks. The legacy string-producing functions (the public
+surface of ``tools/check_metric_names.py`` / ``check_span_names.py``,
+now shims over this module) wrap them by parsing files from disk; the
+Rule subclasses wrap them over the project's already-parsed modules —
+one parse per file in the default lane, and findings carry the real
+path/line so inline suppressions work.
+
+These are *project-level* rules: the single-registration invariant
+cannot be judged from one file, so they check the whole package
+whenever the repo layout is present, parsing from disk only the
+package files a partial-path run didn't load.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, Iterator, List, Tuple
+
+from ..core import Finding, ModuleInfo, Project, Rule, register
+
+_SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+_COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
+_SPAN_PREFIXES = ("SPAN_", "INSTANT_")
+_REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
+_TRACE_CALLABLES = {"trace_annotation", "span", "instant", "begin"}
+
+NAMES_RELPATH = "torchsnapshot_tpu/telemetry/names.py"
+TRACE_EXEMPT_RELPATH = "torchsnapshot_tpu/telemetry/trace.py"
+
+_LOC_RE = re.compile(r"^(?P<path>[^:]+?\.py):(?P<line>\d+): ")
+
+
+# ---------------------------------------------------------------------------
+# declaration-file checks (string API shared with the shims)
+# ---------------------------------------------------------------------------
+
+
+def check_metric_names_file(
+    path: Path, include_span_decls: bool = True
+) -> List[str]:
+    """Errors in the declaration file: malformed values (snake_case for
+    metrics, colon-case for SPAN_/INSTANT_ trace names), duplicate
+    constants, duplicate values. ``include_span_decls=False`` leaves
+    the SPAN_/INSTANT_ value-shape checks to the span rule (the unified
+    registry runs both rules; each defect should report once)."""
+    errors = []
+    if not path.exists():
+        return [f"{path.name}: missing (metric names must be declared here)"]
+    tree = ast.parse(path.read_text())
+    seen_targets = {}
+    seen_values = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if not isinstance(target, ast.Name):
+                continue
+            if not isinstance(node.value, ast.Constant) or not isinstance(
+                node.value.value, str
+            ):
+                errors.append(
+                    f"{path.name}:{node.lineno}: {target.id} is not a "
+                    f"string literal"
+                )
+                continue
+            value = node.value.value
+            if target.id.startswith(_SPAN_PREFIXES):
+                if include_span_decls and not _COLON_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"colon-case (span/instant names look like "
+                        f"'layer:operation')"
+                    )
+            elif not _SNAKE_CASE.match(value):
+                errors.append(
+                    f"{path.name}:{node.lineno}: {value!r} is not "
+                    f"snake_case"
+                )
+            if target.id in seen_targets:
+                errors.append(
+                    f"{path.name}:{node.lineno}: constant {target.id} "
+                    f"assigned twice (first at line "
+                    f"{seen_targets[target.id]})"
+                )
+            seen_targets[target.id] = node.lineno
+            if value in seen_values:
+                errors.append(
+                    f"{path.name}:{node.lineno}: metric {value!r} "
+                    f"registered twice (first at line {seen_values[value]})"
+                )
+            seen_values[value] = node.lineno
+    if not seen_values and not errors:
+        errors.append(f"{path.name}: no metric names declared")
+    return errors
+
+
+def check_span_names_file(path: Path) -> List[str]:
+    """Errors in the declaration file: no span constants at all,
+    non-colon-case values, duplicate constants/values."""
+    if not path.exists():
+        return [f"{path.name}: missing (span names must be declared here)"]
+    errors = []
+    seen_targets = {}
+    seen_values = {}
+    tree = ast.parse(path.read_text())
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if not isinstance(target, ast.Name) or not target.id.startswith(
+                _SPAN_PREFIXES
+            ):
+                continue
+            if not isinstance(node.value, ast.Constant) or not isinstance(
+                node.value.value, str
+            ):
+                errors.append(
+                    f"{path.name}:{node.lineno}: {target.id} is not a "
+                    f"string literal"
+                )
+                continue
+            value = node.value.value
+            if not _COLON_CASE.match(value):
+                errors.append(
+                    f"{path.name}:{node.lineno}: {value!r} is not "
+                    f"colon-case ('layer:operation')"
+                )
+            if target.id in seen_targets:
+                errors.append(
+                    f"{path.name}:{node.lineno}: constant {target.id} "
+                    f"assigned twice (first at line "
+                    f"{seen_targets[target.id]})"
+                )
+            seen_targets[target.id] = node.lineno
+            if value in seen_values:
+                errors.append(
+                    f"{path.name}:{node.lineno}: span {value!r} "
+                    f"registered twice (first at line {seen_values[value]})"
+                )
+            seen_values[value] = node.lineno
+    if not seen_values and not errors:
+        errors.append(f"{path.name}: no span/instant names declared")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# call-site checks: ONE tree-level implementation
+# ---------------------------------------------------------------------------
+
+
+def _called_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _iter_metric_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, method, literal) for string-literal metric names passed
+    to registry methods."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        method = func.attr if isinstance(func, ast.Attribute) else None
+        if method not in _REGISTRY_METHODS or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield node.lineno, method, first.value
+
+
+def _iter_span_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, callable, literal) for string-literal span names passed
+    to trace_annotation/span/instant/begin."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        called = _called_name(node.func)
+        if called not in _TRACE_CALLABLES:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield node.lineno, called, first.value
+
+
+def check_metric_call_sites(package: Path, names_file: Path) -> List[str]:
+    """Shim API: errors at registry call sites, scanned from disk."""
+    errors = []
+    for py in sorted(package.rglob("*.py")):
+        if py == names_file:
+            continue
+        try:
+            tree = ast.parse(py.read_text())
+        except SyntaxError as e:
+            errors.append(f"{py.relative_to(package.parent)}: {e}")
+            continue
+        for lineno, method, literal in _iter_metric_literal_sites(tree):
+            errors.append(
+                f"{py.relative_to(package.parent)}:{lineno}: "
+                f"literal metric name {literal!r} in {method}() — "
+                f"use a telemetry/names.py constant"
+            )
+    return errors
+
+
+def check_span_call_sites(package: Path, exempt=None) -> List[str]:
+    """Shim API: errors at trace call sites, scanned from disk."""
+    exempt = set(exempt or ())
+    errors = []
+    for py in sorted(package.rglob("*.py")):
+        if py in exempt:
+            continue
+        try:
+            tree = ast.parse(py.read_text())
+        except SyntaxError as e:
+            errors.append(f"{py.relative_to(package.parent)}: {e}")
+            continue
+        for lineno, called, literal in _iter_span_literal_sites(tree):
+            errors.append(
+                f"{py.relative_to(package.parent)}:{lineno}: "
+                f"literal span name {literal!r} in {called}() — use a "
+                f"telemetry/names.py constant"
+            )
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# snaplint rule adapters
+# ---------------------------------------------------------------------------
+
+
+def _parse_loc(error: str, default_path: str) -> Tuple[str, int, str]:
+    m = _LOC_RE.match(error)
+    if m:
+        return m.group("path"), int(m.group("line")), error[m.end():]
+    head, _, rest = error.partition(": ")
+    # A path-shaped head without a line number ("pkg/broken.py: invalid
+    # syntax") still names the real file; don't misattribute it.
+    if head.endswith(".py") and rest:
+        return head, 1, rest
+    return default_path, 1, rest or error
+
+
+def _package_dir(project: Project) -> Path:
+    return project.root / "torchsnapshot_tpu"
+
+
+def _package_trees(
+    project: Project,
+) -> Iterator[Tuple[str, ast.AST]]:
+    """(repo-relative path, tree) for every package file — the
+    project's shared parses where available, disk parses only for
+    package files a partial-path run didn't load. Unparseable files are
+    skipped here (the module loader reports them as parse errors when
+    scanned)."""
+    package = _package_dir(project).resolve()
+    seen = set()
+    for m in project.modules:
+        resolved = m.path.resolve()
+        try:
+            resolved.relative_to(package)
+        except ValueError:
+            continue
+        seen.add(resolved)
+        yield m.relpath, m.tree
+    for py in sorted(_package_dir(project).rglob("*.py")):
+        resolved = py.resolve()
+        if "__pycache__" in py.parts or resolved in seen:
+            continue
+        try:
+            tree = ast.parse(py.read_text())
+        except (OSError, SyntaxError):
+            continue
+        try:
+            rel = resolved.relative_to(project.root.resolve()).as_posix()
+        except ValueError:
+            rel = py.as_posix()
+        yield rel, tree
+
+
+def _decl_findings(
+    rule: str, errors: List[str], project: Project
+) -> Iterable[Finding]:
+    for err in errors:
+        loc_path, line, msg = _parse_loc(err, NAMES_RELPATH)
+        if loc_path == Path(NAMES_RELPATH).name:
+            loc_path = NAMES_RELPATH
+        yield Finding(rule=rule, path=loc_path, line=line, message=msg)
+
+
+@register
+class MetricNameLiteral(Rule):
+    name = "metric-name-literal"
+    description = (
+        "metric names: snake_case, declared exactly once in "
+        "telemetry/names.py, no literals at registry call sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return  # fixture runs without the real package layout
+        # Span declaration hygiene is span-name-literal's: each defect
+        # reports once in a unified run.
+        yield from _decl_findings(
+            self.name,
+            check_metric_names_file(names_file, include_span_decls=False),
+            project,
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath == NAMES_RELPATH:
+                continue
+            for lineno, method, literal in _iter_metric_literal_sites(tree):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal metric name {literal!r} in {method}() "
+                        f"— use a telemetry/names.py constant"
+                    ),
+                )
+
+
+@register
+class SpanNameLiteral(Rule):
+    name = "span-name-literal"
+    description = (
+        "span/instant names: colon-case, declared exactly once in "
+        "telemetry/names.py, no literals at trace call sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return
+        yield from _decl_findings(
+            self.name, check_span_names_file(names_file), project
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath in (NAMES_RELPATH, TRACE_EXEMPT_RELPATH):
+                continue
+            for lineno, called, literal in _iter_span_literal_sites(tree):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal span name {literal!r} in {called}() — "
+                        f"use a telemetry/names.py constant"
+                    ),
+                )

@@ -1,0 +1,174 @@
+"""span-and-budget-balance: recorder ``begin`` / ``MemoryBudget.acquire``
+must be released on every exception path.
+
+An unclosed flight-recorder span poisons everything downstream of the
+ring: the Chrome export produces crossed B/E stacks, and the stall
+watchdog attributes a permanent false stall to the leaked span. Leaked
+budget bytes are worse — ``MemoryBudget`` admission waits forever on
+capacity that will never be released, deadlocking the next pipeline.
+
+Accepted as balanced, for a local ``tok = <recorder>.begin(...)``:
+
+- some ``<recorder>.end(tok)`` sits in a ``finally`` suite, or
+- ``end(tok)`` appears both in an ``except`` handler and on the normal
+  path (the scheduler's stage/except/re-raise idiom).
+
+``with recorder.span(...)`` needs no analysis (the context manager is
+the fix this rule pushes toward). Begin tokens stored on ``self`` are
+exempt: their lifecycle belongs to the owning object (e.g.
+``trace_annotation.__enter__``/``__exit__``).
+
+For budgets: a function that both ``acquire``s and ``release``s the
+same budget receiver must have a release in a ``finally``/``except``
+suite. Acquire-only functions are exempt (ownership transfer to a
+completion task is the pipeline's design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from ..core import Finding, ModuleInfo, Project, Rule, register
+from .. import scopes
+
+
+def _receiver_key(func: ast.Attribute) -> Tuple[str, ...]:
+    """Identity of the thing being acquired/released: the attr chain of
+    the receiver (``self.budget.acquire`` -> ("self", "budget"))."""
+    return tuple(scopes.attr_chain(func.value))
+
+
+def _is_budget_receiver(key: Tuple[str, ...]) -> bool:
+    return bool(key) and "budget" in key[-1].lower()
+
+
+@register
+class SpanBudgetBalance(Rule):
+    name = "span-and-budget-balance"
+    description = (
+        "flight-recorder begin / MemoryBudget.acquire without a "
+        "try/finally (or except+normal-path) release"
+    )
+
+    def check_module(
+        self, module: ModuleInfo, project: Project
+    ) -> Iterable[Finding]:
+        parents = module.parents
+        functions = [
+            n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in functions:
+            yield from self._check_spans(module, fn, parents)
+            yield from self._check_budget(module, fn, parents)
+
+    # -- spans -----------------------------------------------------------
+
+    def _check_spans(self, module, fn, parents) -> Iterable[Finding]:
+        # begin() assignments to plain names, owned by THIS function
+        # (nested defs analyze separately).
+        begins: List[Tuple[str, ast.Call]] = []
+        ends: Dict[str, List[ast.Call]] = {}
+        for node in ast.walk(fn):
+            if scopes.enclosing_function(node, parents) is not fn:
+                continue
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                call = node.value
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "begin"
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    begins.append((node.targets[0].id, call))
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                ends.setdefault(node.args[0].id, []).append(node)
+        for name, call in begins:
+            end_calls = ends.get(name, [])
+            if not end_calls:
+                yield Finding(
+                    rule=self.name,
+                    path=module.relpath,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    message=(
+                        f"span {name!r} begun in {fn.name}() is never "
+                        f"end()ed in this function; an exception leaks an "
+                        f"open span (false watchdog stalls, crossed trace "
+                        f"stacks) — close it in a try/finally"
+                    ),
+                )
+                continue
+            in_fin = any(scopes.in_finally(e, parents) for e in end_calls)
+            in_exc = any(
+                scopes.in_except_handler(e, parents) for e in end_calls
+            )
+            on_normal = any(
+                not scopes.in_except_handler(e, parents) for e in end_calls
+            )
+            if not (in_fin or (in_exc and on_normal)):
+                yield Finding(
+                    rule=self.name,
+                    path=module.relpath,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    message=(
+                        f"span {name!r} begun in {fn.name}() is end()ed "
+                        f"only on the normal path; wrap the end() in a "
+                        f"try/finally so exception paths close it too"
+                    ),
+                )
+
+    # -- budget ----------------------------------------------------------
+
+    def _check_budget(self, module, fn, parents) -> Iterable[Finding]:
+        acquires: Dict[Tuple[str, ...], List[ast.Call]] = {}
+        releases: Dict[Tuple[str, ...], List[ast.Call]] = {}
+        for node in ast.walk(fn):
+            if scopes.enclosing_function(node, parents) is not fn:
+                continue
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            key = _receiver_key(node.func)
+            if not _is_budget_receiver(key):
+                continue
+            if node.func.attr == "acquire":
+                acquires.setdefault(key, []).append(node)
+            elif node.func.attr == "release":
+                releases.setdefault(key, []).append(node)
+        for key, acq in acquires.items():
+            rel = releases.get(key, [])
+            if not rel:
+                continue  # ownership transfer: release lives elsewhere
+            protected = any(
+                scopes.in_finally(r, parents)
+                or scopes.in_except_handler(r, parents)
+                for r in rel
+            )
+            if not protected:
+                recv = ".".join(key)
+                yield Finding(
+                    rule=self.name,
+                    path=module.relpath,
+                    line=acq[0].lineno,
+                    col=acq[0].col_offset,
+                    message=(
+                        f"{recv}.acquire() in {fn.name}() has releases "
+                        f"only on the normal path; an exception leaks "
+                        f"budget bytes and deadlocks later admission — "
+                        f"release in a try/finally"
+                    ),
+                )

@@ -1,0 +1,88 @@
+"""tiered-test-markers: the PR 1 marker-lane checker as a snaplint rule.
+
+The tiered crash-consistency and latency properties are tier-1 signal:
+they must be collected in the default ``-m 'not slow'`` lane, while the
+end-to-end mirror sweep stays out of it. The ``check`` function here is
+the original from ``tools/check_tiered_markers.py`` (now a thin shim
+over this module).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List
+
+from ..core import Finding, Project, Rule, register
+
+TIERED_TESTS_RELPATH = "tests/test_tiered.py"
+
+
+def _has_slow_marker(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if (
+            isinstance(target, ast.Attribute)
+            and target.attr == "slow"
+            and isinstance(target.value, ast.Attribute)
+            and target.value.attr == "mark"
+        ):
+            return True
+    return False
+
+
+def check(path: Path) -> List[str]:
+    errors = []
+    if not path.exists():
+        return [f"{path.name}: missing (tiered tests are tier-1 signal)"]
+    tree = ast.parse(path.read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "pytestmark"
+            for t in node.targets
+        ):
+            errors.append(
+                f"{path.name}: module-level pytestmark would reshape the "
+                f"tier-1 lane; mark individual tests instead"
+            )
+    tests = [
+        n
+        for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
+    ]
+    if not tests:
+        errors.append(f"{path.name}: no test functions found")
+    fast = [t for t in tests if not _has_slow_marker(t)]
+    if not fast:
+        errors.append(
+            f"{path.name}: every test is marked slow — nothing reaches the "
+            f"default -m 'not slow' lane"
+        )
+    for t in tests:
+        if "end_to_end" in t.name and not _has_slow_marker(t):
+            errors.append(
+                f"{path.name}: {t.name} is end-to-end but not marked slow"
+            )
+    return errors
+
+
+@register
+class TieredTestMarkers(Rule):
+    name = "tiered-test-markers"
+    description = (
+        "tiered tests stay lane-correct: fast-lane tests present, "
+        "end-to-end sweeps marked slow"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        path = project.root / TIERED_TESTS_RELPATH
+        if not (project.root / "torchsnapshot_tpu").is_dir():
+            return ()  # fixture run outside the real repo layout
+        for err in check(path):
+            msg = err.split(": ", 1)[1] if ": " in err else err
+            yield Finding(
+                rule=self.name,
+                path=TIERED_TESTS_RELPATH,
+                line=1,
+                message=msg,
+            )

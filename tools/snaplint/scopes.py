@@ -1,0 +1,247 @@
+"""Scope, guard, and taint helpers shared by the snaplint rules.
+
+The rules reason about three structural questions:
+
+- what function (sync or async) encloses a node,
+- which ``if``/``while`` tests guard its reachability, and
+- whether an expression's value derives from a knob/env read or from
+  the process's rank (one intraprocedural taint fixpoint over simple
+  assignments — enough for the repo idiom ``enabled = knobs.is_x()``
+  / ``if enabled: ...``).
+
+All of it is conservative and local: taint does not flow across
+function boundaries, and guarded *early returns* are not modeled (a
+``if knob: return`` above an unconditional collective is the same bug
+class but needs a CFG; the rule docs call this out).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Call targets whose result depends on this process's rank.
+_RANK_CALLS = {"get_rank", "process_index"}
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Iterator[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def enclosing_function(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.AST]:
+    """Innermost enclosing function def (or None at module level)."""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, FunctionNode):
+            return anc
+    return None
+
+
+def attr_chain(expr: ast.AST) -> List[str]:
+    """``os.environ.get`` -> ["os", "environ", "get"]; empty when the
+    expression roots in something other than a plain name (a call's
+    result, a subscript, ...)."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return list(reversed(parts))
+    return []
+
+
+def call_chain(call: ast.Call) -> List[str]:
+    return attr_chain(call.func)
+
+
+def guard_tests(
+    node: ast.AST,
+    parents: Dict[ast.AST, ast.AST],
+    stop_at: Optional[ast.AST] = None,
+) -> List[Tuple[ast.expr, ast.AST]]:
+    """The (test expression, guard node) pairs controlling ``node``'s
+    reachability, innermost first, up to ``stop_at`` (typically the
+    enclosing function). Both branches of an ``if`` count: the else
+    branch of a knob guard is exactly as knob-dependent as the body."""
+    out: List[Tuple[ast.expr, ast.AST]] = []
+    child = node
+    for anc in ancestors(node, parents):
+        if anc is stop_at or isinstance(anc, FunctionNode):
+            break
+        if isinstance(anc, (ast.If, ast.While)) and child is not anc.test:
+            out.append((anc.test, anc))
+        elif isinstance(anc, ast.IfExp) and child is not anc.test:
+            out.append((anc.test, anc))
+        child = anc
+    return out
+
+
+def knob_import_names(tree: ast.Module) -> Set[str]:
+    """Names imported from a ``knobs`` module (``from .knobs import
+    is_batching_enabled``): calls to them are knob taint sources even
+    without the ``knobs.`` prefix."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "knobs" or node.module.endswith(".knobs"):
+                names.update(a.asname or a.name for a in node.names)
+    return names
+
+
+def _expr_has_env_read(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        chain = []
+        if isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+        elif isinstance(node, ast.Name):
+            chain = [node.id]
+        elif isinstance(node, ast.Call):
+            chain = call_chain(node)
+        if "environ" in chain or "getenv" in chain:
+            return True
+    return False
+
+
+def expr_knob_tainted(
+    expr: ast.AST,
+    tainted: Optional[Set[str]] = None,
+    knob_names: Optional[Set[str]] = None,
+) -> bool:
+    """Does ``expr`` derive from a knob accessor or an env read?"""
+    tainted = tainted or set()
+    knob_names = knob_names or set()
+    if _expr_has_env_read(expr):
+        return True
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            chain = call_chain(node)
+            if "knobs" in chain:
+                return True
+            if len(chain) == 1 and chain[0] in knob_names:
+                return True
+        elif isinstance(node, ast.Name) and node.id in tainted:
+            return True
+    return False
+
+
+def expr_rank_tainted(
+    expr: ast.AST, tainted: Optional[Set[str]] = None
+) -> bool:
+    """Does ``expr`` depend on this process's rank? Matches terminal
+    identifiers named/containing ``rank`` (``rank``, ``self.rank``,
+    ``local_rank``) and rank-returning calls (``get_rank()``,
+    ``jax.process_index()``)."""
+    tainted = tainted or set()
+    for node in ast.walk(expr):
+        terminal = None
+        if isinstance(node, ast.Call):
+            chain = call_chain(node)
+            terminal = chain[-1] if chain else None
+            if terminal in _RANK_CALLS:
+                return True
+        elif isinstance(node, ast.Attribute):
+            terminal = node.attr
+        elif isinstance(node, ast.Name):
+            terminal = node.id
+            if terminal in tainted:
+                return True
+        if terminal is not None and "rank" in terminal.lower():
+            return True
+    return False
+
+
+def _assign_targets(node: ast.AST) -> List[str]:
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and node.value:
+        targets = [node.target]
+    elif isinstance(node, ast.NamedExpr):
+        targets = [node.target]
+    return [t.id for t in targets if isinstance(t, ast.Name)]
+
+
+def tainted_names(
+    scope: ast.AST,
+    knob_names: Optional[Set[str]] = None,
+) -> Tuple[Set[str], Set[str]]:
+    """(knob-tainted, rank-tainted) local names in ``scope`` (a function
+    or module node): a small fixpoint over simple assignments so
+    ``a = knobs.is_x(); b = a; if b: ...`` still classifies."""
+    knob_taint: Set[str] = set()
+    rank_taint: Set[str] = set()
+    assigns = [
+        n
+        for n in ast.walk(scope)
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.NamedExpr))
+        and getattr(n, "value", None) is not None
+    ]
+    changed = True
+    while changed:
+        changed = False
+        for node in assigns:
+            names = _assign_targets(node)
+            if not names:
+                continue
+            if expr_knob_tainted(node.value, knob_taint, knob_names):
+                new = set(names) - knob_taint
+                if new:
+                    knob_taint.update(new)
+                    changed = True
+            if expr_rank_tainted(node.value, rank_taint):
+                new = set(names) - rank_taint
+                if new:
+                    rank_taint.update(new)
+                    changed = True
+    return knob_taint, rank_taint
+
+
+def in_finally(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    """Is ``node`` inside some ``try``'s ``finally`` suite?"""
+    child = node
+    for anc in ancestors(node, parents):
+        if isinstance(anc, (ast.Try,)) and _in_block(child, anc.finalbody):
+            return True
+        if isinstance(anc, FunctionNode):
+            return False
+        child = anc
+    return False
+
+
+def in_except_handler(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> bool:
+    for anc in ancestors(node, parents):
+        if isinstance(anc, ast.ExceptHandler):
+            return True
+        if isinstance(anc, FunctionNode):
+            return False
+    return False
+
+
+def _in_block(node: ast.AST, block: List[ast.stmt]) -> bool:
+    for stmt in block:
+        if node is stmt or any(node is d for d in ast.walk(stmt)):
+            return True
+    return False
+
+
+def with_context_exprs(node: ast.With) -> List[ast.expr]:
+    return [item.context_expr for item in node.items]

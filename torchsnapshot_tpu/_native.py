@@ -24,9 +24,9 @@ import tempfile
 import threading
 from typing import List, Optional, Sequence, Tuple
 
-logger = logging.getLogger(__name__)
+from . import knobs
 
-_DISABLE_NATIVE_ENV = "TORCHSNAPSHOT_TPU_DISABLE_NATIVE"
+logger = logging.getLogger(__name__)
 
 _SRC_PATH = os.path.join(os.path.dirname(__file__), "native", "ts_io.cpp")
 
@@ -105,7 +105,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
 def lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, or None (disabled / unbuildable)."""
     global _lib, _load_attempted
-    if _DISABLE_NATIVE_ENV in os.environ:
+    if knobs.is_native_disabled():
         return None
     if _load_attempted:
         return _lib

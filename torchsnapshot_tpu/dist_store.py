@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 _DEFAULT_TIMEOUT_S = 300.0
 _POLL_INTERVAL_S = 0.005
+_CONNECT_TIMEOUT_S = 30.0
 
 
 @dataclass
@@ -346,8 +347,15 @@ class TCPStore(Store):
     (reference analog: ``get_or_create_store`` bootstrapping a c10d
     TCPStore, dist_store.py:22-88)."""
 
-    def __init__(self, host: str, port: int, is_server: bool) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        is_server: bool,
+        connect_timeout: float = _CONNECT_TIMEOUT_S,
+    ) -> None:
         self._server: Optional[_StoreServer] = None
+        self._connect_timeout = connect_timeout
         if is_server:
             self._server = _StoreServer((host, port))
             self.port = self._server.server_address[1]
@@ -363,17 +371,44 @@ class TCPStore(Store):
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            deadline = time.monotonic() + 30
+            deadline = time.monotonic() + self._connect_timeout
             while True:
+                # Per-attempt timeout bounded by the remaining deadline:
+                # without it, an unreachable host (firewall DROP, dead
+                # VM) sits in the kernel's SYN-retry cycle for minutes
+                # and the deadline below never gets a chance to fire.
+                remaining = deadline - time.monotonic()
                 try:
-                    self._sock = socket.create_connection((self.host, self.port))
-                    self._sock.setsockopt(
+                    sock = socket.create_connection(
+                        (self.host, self.port),
+                        timeout=max(0.05, min(5.0, remaining)),
+                    )
+                    # Back to blocking mode: the per-attempt timeout
+                    # must not leak into request/response recv calls.
+                    sock.settimeout(None)
+                    sock.setsockopt(
                         socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                     )
+                    self._sock = sock
                     break
-                except ConnectionRefusedError:
+                except socket.gaierror:
+                    # Name resolution failing is a misconfiguration
+                    # (typo'd host), not a leader that hasn't bound
+                    # yet: fail fast instead of burning the deadline.
+                    raise
+                except OSError as e:
+                    # Deadline-bounded with a clear timeout error: a
+                    # leader that never comes up must read as "store
+                    # unreachable", not as a raw ECONNREFUSED (or a
+                    # minutes-late EHOSTUNREACH) from deep inside a
+                    # collective.
                     if time.monotonic() > deadline:
-                        raise
+                        raise StoreTimeoutError(
+                            f"Timed out connecting to store at "
+                            f"{self.host}:{self.port} after "
+                            f"{self._connect_timeout:.1f}s (is the rank-0 "
+                            f"store server up?)"
+                        ) from e
                     time.sleep(0.05)
         return self._sock
 

@@ -37,9 +37,12 @@ _TRACE_ENV = "TORCHSNAPSHOT_TPU_TRACE"
 _TRACE_DIR_ENV = "TORCHSNAPSHOT_TPU_TRACE_DIR"
 _TRACE_BUFFER_EVENTS_ENV = "TORCHSNAPSHOT_TPU_TRACE_BUFFER_EVENTS"
 _WATCHDOG_SECONDS_ENV = "TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS"
+_DISABLE_NATIVE_ENV = "TORCHSNAPSHOT_TPU_DISABLE_NATIVE"
+_WAIT_DURABLE_TIMEOUT_ENV = "TORCHSNAPSHOT_TPU_WAIT_DURABLE_TIMEOUT_SECONDS"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
+_DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS: float = 1800.0
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -208,6 +211,29 @@ def get_watchdog_deadline_seconds() -> float:
     return _DEFAULT_WATCHDOG_SECONDS
 
 
+def is_native_disabled() -> bool:
+    """Kill-switch for the ctypes native I/O runtime (``_native.py``):
+    presence of the env var keeps ``lib()`` returning None so every
+    caller stays on its pure-Python path. Behavior is identical either
+    way, only slower — the switch exists for bisecting suspected
+    native-path issues and for machines where building the .so is
+    undesirable."""
+    return _DISABLE_NATIVE_ENV in os.environ
+
+
+def get_wait_durable_timeout_seconds() -> float:
+    """Default deadline for durability barriers (``wait_durable`` on the
+    manager and the tiered mirror) when the caller passes no explicit
+    timeout. A mirror wedged on a browning-out durable tier must
+    surface as a ``TimeoutError`` naming the step, not as an unbounded
+    poll loop only the stall watchdog can see into. <= 0 restores the
+    old unbounded wait (explicitly opted into, never the default)."""
+    val = os.environ.get(_WAIT_DURABLE_TIMEOUT_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS
+
+
 def get_prometheus_textfile() -> Optional[str]:
     """Prometheus text-exposition file, rewritten (atomically) after
     every report emission — the node-exporter textfile-collector
@@ -340,6 +366,20 @@ def override_watchdog_deadline_seconds(
     seconds: float,
 ) -> Generator[None, None, None]:
     with _override_env(_WATCHDOG_SECONDS_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def disable_native() -> Generator[None, None, None]:
+    with _override_env(_DISABLE_NATIVE_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_wait_durable_timeout_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_WAIT_DURABLE_TIMEOUT_ENV, str(seconds)):
         yield
 
 

@@ -685,11 +685,21 @@ class CheckpointManager:
         Immediate no-op for non-tiered roots (their commit was the
         durable write). Raises ``TimeoutError`` on deadline, and
         re-raises a failed mirror's error (the fast tier remains
-        restorable; the journal resumes the upload)."""
+        restorable; the journal resumes the upload).
+
+        ``timeout=None`` (the default) is NOT unbounded: it resolves to
+        the ``TORCHSNAPSHOT_TPU_WAIT_DURABLE_TIMEOUT_SECONDS`` knob
+        (default 30 min) so a wedged durable tier surfaces as a clear
+        ``TimeoutError`` instead of a silent poll loop the stall
+        watchdog is the only escape from. A non-positive knob value
+        restores the unbounded wait, explicitly."""
         import time as _time
 
         from .tiered.mirror import wait_durable as _wait_durable
 
+        if timeout is None:
+            default_timeout = knobs.get_wait_durable_timeout_seconds()
+            timeout = default_timeout if default_timeout > 0 else None
         tiers = split_tiered_url(self.root)
         deadline = (
             _time.monotonic() + timeout if timeout is not None else None

@@ -601,10 +601,18 @@ def wait_durable(
     journal/manifest first if no job is in flight (the restarted-process
     case); then confirms the durable commit marker exists. Raises
     ``TimeoutError`` when the deadline lapses with durability not yet
-    reached."""
+    reached.
+
+    ``timeout=None`` resolves to the
+    ``TORCHSNAPSHOT_TPU_WAIT_DURABLE_TIMEOUT_SECONDS`` knob (default
+    30 min) rather than waiting forever; a non-positive knob value
+    opts back into the unbounded wait."""
     tiers = split_tiered_url(path_url)
     if tiers is None:
         return
+    if timeout is None:
+        default_timeout = knobs.get_wait_durable_timeout_seconds()
+        timeout = default_timeout if default_timeout > 0 else None
     fast_url, _ = tiers
     deadline = time.monotonic() + timeout if timeout is not None else None
     mirror = get_mirror()
