@@ -91,6 +91,8 @@ import numpy as np
 
 import torchsnapshot_tpu as ts
 from torchsnapshot_tpu import scheduler as ts_scheduler
+from torchsnapshot_tpu.telemetry import doctor as ts_doctor
+from torchsnapshot_tpu.telemetry import names as ts_names
 
 REFERENCE_SINGLE_ACCEL_GBPS = 20.0 / 13.91  # benchmarks/ddp/README.md:17
 
@@ -324,19 +326,17 @@ def _bracketed_efficiency(times_s, probes_gbps, gib):
     definition, so the two legs can never drift apart): transfer i's
     ratio is achieved / max(probe_before, probe_after) — probes are
     lower bounds of attainable, so the bracket's max is the tightest
-    estimate covering that window — and the link is flagged unstable
-    when adjacent probes disagree by >1.5x. Returns
+    estimate covering that window. Stability thresholds now live in the
+    checkpoint doctor (telemetry/doctor.py) so the bench and production
+    agree on what "unstable" means; ``link_unstable`` is the doctor's
+    series-level probe check. Returns
     (brackets, ratios, median_efficiency, link_unstable)."""
     brackets = [
         max(probes_gbps[i], probes_gbps[i + 1]) for i in range(len(times_s))
     ]
     ratios = [(gib / t) / b for t, b in zip(times_s, brackets) if b > 0]
     efficiency = statistics.median(ratios) if ratios else 0.0
-    unstable = any(
-        max(a, b) / min(a, b) > 1.5
-        for a, b in zip(probes_gbps, probes_gbps[1:])
-        if min(a, b) > 0
-    )
+    unstable = ts_doctor.probes_unstable(probes_gbps)
     return brackets, ratios, efficiency, unstable
 
 
@@ -668,11 +668,17 @@ def main() -> None:
             # Stall self-diagnosis runs NOW, not after the loop: the
             # snap dir (and its .trace-take-rank0.json) is deleted
             # before the next trial, so the top spans must be read
-            # while the evidence exists. Same ratio formula as
-            # _bracketed_efficiency / the in_take_stall flag below.
+            # while the evidence exists. The diagnosis itself is the
+            # shared checkpoint doctor's — the same rule production
+            # callers get — so bench and doctor can never disagree
+            # about what "stalled" means.
             a, b = matched_probes[i], matched_probes[i + 1]
-            stable = min(a, b) > 0 and max(a, b) / min(a, b) <= 1.5
-            if stable and (gib / take_times[-1]) / max(a, b) < 0.5:
+            trial_verdicts = ts_doctor.diagnose_take_trial(
+                take_times[-1], gib, a, b, phases=take_phases[-1]
+            )
+            if any(
+                v.rule == ts_names.RULE_IN_TAKE_STALL for v in trial_verdicts
+            ):
                 # Resolve through the sink's own path logic: with
                 # TORCHSNAPSHOT_TPU_TRACE_DIR set, the export went there,
                 # not next to the snapshot.
@@ -707,11 +713,14 @@ def main() -> None:
 
         # Per-trial ratio: take i divided by the better of its bracketing
         # probes. A ratio > 1 means the link outran both probes during
-        # the take — the pipeline is not the limit there. A stable
-        # bracket (adjacent probes within 1.5x) with ratio < 0.5 is
-        # flagged in_take_stall: the slowdown happened INSIDE the take
-        # (writeback storm, tunnel hiccup, GC), and the phase timestamps
-        # say where the wall went.
+        # the take — the pipeline is not the limit there. The stall and
+        # stability thresholds are the checkpoint doctor's
+        # (diagnose_take_trial): a stable bracket with ratio below the
+        # doctor's stall ratio is flagged in_take_stall — the slowdown
+        # happened INSIDE the take (writeback storm, tunnel hiccup, GC),
+        # and the phase timestamps say where the wall went. JSON keys
+        # are unchanged for BENCH_r* comparability; each diagnostic
+        # additionally embeds the doctor's verdict ids.
         denom = statistics.median(matched_probes)
         brackets, ratios, efficiency, link_unstable = _bracketed_efficiency(
             take_times, matched_probes, gib
@@ -719,15 +728,17 @@ def main() -> None:
         diagnostics = []
         for i, t in enumerate(take_times):
             a, b = matched_probes[i], matched_probes[i + 1]
-            stable = min(a, b) > 0 and max(a, b) / min(a, b) <= 1.5
             phases = take_phases[i] or {}
+            trial_verdicts = ts_doctor.diagnose_take_trial(
+                t, gib, a, b, phases=phases
+            )
+            verdict_ids = [v.rule for v in trial_verdicts]
             diag = {
                 "take_s": round(t, 2),
                 "bracket_gbps": [round(a, 3), round(b, 3)],
                 "ratio": round(ratios[i], 3) if i < len(ratios) else None,
-                "in_take_stall": bool(
-                    stable and i < len(ratios) and ratios[i] < 0.5
-                ),
+                "in_take_stall": ts_names.RULE_IN_TAKE_STALL in verdict_ids,
+                "verdicts": verdict_ids,
                 "staging_done_s": phases.get("staging"),
                 "writing_done_s": phases.get("writing"),
             }

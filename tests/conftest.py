@@ -21,6 +21,16 @@ import os
 # knobs.override_watchdog_deadline_seconds().
 os.environ.setdefault("TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS", "0")
 
+# Live-progress heartbeat files and the per-manager step history are
+# likewise off by default (0 disables both): tier-1 snapshot/manager
+# dirs must hold exactly the files the code under test wrote. Tests
+# that exercise them opt back in via
+# knobs.override_progress_interval_seconds() /
+# knobs.override_history_max_records(). The in-memory
+# telemetry.current_progress() view stays on regardless.
+os.environ.setdefault("TORCHSNAPSHOT_TPU_PROGRESS_SECONDS", "0")
+os.environ.setdefault("TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS", "0")
+
 if os.environ.get("TS_TEST_ON_TPU") != "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,506 @@
+"""Checkpoint doctor: rule registry, evidence-cited verdicts over real
+snapshot artifacts, bench-trial epistemics, per-manager step history,
+and trend regression detection.
+
+Acceptance pins (ISSUE 5): ``python -m torchsnapshot_tpu.telemetry
+doctor <snapshot>`` on a synthetic slow-storage take (fake plugin with
+injected latency) emits at least one correct, evidence-cited verdict;
+``doctor --trend`` over >= 3 manager steps with one injected regression
+flags exactly that step.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.telemetry import doctor, history, names
+from torchsnapshot_tpu.telemetry.stats import main as stats_main
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset_metrics()
+    yield
+    telemetry.reset_metrics()
+
+
+def _state(n=4, size=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": rng.standard_normal(size).astype(np.float32)
+        for i in range(n)
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+
+def test_every_registered_rule_id_is_declared_in_names():
+    declared = {
+        v
+        for k, v in vars(names).items()
+        if k.startswith("RULE_") and isinstance(v, str)
+    }
+    registered = set(doctor.registered_rule_ids())
+    assert registered <= declared
+    # The headline rules from the issue all exist.
+    for rule_id in (
+        names.RULE_D2H_BOUND,
+        names.RULE_BUDGET_STARVED,
+        names.RULE_STRAGGLER_RANK,
+        names.RULE_STORAGE_TIER_SLOW,
+        names.RULE_MIRROR_LAGGING,
+        names.RULE_WRITE_TAIL_STALL,
+        names.RULE_INTERRUPTED_TAKE,
+    ):
+        assert rule_id in registered
+
+
+# ---------------------------------------------------------------------------
+# Report-scope rules over synthetic reports (threshold unit tests)
+# ---------------------------------------------------------------------------
+
+
+def _report(**over):
+    base = {
+        "kind": "take",
+        "rank": 0,
+        "phases": {"staging": 1.0, "writing": 2.0},
+        "bytes_moved": 100 * 1024**2,
+        "budget_wait_s": 0.0,
+        "retries": {},
+        "mirror": {},
+    }
+    base.update(over)
+    return base
+
+
+def _rules_for(reports):
+    return {v.rule for v in doctor.diagnose_reports(reports)}
+
+
+def test_storage_tier_slow_vs_d2h_bound():
+    # Write drain (wall - staging) dominates -> storage-tier-slow.
+    slow_storage = _report(phases={"staging": 0.2, "writing": 3.0})
+    assert _rules_for([slow_storage]) == {names.RULE_STORAGE_TIER_SLOW}
+    # Staging dominates -> d2h-bound, not storage.
+    d2h = _report(phases={"staging": 2.8, "writing": 3.0})
+    assert _rules_for([d2h]) == {names.RULE_D2H_BOUND}
+    # Balanced take below both thresholds -> silence.
+    ok = _report(phases={"staging": 1.5, "writing": 3.0})
+    assert _rules_for([ok]) == set()
+
+
+def test_budget_starved_cites_wait_fraction():
+    starved = _report(budget_wait_s=1.5, phases={"staging": 1.5, "writing": 3.0})
+    verdicts = doctor.diagnose_reports([starved])
+    budget = [v for v in verdicts if v.rule == names.RULE_BUDGET_STARVED]
+    assert len(budget) == 1
+    assert budget[0].evidence["wait_frac"] == 0.5
+    assert budget[0].evidence["budget_wait_s"] == 1.5
+
+
+def test_straggler_rank_names_the_rank():
+    report = _report(
+        aggregated={
+            "phase_writing_s": {
+                "min": 1.0,
+                "median": 1.1,
+                "max": 9.0,
+                "straggler": 3,
+            },
+            "bytes_moved": {
+                "min": 1.0,
+                "median": 1.0,
+                "max": 1.0,
+                "straggler": 0,
+            },
+        },
+        phases={"staging": 1.5, "writing": 3.0},
+    )
+    verdicts = [
+        v
+        for v in doctor.diagnose_reports([report])
+        if v.rule == names.RULE_STRAGGLER_RANK
+    ]
+    assert len(verdicts) == 1
+    assert verdicts[0].evidence["straggler_rank"] == 3
+    assert verdicts[0].evidence["metric"] == "phase_writing_s"
+
+
+def test_mirror_lagging_and_retry_storm_thresholds():
+    lagging = _report(
+        mirror={"upload_lag_s": 120.0, "snapshots_pending": 1},
+        phases={"staging": 1.5, "writing": 3.0},
+    )
+    assert names.RULE_MIRROR_LAGGING in _rules_for([lagging])
+    storm = _report(
+        retries={"attempts": 5.0, "backoff_s": 2.0, "exhausted": 0.0},
+        phases={"staging": 1.5, "writing": 3.0},
+    )
+    assert names.RULE_RETRY_STORM in _rules_for([storm])
+    quiet = _report(
+        mirror={"upload_lag_s": 0.5, "snapshots_pending": 1},
+        retries={"attempts": 1.0},
+        phases={"staging": 1.5, "writing": 3.0},
+    )
+    assert _rules_for([quiet]) == set()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: synthetic slow-storage take -> evidence-cited verdict
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_cli_on_synthetic_slow_storage_take(
+    tmp_path, monkeypatch, capsys
+):
+    """Inject storage latency, take with the JSONL sink on, and ask the
+    CLI: the storage-tier-slow verdict must appear with the phase
+    evidence that triggered it."""
+    orig = FSStoragePlugin.write
+
+    async def slow_write(self, write_io):
+        await asyncio.sleep(0.3)
+        await orig(self, write_io)
+
+    async def decline_fused(self, write_io):
+        return None  # fused fast path declines -> slow plain writes
+
+    monkeypatch.setattr(FSStoragePlugin, "write", slow_write)
+    monkeypatch.setattr(
+        FSStoragePlugin, "write_with_checksum", decline_fused
+    )
+    snap = str(tmp_path / "snap")
+    with knobs.enable_telemetry():
+        ts.Snapshot.take(snap, {"s": ts.PyTreeState(_state(n=3, size=256))})
+
+    rc = stats_main(["doctor", snap])
+    out = capsys.readouterr().out
+    assert rc == 2  # findings present
+    assert names.RULE_STORAGE_TIER_SLOW in out
+    assert "write_drain_s=" in out  # evidence cited
+
+    # Library API agrees and carries machine-readable evidence.
+    verdicts = doctor.diagnose_snapshot(snap)
+    slow = [v for v in verdicts if v.rule == names.RULE_STORAGE_TIER_SLOW]
+    assert slow
+    ev = slow[0].evidence
+    assert ev["write_drain_s"] > ev["staging_s"]
+    assert ev["wall_s"] >= ev["write_drain_s"]
+
+
+def test_doctor_flags_interrupted_take_from_leftover_heartbeat(tmp_path):
+    """A non-terminal progress leftover (crashed op) becomes
+    interrupted-take evidence instead of a silently ignored dotfile."""
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    (snap / ".progress-rank0.json").write_text(
+        json.dumps(
+            {
+                "kind": "take",
+                "rank": 0,
+                "phase": "writing",
+                "written_bytes": 1024,
+                "planned_bytes": 4096,
+                "items_done": 1,
+                "planned_items": 4,
+                "terminal": None,
+            }
+        )
+    )
+    verdicts = doctor.diagnose_snapshot(str(snap))
+    interrupted = [
+        v for v in verdicts if v.rule == names.RULE_INTERRUPTED_TAKE
+    ]
+    assert len(interrupted) == 1
+    assert interrupted[0].severity == "critical"
+    assert interrupted[0].evidence["written_bytes"] == 1024
+    assert interrupted[0].evidence["planned_bytes"] == 4096
+    # Ranked most-severe first.
+    assert verdicts[0].rule == names.RULE_INTERRUPTED_TAKE
+
+
+def test_doctor_spares_fresh_heartbeat_of_live_op(tmp_path):
+    """A fresh non-terminal heartbeat is a healthy RUNNING op — the
+    doctor must not raise a false critical when diagnosing a snapshot
+    mid-take; only a stale heartbeat (10x the writer's own interval,
+    >= 30 s) is crash evidence."""
+    import time as _time
+
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    doc = {
+        "kind": "take",
+        "rank": 0,
+        "phase": "writing",
+        "written_bytes": 1024,
+        "planned_bytes": 4096,
+        "items_done": 1,
+        "planned_items": 4,
+        "terminal": None,
+        "interval_s": 1.0,
+        "updated_unix_ts": _time.time(),
+    }
+    (snap / ".progress-rank0.json").write_text(json.dumps(doc))
+    assert [
+        v
+        for v in doctor.diagnose_snapshot(str(snap))
+        if v.rule == names.RULE_INTERRUPTED_TAKE
+    ] == []
+    # The same document gone stale IS the crash evidence.
+    doc["updated_unix_ts"] = _time.time() - 3600
+    (snap / ".progress-rank0.json").write_text(json.dumps(doc))
+    assert [
+        v
+        for v in doctor.diagnose_snapshot(str(snap))
+        if v.rule == names.RULE_INTERRUPTED_TAKE
+    ]
+
+
+def test_fsck_stats_lists_progress_leftovers(tmp_path, capsys):
+    """fsck --stats surfaces heartbeat leftovers and doctor verdicts."""
+    from torchsnapshot_tpu.fsck import main as fsck_main
+
+    snap = str(tmp_path / "snap")
+    ts.Snapshot.take(snap, {"s": ts.PyTreeState(_state(n=2, size=128))})
+    with open(
+        os.path.join(snap, ".progress-rank0.json"), "w", encoding="utf-8"
+    ) as f:
+        json.dump(
+            {
+                "kind": "take",
+                "rank": 0,
+                "phase": "writing",
+                "written_bytes": 10,
+                "planned_bytes": 100,
+                "items_done": 0,
+                "planned_items": 2,
+                "terminal": None,
+            },
+            f,
+        )
+    rc = fsck_main([snap, "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0  # the snapshot itself is sound
+    assert "progress heartbeats" in out
+    assert "NOT TERMINAL" in out
+    assert names.RULE_INTERRUPTED_TAKE in out
+
+
+# ---------------------------------------------------------------------------
+# Bench-trial epistemics (shared with bench.py)
+# ---------------------------------------------------------------------------
+
+
+def test_diagnose_take_trial_matches_bench_semantics():
+    # Stable bracket, achieved well below half -> in-take stall.
+    verdicts = doctor.diagnose_take_trial(
+        take_s=10.0,
+        gib=1.0,
+        probe_before_gbps=1.0,
+        probe_after_gbps=1.1,
+        phases={"staging": 9.5, "writing": 10.0},
+    )
+    assert [v.rule for v in verdicts] == [names.RULE_IN_TAKE_STALL]
+    ev = verdicts[0].evidence
+    assert ev["ratio"] < doctor.STALL_EFFICIENCY_RATIO
+    assert ev["staging_done_s"] == 9.5
+    # Unstable bracket -> link-unstable, and NO stall verdict (the
+    # bench's old behavior: an unstable bracket never flags a stall).
+    verdicts = doctor.diagnose_take_trial(1.0, 1.0, 0.4, 1.0)
+    assert [v.rule for v in verdicts] == [names.RULE_LINK_UNSTABLE]
+    # Healthy trial -> silence.
+    assert doctor.diagnose_take_trial(1.0, 1.0, 1.0, 1.05) == []
+
+
+def test_probes_unstable_series():
+    assert not doctor.probes_unstable([1.0, 1.2, 1.1])
+    assert doctor.probes_unstable([1.0, 2.0, 1.9])
+    assert not doctor.probes_unstable([])
+
+
+def test_bench_diagnostics_embed_doctor_verdicts():
+    """bench.py's take_diagnostics keep their JSON keys and gain the
+    doctor's verdict ids (satellite: shared stall definition)."""
+    import bench
+
+    brackets, ratios, eff, unstable = bench._bracketed_efficiency(
+        [10.0], [1.0, 1.1], 1.0
+    )
+    assert unstable is False
+    trial = doctor.diagnose_take_trial(10.0, 1.0, 1.0, 1.1)
+    assert names.RULE_IN_TAKE_STALL in [v.rule for v in trial]
+    assert ratios[0] == pytest.approx(
+        trial[0].evidence["ratio"], rel=1e-2
+    )
+
+
+# ---------------------------------------------------------------------------
+# History + trend
+# ---------------------------------------------------------------------------
+
+
+def _summary(step, take_s, mb_s=100.0, wait=0.01):
+    return {
+        "step": step,
+        "kind": "take",
+        "path": f"/snaps/step_{step:010d}",
+        "unix_ts": 0.0,
+        "take_s": take_s,
+        "phases": {"staging": take_s * 0.4, "writing": take_s},
+        "bytes_moved": 1024,
+        "blobs": 4,
+        "mb_s": mb_s,
+        "budget_wait_s": wait,
+    }
+
+
+def test_trend_flags_exactly_the_injected_regression(tmp_path):
+    """>= 3 steps, one injected 3x take-time regression: the doctor
+    flags that step and no other."""
+    records = [
+        _summary(0, 1.0),
+        _summary(1, 1.05),
+        _summary(2, 0.95),
+        _summary(3, 3.2),  # injected regression
+        _summary(4, 1.0),
+    ]
+    verdicts = doctor.diagnose_trend(records)
+    flagged_steps = {v.evidence["step"] for v in verdicts}
+    assert flagged_steps == {3}
+    assert all(v.rule == names.RULE_TREND_REGRESSION for v in verdicts)
+    take_rows = [
+        v for v in verdicts if v.evidence["metric"] == "take_s"
+    ]
+    assert take_rows and take_rows[0].evidence["value"] == 3.2
+
+
+def test_trend_quiet_on_flat_history():
+    records = [_summary(i, 1.0 + 0.01 * (i % 3)) for i in range(10)]
+    assert doctor.diagnose_trend(records) == []
+
+
+def test_manager_saves_append_bounded_history(tmp_path):
+    """Each committed step appends one summary; the file is bounded by
+    the knob; doctor --trend reads it through the CLI."""
+    root = str(tmp_path / "ckpts")
+    state = {"s": ts.PyTreeState(_state(n=2, size=256))}
+    with knobs.override_history_max_records(3), knobs.enable_telemetry():
+        mgr = ts.CheckpointManager(root)
+        for step in range(5):
+            mgr.save(step, state)
+    path = history.history_path_for(root)
+    records = history.load_history(path)
+    # Bounded to the newest 3 of the 5 saves.
+    assert [r["step"] for r in records] == [2, 3, 4]
+    assert all(r["kind"] == "take" for r in records)
+    assert all(r["take_s"] >= 0 for r in records)
+
+
+def test_async_save_records_history_too(tmp_path):
+    root = str(tmp_path / "ckpts")
+    state = {"s": ts.PyTreeState(_state(n=2, size=256))}
+    with knobs.override_history_max_records(10), knobs.enable_telemetry():
+        mgr = ts.CheckpointManager(root)
+        mgr.async_save(0, state).wait()
+    records = history.load_history(history.history_path_for(root))
+    assert [r["step"] for r in records] == [0]
+    assert records[0]["kind"] == "async_take"
+
+
+def test_history_disabled_by_default_in_suite(tmp_path):
+    """conftest zeroes the bound: no history file appears unless a test
+    opts in (tier-1 determinism)."""
+    root = str(tmp_path / "ckpts")
+    mgr = ts.CheckpointManager(root)
+    mgr.save(0, {"s": ts.PyTreeState(_state(n=1, size=64))})
+    assert not os.path.exists(os.path.join(root, ".telemetry-history.jsonl"))
+
+
+def test_doctor_trend_cli_over_manager_history(tmp_path, capsys):
+    """snapshot_stats `trend <root>` and `doctor --trend <root>` find
+    the history file and flag the injected regression."""
+    root = tmp_path / "ckpts"
+    root.mkdir()
+    records = [_summary(i, 1.0) for i in range(4)] + [_summary(4, 5.0)]
+    with open(root / ".telemetry-history.jsonl", "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    rc = stats_main(["doctor", "--trend", str(root)])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "step 4" in out
+    assert names.RULE_TREND_REGRESSION in out
+    # The `trend` shorthand routes to the same diagnosis.
+    rc = stats_main(["trend", str(root), "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert {r["evidence"]["step"] for r in rows} == {4}
+
+
+def test_trend_end_to_end_over_real_manager_steps(
+    tmp_path, monkeypatch, capsys
+):
+    """The full acceptance path: >= 3 real manager saves feed the
+    rolling history, one step suffers injected storage latency, and
+    ``doctor --trend`` flags exactly that step."""
+    orig = FSStoragePlugin.write
+    slow_steps = {2}
+    current = {"step": None}
+
+    async def maybe_slow(self, write_io):
+        if current["step"] in slow_steps:
+            await asyncio.sleep(0.25)
+        await orig(self, write_io)
+
+    async def decline_fused(self, write_io):
+        return None
+
+    monkeypatch.setattr(FSStoragePlugin, "write", maybe_slow)
+    monkeypatch.setattr(
+        FSStoragePlugin, "write_with_checksum", decline_fused
+    )
+    root = str(tmp_path / "ckpts")
+    state = {"s": ts.PyTreeState(_state(n=2, size=256))}
+    with knobs.override_history_max_records(10), knobs.enable_telemetry():
+        mgr = ts.CheckpointManager(root)
+        for step in range(4):
+            current["step"] = step
+            mgr.save(step, state)
+    rc = stats_main(["doctor", "--trend", root])
+    out = capsys.readouterr().out
+    assert rc == 2, out
+    verdicts = doctor.diagnose_trend(
+        history.load_history(history.history_path_for(root))
+    )
+    assert {v.evidence["step"] for v in verdicts} == slow_steps
+
+
+def test_doctor_trend_cli_without_history(tmp_path, capsys):
+    rc = stats_main(["doctor", "--trend", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "no step history" in out
+
+
+def test_history_append_is_bounded_and_atomic(tmp_path):
+    with knobs.override_history_max_records(2):
+        for i in range(4):
+            history.append_summary(str(tmp_path), _summary(i, 1.0))
+    records = history.load_history(history.history_path_for(str(tmp_path)))
+    assert [r["step"] for r in records] == [2, 3]
+    # Corrupt line resilience.
+    with open(
+        history.history_path_for(str(tmp_path)), "a", encoding="utf-8"
+    ) as f:
+        f.write("{torn\n")
+    assert len(history.load_history(history.history_path_for(str(tmp_path)))) == 2

@@ -85,3 +85,37 @@ def test_wait_durable_timeout_knob() -> None:
     with knobs.override_wait_durable_timeout_seconds(0.25):
         assert knobs.get_wait_durable_timeout_seconds() == 0.25
     assert knobs.get_wait_durable_timeout_seconds() == 1800.0
+
+
+def test_progress_knobs() -> None:
+    """Heartbeat interval (conftest zeroes it for the suite; the
+    out-of-suite default is 1 s), progress dir, and the <= 0 disable
+    contract progress.progress_path_for keys off."""
+    assert knobs.get_progress_interval_seconds() == 0.0  # conftest
+    with knobs.override_progress_interval_seconds(0.5):
+        assert knobs.get_progress_interval_seconds() == 0.5
+    assert knobs.get_progress_interval_seconds() == 0.0
+    # The packaged default (no env var at all) is 1 s.
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_PROGRESS_SECONDS", None)
+    try:
+        assert knobs.get_progress_interval_seconds() == 1.0
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_PROGRESS_SECONDS"] = prev
+    assert knobs.get_progress_dir() is None
+    with knobs.override_progress_dir("/tmp/progress-out"):
+        assert knobs.get_progress_dir() == "/tmp/progress-out"
+    assert knobs.get_progress_dir() is None
+
+
+def test_history_max_records_knob() -> None:
+    assert knobs.get_history_max_records() == 0  # conftest zeroes it
+    with knobs.override_history_max_records(7):
+        assert knobs.get_history_max_records() == 7
+    assert knobs.get_history_max_records() == 0
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS", None)
+    try:
+        assert knobs.get_history_max_records() == 512
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS"] = prev

@@ -1,0 +1,338 @@
+"""Live-progress heartbeats: file atomicity under a concurrent reader,
+lifecycle (removed on success, terminal on failure, leftover on crash),
+and the always-on in-memory ``current_progress`` view mid-take.
+
+Acceptance pin (ISSUE 5): during a take, a concurrent reader of the
+per-rank progress file observes monotonically non-decreasing
+bytes-written and a valid JSON document on every read; the file is
+removed when the op completes; ``current_progress()`` is correct
+mid-take via a slow fake plugin.
+"""
+
+import asyncio
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.telemetry import progress
+
+
+def _state(n=8, size=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": rng.standard_normal(size).astype(np.float32)
+        for i in range(n)
+    }
+
+
+def _slow_writes(monkeypatch, delay_s=0.03):
+    """Inject per-blob write latency into the fs plugin so a take is
+    slow enough for pollers to observe it mid-flight. The fused
+    write+checksum fast path declines so every write takes the patched
+    plain path."""
+    orig = FSStoragePlugin.write
+
+    async def slow_write(self, write_io):
+        await asyncio.sleep(delay_s)
+        await orig(self, write_io)
+
+    async def decline_fused(self, write_io):
+        return None
+
+    monkeypatch.setattr(FSStoragePlugin, "write", slow_write)
+    monkeypatch.setattr(
+        FSStoragePlugin, "write_with_checksum", decline_fused
+    )
+
+
+def test_progress_path_resolution(tmp_path):
+    """Interval <= 0 disables the file heartbeat; the dir knob takes
+    precedence over the snapshot-adjacent file; object-store paths get
+    no file without the dir knob; dir-mode names are disambiguated by
+    snapshot-path digest and kind so concurrent ops on one rank never
+    clobber (or finish()-delete) each other's heartbeats."""
+    assert progress.progress_path_for(str(tmp_path), 0) is None  # conftest 0
+    with knobs.override_progress_interval_seconds(0.5):
+        assert progress.progress_path_for(str(tmp_path), 1) == str(
+            tmp_path / ".progress-rank1.json"
+        )
+        assert progress.progress_path_for("s3://bucket/snap", 0) is None
+        with knobs.override_progress_dir(str(tmp_path / "out")):
+            assert progress.progress_path_for("s3://bucket/snap", 2) == str(
+                tmp_path / "out" / "progress-rank2.json"
+            )
+            a = progress.progress_path_for(
+                "s3://bucket/step_1", 0, kind="take"
+            )
+            b = progress.progress_path_for(
+                "s3://bucket/step_2", 0, kind="take"
+            )
+            c = progress.progress_path_for(
+                "s3://bucket/step_1", 0, kind="async_take"
+            )
+            assert len({a, b, c}) == 3
+
+
+def test_dir_mode_findings_filter_by_snapshot_path(tmp_path):
+    """A shared progress dir serves several snapshots; discovery for
+    snapshot A must not return snapshot B's heartbeats (filtered by the
+    path digest embedded in every dir-mode filename — one glob, no
+    per-file parse)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    dig_a = progress._path_digest("s3://bucket/a")
+    dig_b = progress._path_digest("s3://bucket/b")
+    (out / f"progress-{dig_a}-take-rank0.json").write_text(
+        json.dumps({"kind": "take", "path": "s3://bucket/a", "terminal": None})
+    )
+    (out / f"progress-{dig_b}-take-rank0.json").write_text(
+        json.dumps({"kind": "take", "path": "s3://bucket/b", "terminal": None})
+    )
+    with knobs.override_progress_dir(str(out)):
+        found = progress.find_progress_files("s3://bucket/a")
+    assert [os.path.basename(f) for f in found] == [
+        f"progress-{dig_a}-take-rank0.json"
+    ]
+
+
+def test_concurrent_reader_sees_valid_monotonic_heartbeats(
+    tmp_path, monkeypatch
+):
+    """The acceptance pin: every concurrent read parses, written_bytes
+    never decreases, and the file is gone once the take completes."""
+    _slow_writes(monkeypatch)
+    snap = str(tmp_path / "snap")
+    heartbeat = os.path.join(snap, ".progress-rank0.json")
+    docs = []
+    raw_failures = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                with open(heartbeat, "r", encoding="utf-8") as f:
+                    raw = f.read()
+            except OSError:
+                time.sleep(0.001)
+                continue
+            try:
+                docs.append(json.loads(raw))
+            except ValueError:
+                raw_failures.append(raw)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        with knobs.override_progress_interval_seconds(0.001):
+            ts.Snapshot.take(snap, {"s": ts.PyTreeState(_state())})
+    finally:
+        stop.set()
+        t.join()
+    assert not raw_failures, f"torn reads: {raw_failures[:3]}"
+    assert docs, "reader never saw a heartbeat"
+    written = [d["written_bytes"] for d in docs]
+    assert written == sorted(written), "written_bytes regressed"
+    assert all(d["kind"] == "take" for d in docs)
+    assert all(d["schema_version"] == progress.PROGRESS_SCHEMA_VERSION
+               for d in docs)
+    # Lifecycle: a completed op removes its heartbeat.
+    assert not os.path.exists(heartbeat)
+    planned = docs[-1]["planned_bytes"]
+    assert planned == sum(a.nbytes for a in _state().values())
+    assert written[-1] <= planned
+
+
+def test_current_progress_mid_take(tmp_path, monkeypatch):
+    """The always-on in-memory view (no file knobs at all): a poller
+    thread sees the live take with sane, growing counters."""
+    _slow_writes(monkeypatch)
+    snap = str(tmp_path / "snap")
+    rows = []
+    stop = threading.Event()
+
+    def poller():
+        while not stop.is_set():
+            rows.extend(telemetry.current_progress())
+            time.sleep(0.002)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    try:
+        ts.Snapshot.take(snap, {"s": ts.PyTreeState(_state())})
+    finally:
+        stop.set()
+        t.join()
+    takes = [r for r in rows if r["kind"] == "take"]
+    assert takes, "current_progress never showed the live take"
+    assert takes[0]["path"] == snap
+    assert takes[0]["rank"] == 0
+    written = [r["written_bytes"] for r in takes]
+    assert written == sorted(written)
+    planned = sum(a.nbytes for a in _state().values())
+    assert any(r["planned_bytes"] == planned for r in takes)
+    assert any(r["phase"] in ("staging", "writing") for r in takes)
+    # No file heartbeat was requested (conftest interval 0): nothing on
+    # disk, and the op unregistered at completion.
+    assert not glob.glob(os.path.join(snap, ".progress*"))
+    assert telemetry.current_progress() == []
+
+
+def test_heartbeat_refreshes_while_write_is_blocked(tmp_path, monkeypatch):
+    """A blocked op produces no pipeline events, but the heartbeat must
+    keep refreshing (background refresher): updated_unix_ts advances
+    with written_bytes frozen — 'alive but stuck', not 'crashed'. This
+    is what keeps the doctor's staleness-based interrupted-take check
+    honest for single-blob multi-minute writes."""
+    _slow_writes(monkeypatch, delay_s=0.6)
+    snap = str(tmp_path / "snap")
+    heartbeat = os.path.join(snap, ".progress-rank0.json")
+    stamps = set()
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            doc = progress.load_progress_file(heartbeat)
+            if doc is not None and doc["written_bytes"] == 0:
+                stamps.add(doc["updated_unix_ts"])
+            time.sleep(0.01)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        with knobs.override_progress_interval_seconds(0.05):
+            ts.Snapshot.take(
+                snap, {"s": ts.PyTreeState(_state(n=1, size=256))}
+            )
+    finally:
+        stop.set()
+        t.join()
+    # The single write blocks ~0.6s with zero pipeline events; without
+    # the refresher at most two stamps exist (registration + staging).
+    assert len(stamps) >= 4, stamps
+
+
+def test_failed_take_leaves_terminal_heartbeat(tmp_path, monkeypatch):
+    """A take whose writes fail must leave a TERMINAL heartbeat with
+    the error — distinguishing a clean failure from a crash's
+    non-terminal leftover — and unregister from current_progress."""
+
+    async def broken_write(self, write_io):
+        raise OSError("injected disk failure")
+
+    monkeypatch.setattr(FSStoragePlugin, "write", broken_write)
+    monkeypatch.setattr(FSStoragePlugin, "write_with_checksum", broken_write)
+    snap = str(tmp_path / "snap")
+    with knobs.override_progress_interval_seconds(0.001):
+        with pytest.raises(OSError):
+            ts.Snapshot.take(snap, {"s": ts.PyTreeState(_state(n=2))})
+    heartbeat = os.path.join(snap, ".progress-rank0.json")
+    assert os.path.exists(heartbeat)
+    doc = progress.load_progress_file(heartbeat)
+    assert doc["terminal"] == "failed"
+    assert "injected disk failure" in doc["error"]
+    assert telemetry.current_progress() == []
+
+
+def test_restore_progress_accumulates_across_pipelines(tmp_path):
+    """A restore runs one read pipeline per stateful; the published
+    totals must fold them (begin_pipeline offsets), ending at the full
+    byte count."""
+    snap = str(tmp_path / "snap")
+    state_a, state_b = _state(n=2, seed=1), _state(n=3, seed=2)
+    ts.Snapshot.take(
+        snap, {"a": ts.PyTreeState(state_a), "b": ts.PyTreeState(state_b)}
+    )
+    tracker_rows = []
+    orig_finish = progress.ProgressTracker.finish
+
+    def spy_finish(self, error=None):
+        tracker_rows.append(self.snapshot())
+        orig_finish(self, error)
+
+    try:
+        progress.ProgressTracker.finish = spy_finish
+        dest = {
+            "a": ts.PyTreeState(
+                {k: np.zeros_like(v) for k, v in state_a.items()}
+            ),
+            "b": ts.PyTreeState(
+                {k: np.zeros_like(v) for k, v in state_b.items()}
+            ),
+        }
+        ts.Snapshot(snap).restore(dest)
+    finally:
+        progress.ProgressTracker.finish = orig_finish
+    restores = [r for r in tracker_rows if r["kind"] == "restore"]
+    assert len(restores) == 1
+    total = sum(a.nbytes for a in state_a.values()) + sum(
+        a.nbytes for a in state_b.values()
+    )
+    assert restores[0]["planned_bytes"] == total
+    assert restores[0]["written_bytes"] == total
+    assert restores[0]["items_done"] == len(state_a) + len(state_b)
+
+
+def test_async_take_heartbeat_settles_on_background_thread(
+    tmp_path, monkeypatch
+):
+    """async_take's heartbeat stays live through the background drain
+    and is removed when the commit thread settles."""
+    _slow_writes(monkeypatch, delay_s=0.02)
+    snap = str(tmp_path / "snap")
+    with knobs.override_progress_interval_seconds(0.001):
+        pending = ts.Snapshot.async_take(
+            snap, {"s": ts.PyTreeState(_state(n=4))}
+        )
+        live = [
+            r
+            for r in telemetry.current_progress()
+            if r["kind"] == "async_take"
+        ]
+        assert live and live[0]["path"] == snap
+        pending.wait()
+    assert not os.path.exists(os.path.join(snap, ".progress-rank0.json"))
+    assert telemetry.current_progress() == []
+
+
+def test_manager_gc_reaps_dir_mode_heartbeats(tmp_path):
+    """Shared-dir heartbeats have no other reaper: dropping a step must
+    remove its dir-mode leftovers (and only its own)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    snap_a = "s3://bucket/step_1"
+    dig_a = progress._path_digest(snap_a)
+    dig_b = progress._path_digest("s3://bucket/step_2")
+    (out / f"progress-{dig_a}-take-rank0.json").write_text("{}")
+    (out / f"progress-{dig_b}-take-rank0.json").write_text("{}")
+    with knobs.override_progress_dir(str(out)):
+        progress.remove_dir_heartbeats(snap_a)
+    assert [p.name for p in sorted(out.iterdir())] == [
+        f"progress-{dig_b}-take-rank0.json"
+    ]
+
+
+def test_find_and_load_progress_files(tmp_path):
+    """fsck/doctor discovery: snapshot-adjacent leftovers are found and
+    unreadable files load as None instead of raising."""
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    good = snap / ".progress-rank0.json"
+    good.write_text(json.dumps({"kind": "take", "terminal": None}))
+    bad = snap / ".progress-rank1.json"
+    bad.write_text("{torn")
+    files = progress.find_progress_files(str(snap))
+    assert [os.path.basename(f) for f in files] == [
+        ".progress-rank0.json",
+        ".progress-rank1.json",
+    ]
+    assert progress.load_progress_file(str(good))["kind"] == "take"
+    assert progress.load_progress_file(str(bad)) is None

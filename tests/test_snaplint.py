@@ -355,6 +355,83 @@ def test_executor_thread_leak_detects_and_accepts_fix(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# doctor-rule-ids
+# ---------------------------------------------------------------------------
+
+_DOCTOR_NAMES_BAD = """
+RULE_FOO = "Not_Kebab"
+RULE_FOO_AGAIN = "Not_Kebab"
+"""
+
+_DOCTOR_NAMES_FIXED = """
+RULE_FOO = "foo-too-slow"
+"""
+
+_DOCTOR_EMIT_BAD = """
+from torchsnapshot_tpu.telemetry.doctor import Verdict, doctor_rule
+
+@doctor_rule("literal-id")
+def _check(report):
+    return None
+
+def emit():
+    return Verdict(rule="another-literal", summary="x")
+"""
+
+_DOCTOR_EMIT_FIXED = """
+from torchsnapshot_tpu.telemetry import names
+from torchsnapshot_tpu.telemetry.doctor import Verdict, doctor_rule
+
+@doctor_rule(names.RULE_FOO)
+def _check(report):
+    return None
+
+def emit():
+    return Verdict(rule=names.RULE_FOO, summary="x")
+"""
+
+
+def _doctor_layout(tmp_path, names_src, emit_src):
+    """The doctor-rule-ids rule is project-level: it needs the package
+    layout (telemetry/names.py) to exist under the analyzer root."""
+    pkg = tmp_path / "torchsnapshot_tpu" / "telemetry"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "names.py").write_text(names_src)
+    emitter = pkg / "emitter.py"
+    emitter.write_text(emit_src)
+    return emitter
+
+
+def test_doctor_rule_ids_detects_and_accepts_fix(tmp_path):
+    emitter = _doctor_layout(tmp_path, _DOCTOR_NAMES_BAD, _DOCTOR_EMIT_BAD)
+    analyzer = Analyzer(root=tmp_path, select=["doctor-rule-ids"])
+    bad = analyzer.run([emitter], baseline=None)
+    msgs = _messages(bad)
+    assert any("not\nkebab-case".replace("\n", " ") in m for m in msgs)
+    assert any("registered twice" in m for m in msgs)
+    assert any("'literal-id'" in m and "doctor_rule" in m for m in msgs)
+    assert any("'another-literal'" in m and "Verdict" in m for m in msgs)
+
+    emitter = _doctor_layout(
+        tmp_path, _DOCTOR_NAMES_FIXED, _DOCTOR_EMIT_FIXED
+    )
+    analyzer = Analyzer(root=tmp_path, select=["doctor-rule-ids"])
+    fixed = analyzer.run([emitter], baseline=None)
+    assert fixed.new_findings == []
+
+
+def test_doctor_rule_ids_requires_declarations(tmp_path):
+    """An empty RULE_ registry is itself a finding (the catalogue must
+    exist), mirroring the metric/span declaration checks."""
+    emitter = _doctor_layout(tmp_path, "X = 1\n", "def noop():\n    pass\n")
+    analyzer = Analyzer(root=tmp_path, select=["doctor-rule-ids"])
+    result = analyzer.run([emitter], baseline=None)
+    assert any(
+        "no doctor rule ids declared" in m for m in _messages(result)
+    )
+
+
 def test_inline_suppression_silences_one_rule(tmp_path):
     source = """
 import time
@@ -527,6 +604,7 @@ def test_cli_json_output_and_rule_listing():
         "executor-thread-leak",
         "metric-name-literal",
         "span-name-literal",
+        "doctor-rule-ids",
         "tiered-test-markers",
     ):
         assert rule in listing.stdout

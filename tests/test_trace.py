@@ -467,6 +467,11 @@ def test_watchdog_fires_exactly_once_on_injected_slow_plugin(
     assert args["age_s"] >= 0.15
     assert args["open_spans"]  # the open-span tree snapshot
     assert any(names.SPAN_TAKE in s for s in args["open_spans"])
+    # The stall instant carries the live-progress snapshot: how far the
+    # wedged op got (bytes/items), not just which spans are open.
+    assert any(
+        "take rank0" in row and "items" in row for row in args["progress"]
+    )
     # The log carried the tree and the faulthandler-style stacks.
     log_text = caplog.text
     assert "open-span tree" in log_text

@@ -32,9 +32,15 @@ from ..core import Finding, ModuleInfo, Project, Rule, register
 
 _SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
 _COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
+_KEBAB_CASE = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)*$")
 _SPAN_PREFIXES = ("SPAN_", "INSTANT_")
+_RULE_PREFIX = "RULE_"
 _REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
 _TRACE_CALLABLES = {"trace_annotation", "span", "instant", "begin"}
+# Doctor emit surfaces: the rule-registration decorator and the verdict
+# constructor (telemetry/doctor.py). A literal id at either means the
+# verdict namespace can drift from the names.py registry.
+_DOCTOR_CALLABLES = {"doctor_rule", "Verdict"}
 
 NAMES_RELPATH = "torchsnapshot_tpu/telemetry/names.py"
 TRACE_EXEMPT_RELPATH = "torchsnapshot_tpu/telemetry/trace.py"
@@ -48,13 +54,17 @@ _LOC_RE = re.compile(r"^(?P<path>[^:]+?\.py):(?P<line>\d+): ")
 
 
 def check_metric_names_file(
-    path: Path, include_span_decls: bool = True
+    path: Path,
+    include_span_decls: bool = True,
+    include_rule_decls: bool = True,
 ) -> List[str]:
     """Errors in the declaration file: malformed values (snake_case for
-    metrics, colon-case for SPAN_/INSTANT_ trace names), duplicate
-    constants, duplicate values. ``include_span_decls=False`` leaves
-    the SPAN_/INSTANT_ value-shape checks to the span rule (the unified
-    registry runs both rules; each defect should report once)."""
+    metrics, colon-case for SPAN_/INSTANT_ trace names, kebab-case for
+    RULE_ doctor-verdict ids), duplicate constants, duplicate values.
+    ``include_span_decls=False`` / ``include_rule_decls=False`` leave
+    the SPAN_/INSTANT_ and RULE_ checks to the span / doctor rules (the
+    unified registry runs all three; each defect should report once —
+    with the flag off, those constants are skipped here entirely)."""
     errors = []
     if not path.exists():
         return [f"{path.name}: missing (metric names must be declared here)"]
@@ -67,6 +77,12 @@ def check_metric_names_file(
         for target in node.targets:
             if not isinstance(target, ast.Name):
                 continue
+            if not include_rule_decls and target.id.startswith(_RULE_PREFIX):
+                continue
+            if not include_span_decls and target.id.startswith(
+                _SPAN_PREFIXES
+            ):
+                continue
             if not isinstance(node.value, ast.Constant) or not isinstance(
                 node.value.value, str
             ):
@@ -77,11 +93,18 @@ def check_metric_names_file(
                 continue
             value = node.value.value
             if target.id.startswith(_SPAN_PREFIXES):
-                if include_span_decls and not _COLON_CASE.match(value):
+                if not _COLON_CASE.match(value):
                     errors.append(
                         f"{path.name}:{node.lineno}: {value!r} is not "
                         f"colon-case (span/instant names look like "
                         f"'layer:operation')"
+                    )
+            elif target.id.startswith(_RULE_PREFIX):
+                if not _KEBAB_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"kebab-case (doctor verdict ids look like "
+                        f"'what-is-wrong')"
                     )
             elif not _SNAKE_CASE.match(value):
                 errors.append(
@@ -106,11 +129,22 @@ def check_metric_names_file(
     return errors
 
 
-def check_span_names_file(path: Path) -> List[str]:
-    """Errors in the declaration file: no span constants at all,
-    non-colon-case values, duplicate constants/values."""
+def _scan_prefixed_decls(
+    path: Path,
+    prefixes: Tuple[str, ...],
+    value_regex: "re.Pattern[str]",
+    shape_error: str,
+    dup_label: str,
+    missing_what: str,
+    empty_error: str,
+) -> List[str]:
+    """ONE declaration-file scan for a prefixed constant family
+    (SPAN_/INSTANT_, RULE_): value-shape check, duplicate constants,
+    duplicate values, empty registry. The span and doctor checkers are
+    thin wrappers so a declaration-hygiene fix lands once, not per
+    family."""
     if not path.exists():
-        return [f"{path.name}: missing (span names must be declared here)"]
+        return [f"{path.name}: missing ({missing_what} must be declared here)"]
     errors = []
     seen_targets = {}
     seen_values = {}
@@ -120,7 +154,7 @@ def check_span_names_file(path: Path) -> List[str]:
             continue
         for target in node.targets:
             if not isinstance(target, ast.Name) or not target.id.startswith(
-                _SPAN_PREFIXES
+                prefixes
             ):
                 continue
             if not isinstance(node.value, ast.Constant) or not isinstance(
@@ -132,10 +166,10 @@ def check_span_names_file(path: Path) -> List[str]:
                 )
                 continue
             value = node.value.value
-            if not _COLON_CASE.match(value):
+            if not value_regex.match(value):
                 errors.append(
                     f"{path.name}:{node.lineno}: {value!r} is not "
-                    f"colon-case ('layer:operation')"
+                    f"{shape_error}"
                 )
             if target.id in seen_targets:
                 errors.append(
@@ -146,13 +180,42 @@ def check_span_names_file(path: Path) -> List[str]:
             seen_targets[target.id] = node.lineno
             if value in seen_values:
                 errors.append(
-                    f"{path.name}:{node.lineno}: span {value!r} "
+                    f"{path.name}:{node.lineno}: {dup_label} {value!r} "
                     f"registered twice (first at line {seen_values[value]})"
                 )
             seen_values[value] = node.lineno
     if not seen_values and not errors:
-        errors.append(f"{path.name}: no span/instant names declared")
+        errors.append(f"{path.name}: {empty_error}")
     return errors
+
+
+def check_span_names_file(path: Path) -> List[str]:
+    """Errors in the declaration file: no span constants at all,
+    non-colon-case values, duplicate constants/values."""
+    return _scan_prefixed_decls(
+        path,
+        _SPAN_PREFIXES,
+        _COLON_CASE,
+        "colon-case ('layer:operation')",
+        "span",
+        "span names",
+        "no span/instant names declared",
+    )
+
+
+def check_doctor_rule_ids_file(path: Path) -> List[str]:
+    """Errors in the declaration file's doctor-verdict registry: no
+    RULE_ constants at all, non-kebab-case values, duplicate
+    constants/values."""
+    return _scan_prefixed_decls(
+        path,
+        (_RULE_PREFIX,),
+        _KEBAB_CASE,
+        "kebab-case ('what-is-wrong')",
+        "rule id",
+        "doctor rule ids",
+        "no doctor rule ids declared",
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +262,31 @@ def _iter_span_literal_sites(
         first = node.args[0]
         if isinstance(first, ast.Constant) and isinstance(first.value, str):
             yield node.lineno, called, first.value
+
+
+def _iter_rule_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, callable, literal) for string-literal verdict ids at
+    doctor emit sites: the first positional arg of ``doctor_rule(...)``
+    / ``Verdict(...)`` or their ``rule=`` / ``rule_id=`` keyword."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        called = _called_name(node.func)
+        if called not in _DOCTOR_CALLABLES:
+            continue
+        candidates = []
+        if node.args:
+            candidates.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg in ("rule", "rule_id"):
+                candidates.append(kw.value)
+        for cand in candidates:
+            if isinstance(cand, ast.Constant) and isinstance(
+                cand.value, str
+            ):
+                yield node.lineno, called, cand.value
 
 
 def check_metric_call_sites(package: Path, names_file: Path) -> List[str]:
@@ -318,11 +406,16 @@ class MetricNameLiteral(Rule):
         names_file = project.root / NAMES_RELPATH
         if not _package_dir(project).is_dir() or not names_file.exists():
             return  # fixture runs without the real package layout
-        # Span declaration hygiene is span-name-literal's: each defect
-        # reports once in a unified run.
+        # Span declaration hygiene is span-name-literal's, doctor-id
+        # hygiene doctor-rule-ids': each defect reports once in a
+        # unified run.
         yield from _decl_findings(
             self.name,
-            check_metric_names_file(names_file, include_span_decls=False),
+            check_metric_names_file(
+                names_file,
+                include_span_decls=False,
+                include_rule_decls=False,
+            ),
             project,
         )
         for relpath, tree in _package_trees(project):
@@ -336,6 +429,37 @@ class MetricNameLiteral(Rule):
                     message=(
                         f"literal metric name {literal!r} in {method}() "
                         f"— use a telemetry/names.py constant"
+                    ),
+                )
+
+
+@register
+class DoctorRuleIds(Rule):
+    name = "doctor-rule-ids"
+    description = (
+        "doctor verdict ids: kebab-case, declared exactly once in "
+        "telemetry/names.py (RULE_ constants), no literal ids at "
+        "doctor_rule/Verdict emit sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return
+        yield from _decl_findings(
+            self.name, check_doctor_rule_ids_file(names_file), project
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath == NAMES_RELPATH:
+                continue
+            for lineno, called, literal in _iter_rule_literal_sites(tree):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal verdict id {literal!r} in {called}() — "
+                        f"use a telemetry/names.py RULE_ constant"
                     ),
                 )
 

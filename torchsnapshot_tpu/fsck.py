@@ -418,14 +418,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = p.parse_args(argv)
     report = verify_snapshot(args.path, deep=args.deep, tier=args.tier)
     if args.stats:
-        from .telemetry.stats import find_events_for, render_summary
-        from .telemetry.trace import find_trace_files, longest_spans
+        # One artifact sweep: the same Evidence bundle drives the
+        # listings below AND the doctor's diagnosis (events, traces and
+        # heartbeats are read from disk exactly once).
+        from .telemetry.doctor import diagnose_evidence, gather_evidence
+        from .telemetry.stats import render_summary
 
-        events = find_events_for(args.path)
+        evidence = gather_evidence(args.path)
         print()
-        if events:
-            print(f"telemetry ({len(events)} event(s)):")
-            print(render_summary(events))
+        if evidence.reports:
+            print(f"telemetry ({len(evidence.reports)} event(s)):")
+            print(render_summary(evidence.reports))
         else:
             print(
                 "telemetry: no events recorded for this snapshot (take "
@@ -433,24 +436,53 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "snapshot-adjacent sink, or run this command with the "
                 "same TORCHSNAPSHOT_TPU_TELEMETRY_DIR the take used)"
             )
-        trace_files = find_trace_files(args.path)
-        if trace_files:
+        n_traces = len(evidence.trace_spans) + len(evidence.trace_unreadable)
+        if n_traces:
             print()
-            print(f"flight-recorder traces ({len(trace_files)} file(s)):")
-            for tf in trace_files:
-                try:
-                    tops = longest_spans(tf, 3)
-                except Exception as e:  # noqa: BLE001 - stats are advisory
-                    print(f"  {tf}: unreadable ({e!r})")
-                    continue
+            print(f"flight-recorder traces ({n_traces} file(s)):")
+            for tf, tops in sorted(evidence.trace_spans.items()):
                 top_str = ", ".join(
-                    f"{t['name']}={t['dur_ms']}ms" for t in tops
+                    f"{t['name']}={t['dur_ms']}ms" for t in tops[:3]
                 )
                 print(f"  {tf}: {top_str}")
+            for tf, err in sorted(evidence.trace_unreadable.items()):
+                print(f"  {tf}: unreadable ({err})")
             print(
                 "  merge + straggler summary: "
                 "python -m torchsnapshot_tpu.telemetry trace <snapshot>"
             )
+        # Progress-heartbeat leftovers: a completed op removes its
+        # heartbeat, so anything still here is a live op, a failed one
+        # (terminal document), or a crashed one (non-terminal) — the
+        # doctor's interrupted-take evidence, listed rather than
+        # silently ignored as unknown dotfiles.
+        if evidence.progress_files:
+            print()
+            print(
+                f"progress heartbeats ({len(evidence.progress_files)} "
+                f"leftover file(s); completed ops remove theirs):"
+            )
+            docs_by_file = {d.get("file"): d for d in evidence.progress}
+            for pf in evidence.progress_files:
+                doc = docs_by_file.get(pf)
+                if doc is None:
+                    print(f"  {pf}: unreadable")
+                    continue
+                status = doc.get("terminal") or "NOT TERMINAL (live or crashed)"
+                print(
+                    f"  {pf}: {doc.get('kind', '?')} rank "
+                    f"{doc.get('rank', '?')} {doc.get('phase', '?')} — "
+                    f"{doc.get('written_bytes', 0)}/"
+                    f"{doc.get('planned_bytes', 0)} bytes, "
+                    f"{doc.get('items_done', 0)}/"
+                    f"{doc.get('planned_items', 0)} items [{status}]"
+                )
+        verdicts = diagnose_evidence(evidence)
+        if verdicts:
+            print()
+            print(f"doctor verdicts ({len(verdicts)}):")
+            for v in verdicts:
+                print(f"  {v.format()}")
         print()
     for prob in report.problems:
         print(f"FSCK {prob.kind}: {prob.location}: {prob.detail}")

@@ -39,10 +39,15 @@ _TRACE_BUFFER_EVENTS_ENV = "TORCHSNAPSHOT_TPU_TRACE_BUFFER_EVENTS"
 _WATCHDOG_SECONDS_ENV = "TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS"
 _DISABLE_NATIVE_ENV = "TORCHSNAPSHOT_TPU_DISABLE_NATIVE"
 _WAIT_DURABLE_TIMEOUT_ENV = "TORCHSNAPSHOT_TPU_WAIT_DURABLE_TIMEOUT_SECONDS"
+_PROGRESS_SECONDS_ENV = "TORCHSNAPSHOT_TPU_PROGRESS_SECONDS"
+_PROGRESS_DIR_ENV = "TORCHSNAPSHOT_TPU_PROGRESS_DIR"
+_HISTORY_MAX_RECORDS_ENV = "TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
 _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS: float = 1800.0
+_DEFAULT_PROGRESS_SECONDS: float = 1.0
+_DEFAULT_HISTORY_MAX_RECORDS: int = 512
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -234,6 +239,40 @@ def get_wait_durable_timeout_seconds() -> float:
     return _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS
 
 
+def get_progress_interval_seconds() -> float:
+    """Minimum interval between live-progress heartbeat rewrites
+    (``<snapshot>/.progress-rank<r>.json``, telemetry/progress.py).
+    <= 0 disables the file heartbeat entirely; the in-memory
+    ``telemetry.current_progress()`` view is always on regardless. The
+    test conftest sets 0 so the fast suite's snapshot dirs stay
+    deterministic."""
+    val = os.environ.get(_PROGRESS_SECONDS_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_PROGRESS_SECONDS
+
+
+def get_progress_dir() -> Optional[str]:
+    """Local directory for live-progress heartbeat files
+    (``<dir>/progress-rank<r>.json``). Takes precedence over the
+    snapshot-adjacent heartbeat — the object-store escape hatch, like
+    the telemetry/trace dir knobs; unset = snapshot-adjacent when the
+    snapshot path is local."""
+    return os.environ.get(_PROGRESS_DIR_ENV) or None
+
+
+def get_history_max_records() -> int:
+    """Bound on the per-manager rolling step-telemetry history
+    (``<root>/.telemetry-history.jsonl``, telemetry/history.py): the
+    newest N summaries are kept, older ones rewritten away. <= 0
+    disables history recording entirely; the test conftest sets 0 so
+    tier-1 manager tests stay deterministic."""
+    val = os.environ.get(_HISTORY_MAX_RECORDS_ENV)
+    if val is not None:
+        return int(val)
+    return _DEFAULT_HISTORY_MAX_RECORDS
+
+
 def get_prometheus_textfile() -> Optional[str]:
     """Prometheus text-exposition file, rewritten (atomically) after
     every report emission — the node-exporter textfile-collector
@@ -380,6 +419,26 @@ def override_wait_durable_timeout_seconds(
     seconds: float,
 ) -> Generator[None, None, None]:
     with _override_env(_WAIT_DURABLE_TIMEOUT_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_progress_interval_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_PROGRESS_SECONDS_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_progress_dir(path: str) -> Generator[None, None, None]:
+    with _override_env(_PROGRESS_DIR_ENV, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_history_max_records(n: int) -> Generator[None, None, None]:
+    with _override_env(_HISTORY_MAX_RECORDS_ENV, str(n)):
         yield
 
 

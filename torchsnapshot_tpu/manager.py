@@ -37,6 +37,7 @@ import asyncio
 import json
 import logging
 import re
+import threading
 from typing import Any, Dict, List, Optional, Set
 
 from . import knobs, telemetry
@@ -107,15 +108,26 @@ class _PendingManagedSnapshot:
         self._step = step
         self._pending = pending
         self._metric = metric
+        self._committed = False
+        self._commit_lock = threading.Lock()
 
     def wait(self) -> Snapshot:
         snapshot = self._pending.wait()  # raises on failed take: no index entry
-        self._manager._commit_step(
-            self._step,
-            refs=lambda: referenced_steps(snapshot.metadata.manifest),
-            metric=self._metric,
-        )
-        telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
+        # Idempotent join, lock-guarded: wait() may be called from more
+        # than one place (progress loop + shutdown path, possibly on
+        # different threads) and must commit + record history exactly
+        # once — a duplicate history record widens the trend baseline.
+        with self._commit_lock:
+            if self._committed:
+                return snapshot
+            self._manager._commit_step(
+                self._step,
+                refs=lambda: referenced_steps(snapshot.metadata.manifest),
+                metric=self._metric,
+            )
+            telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
+            self._manager._record_step_history(self._step)
+            self._committed = True
         return snapshot
 
     def done(self) -> bool:
@@ -230,6 +242,7 @@ class CheckpointManager:
             metric=metric,
         )
         telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
+        self._record_step_history(step)
         return snapshot
 
     @staticmethod
@@ -262,6 +275,33 @@ class CheckpointManager:
             self.step_path(step), app_state, pg=self._pg_arg, **take_kwargs
         )
         return _PendingManagedSnapshot(self, step, pending, metric=metric)
+
+    def _record_step_history(self, step: int) -> None:
+        """Append the just-committed step's telemetry summary to the
+        manager root's rolling history (``.telemetry-history.jsonl``),
+        the input ``doctor --trend`` baselines against. Rank 0 only;
+        best-effort (history must never fail a save); knob-bounded
+        (TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS, <= 0 disables)."""
+        if self._pg.get_rank() != 0:
+            return
+        try:
+            from .telemetry import history, last_report
+
+            # Path-keyed lookup: overlapping async saves each find their
+            # own step's report, never whichever commit thread emitted
+            # last.
+            report = last_report(
+                "take", "async_take", path=self.step_path(step)
+            )
+            if report is None:
+                return
+            history.append_summary(
+                self.root, history.summarize_report(report, step=step)
+            )
+        except Exception as e:  # noqa: BLE001 - history is best-effort
+            logger.warning(
+                "could not record step %d telemetry history: %r", step, e
+            )
 
     # ------------------------------------------------------------------
     # resuming
@@ -651,12 +691,16 @@ class CheckpointManager:
 
             # Commit marker first (deletion discipline shared with
             # _delete_step_async), then data, then the journal. The
-            # telemetry event log is not manifest-named; drop it
-            # explicitly or every evicted step leaks one file.
+            # telemetry event log and progress heartbeats are not
+            # manifest-named; drop them explicitly or every evicted
+            # step leaks files.
+            from .telemetry.progress import SNAPSHOT_PROGRESS_PREFIX
             from .telemetry.sink import SNAPSHOT_EVENTS_BASENAME
 
             await _drop(SNAPSHOT_METADATA_FNAME)
             await _drop(SNAPSHOT_EVENTS_BASENAME)
+            for rank in range(metadata.world_size):
+                await _drop(f"{SNAPSHOT_PROGRESS_PREFIX}{rank}.json")
             slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
 
             async def _drop_slotted(location: str) -> None:
@@ -801,15 +845,30 @@ class CheckpointManager:
                 from .tiered.journal import MirrorJournal
 
                 await MirrorJournal(blobs={}).delete(storage.fast)
-            # The snapshot-adjacent telemetry log is not named by the
-            # manifest; remove it with the step or GC leaks one file per
-            # dropped step.
+            # The snapshot-adjacent telemetry log and any progress
+            # heartbeats (a crashed take leaves one behind) are not
+            # named by the manifest; remove them with the step or GC
+            # leaks files per dropped step. Shared-dir heartbeats have
+            # no other reaper at all.
+            from .telemetry.progress import (
+                SNAPSHOT_PROGRESS_PREFIX,
+                remove_dir_heartbeats,
+            )
             from .telemetry.sink import SNAPSHOT_EVENTS_BASENAME
+
+            remove_dir_heartbeats(self.step_path(step))
 
             try:
                 await storage.delete(SNAPSHOT_EVENTS_BASENAME)
             except FileNotFoundError:
                 pass  # sink was never enabled for this step
+            for rank in range(metadata.world_size):
+                try:
+                    await storage.delete(
+                        f"{SNAPSHOT_PROGRESS_PREFIX}{rank}.json"
+                    )
+                except FileNotFoundError:
+                    pass  # no heartbeat recorded / already settled
 
             locations: Set[str] = set()
             manifest: Manifest = metadata.manifest

@@ -27,9 +27,12 @@ import logging
 import math
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Optional
+from typing import TYPE_CHECKING, Callable, List, Optional
 
 import psutil
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .telemetry.progress import ProgressTracker
 
 from . import knobs, telemetry
 from .telemetry.trace import get_recorder as _trace_recorder
@@ -173,6 +176,12 @@ class _PipelineStats:
         self.io = 0
         self.done = 0
         self.bytes_moved = 0
+        self.bytes_staged = 0
+
+
+# report_phase_done -> the phase the op is IN once that one completed,
+# published to the live-progress heartbeat (telemetry/progress.py).
+_NEXT_PHASE = {"staging": "writing", "writing": "committing", "loading": "applying"}
 
 
 class _ProgressReporter:
@@ -185,11 +194,19 @@ class _ProgressReporter:
     )
 
     def __init__(
-        self, stats: _PipelineStats, budget: MemoryBudget, rank: int, total: int
+        self,
+        stats: _PipelineStats,
+        budget: MemoryBudget,
+        rank: int,
+        total: int,
+        progress: Optional["ProgressTracker"] = None,
     ) -> None:
         self.stats = stats
         self.budget = budget
         self.rank = rank
+        # Live-progress tracker for the enclosing operation (None when
+        # the caller runs no heartbeat, e.g. read_object).
+        self.progress = progress
         # Per-pipeline phase completions (phase -> seconds since start):
         # unlike the process-global last_phase_timings channel this can
         # never leak a previous run's phases into this run's report.
@@ -236,10 +253,30 @@ class _ProgressReporter:
         if self.stats.done % self.report_every == 0:
             self.report()
 
+    def publish_progress(self) -> None:
+        """Feed the op's live-progress tracker from this pipeline's
+        counters. Called on every request completion and phase
+        transition; the tracker's file writes are interval-gated, the
+        in-memory view updates every time."""
+        if self.progress is None:
+            return
+        self.progress.update_pipeline(
+            pending=self.stats.pending,
+            staging=self.stats.staging,
+            inflight=self.stats.waiting_io + self.stats.io,
+            done=self.stats.done,
+            staged_bytes=self.stats.bytes_staged,
+            done_bytes=self.stats.bytes_moved,
+            budget_wait_s=self.budget.wait_s,
+        )
+
     def report_phase_done(self, phase: str) -> None:
         elapsed = time.monotonic() - self.begin_ts
         self.phase_s[phase] = round(elapsed, 3)
         telemetry.record_phase(phase, elapsed)
+        self.publish_progress()
+        if self.progress is not None and phase in _NEXT_PHASE:
+            self.progress.set_phase(_NEXT_PHASE[phase])
         mbps = safe_rate_mb_s(self.stats.bytes_moved, elapsed)
         msg = (
             f"Rank {self.rank} completed {phase} in {elapsed:.2f}s "
@@ -335,14 +372,23 @@ async def execute_write_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    progress: Optional["ProgressTracker"] = None,
 ) -> PendingIOWork:
     """Run the staged write pipeline; returns once every request is past
-    staging, with storage I/O continuing inside the returned handle."""
+    staging, with storage I/O continuing inside the returned handle.
+    ``progress`` (the enclosing op's live-progress tracker) receives the
+    pipeline's plan and per-request counter updates."""
     budget = MemoryBudget(memory_budget_bytes)
     stats = _PipelineStats()
     stats.pending = len(write_reqs)
-    reporter = _ProgressReporter(stats, budget, rank, len(write_reqs))
+    reporter = _ProgressReporter(stats, budget, rank, len(write_reqs), progress)
     reporter.print_header()
+    if progress is not None:
+        progress.begin_pipeline(
+            len(write_reqs),
+            sum(r.buffer_stager.get_staging_cost_bytes() for r in write_reqs),
+            phase="staging",
+        )
 
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="ts-stage"
@@ -428,6 +474,7 @@ async def execute_write_reqs(
         stats.done += 1
         stats.bytes_moved += buf_len
         reporter.maybe_report()
+        reporter.publish_progress()
 
     async def stage_one(req: WriteReq) -> None:
         """Budget-admitted staging; hands the staged buffer straight to a
@@ -458,6 +505,8 @@ async def execute_write_reqs(
         recorder.end(stage_span, staged_bytes=len(buf))
         stats.staging -= 1
         stats.waiting_io += 1
+        stats.bytes_staged += len(buf)
+        reporter.publish_progress()
         # Re-price the reservation: actual buffer size can differ from the
         # staging cost (e.g. pickled objects).
         await budget.adjust(len(buf) - cost)
@@ -490,6 +539,7 @@ def sync_execute_write_reqs(
     memory_budget_bytes: int,
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
+    progress: Optional["ProgressTracker"] = None,
 ) -> PendingIOWork:
     return event_loop.run_until_complete(
         execute_write_reqs(
@@ -497,6 +547,7 @@ def sync_execute_write_reqs(
             storage=storage,
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
+            progress=progress,
         )
     )
 
@@ -508,6 +559,7 @@ async def execute_read_reqs(
     rank: int,
     checksum_table: Optional[ChecksumTable] = None,
     on_req_complete: Optional[Callable[[ReadReq], None]] = None,
+    progress: Optional["ProgressTracker"] = None,
 ) -> dict:
     """Read pipeline: storage read -> deserialize/copy, budgeted by each
     request's consuming cost (reference scheduler.py:357-444). Returns
@@ -521,7 +573,15 @@ async def execute_read_reqs(
     budget = MemoryBudget(memory_budget_bytes)
     stats = _PipelineStats()
     stats.pending = len(read_reqs)
-    reporter = _ProgressReporter(stats, budget, rank, len(read_reqs))
+    reporter = _ProgressReporter(stats, budget, rank, len(read_reqs), progress)
+    if progress is not None:
+        progress.begin_pipeline(
+            len(read_reqs),
+            sum(
+                r.buffer_consumer.get_consuming_cost_bytes() for r in read_reqs
+            ),
+            phase="loading",
+        )
 
     executor = ThreadPoolExecutor(
         max_workers=knobs.get_staging_threads(), thread_name_prefix="ts-consume"
@@ -648,6 +708,7 @@ async def execute_read_reqs(
             if on_req_complete is not None:
                 on_req_complete(req)
             reporter.maybe_report()
+            reporter.publish_progress()
         finally:
             await budget.release(cost)
 
@@ -680,6 +741,7 @@ def sync_execute_read_reqs(
     event_loop: asyncio.AbstractEventLoop,
     checksum_table: Optional[ChecksumTable] = None,
     on_req_complete: Optional[Callable[[ReadReq], None]] = None,
+    progress: Optional["ProgressTracker"] = None,
 ) -> dict:
     return event_loop.run_until_complete(
         execute_read_reqs(
@@ -689,5 +751,6 @@ def sync_execute_read_reqs(
             rank=rank,
             checksum_table=checksum_table,
             on_req_complete=on_req_complete,
+            progress=progress,
         )
     )

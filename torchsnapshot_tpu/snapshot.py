@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from . import knobs, telemetry
+from .telemetry import progress as _progress
 from .telemetry.trace import (
     TraceMark,
     export_op_trace,
@@ -119,16 +120,9 @@ def _mirror_state_for(path: str) -> Dict[str, Any]:
     """The process mirror's queue/lag state, for reports about tiered
     paths ({} otherwise): at take-report time the step's upload job was
     just enqueued, so this is the durability backlog the take added to."""
-    from .storage_plugin import split_tiered_url
+    from .tiered.mirror import mirror_state_for_path
 
-    try:
-        if split_tiered_url(path) is None:
-            return {}
-    except ValueError:
-        return {}
-    from .tiered.mirror import get_mirror
-
-    return dict(get_mirror().metrics())
+    return dict(mirror_state_for_path(path) or {})
 
 
 def _emit_snapshot_report(
@@ -282,6 +276,10 @@ class Snapshot:
         take_span = recorder.begin(
             telemetry.names.SPAN_TAKE, path=path, rank=pg_wrapper.get_rank()
         )
+        # Live-progress heartbeat for the whole op: external pollers see
+        # a stuck rank from outside the process (telemetry/progress.py).
+        tracker = _progress.track("take", path, pg_wrapper.get_rank())
+        op_error: Optional[BaseException] = None
         try:
             storage = url_to_storage_plugin(path)
             with _reporting_to(barrier, "take"):
@@ -296,6 +294,7 @@ class Snapshot:
                     incremental_base=incremental_base,
                     record_digests=record_digests,
                     _custom_array_prepare_func=_custom_array_prepare_func,
+                    progress_tracker=tracker,
                 )
                 pending_io_work.sync_complete(event_loop)
                 pending_io_work.finalize_checksums()
@@ -334,7 +333,13 @@ class Snapshot:
                 nonce=commit_nonce,
                 trace_mark=trace_mark,
             )
+        except BaseException as e:
+            op_error = e
+            raise
         finally:
+            # Success removes the heartbeat file; failure leaves a
+            # terminal document (doctor evidence the op *ended*).
+            tracker.finish(op_error)
             recorder.end(take_span)  # no-op if already closed
             event_loop.close()
         snapshot = cls(path=path, pg=pg)
@@ -379,6 +384,7 @@ class Snapshot:
         recorder = _trace_recorder()
         trace_mark = recorder.mark()
         storage = url_to_storage_plugin(path)
+        tracker = _progress.track("async_take", path, pg_wrapper.get_rank())
         try:
             with recorder.span(
                 telemetry.names.SPAN_ASYNC_TAKE_STAGE,
@@ -396,10 +402,12 @@ class Snapshot:
                     incremental_base=incremental_base,
                     record_digests=record_digests,
                     _custom_array_prepare_func=_custom_array_prepare_func,
+                    progress_tracker=tracker,
                 )
-        except BaseException:
+        except BaseException as e:
             # The failure path owns the loop/storage (no PendingSnapshot
             # thread will ever run to close them).
+            tracker.finish(e)
             try:
                 event_loop.run_until_complete(storage.close())
             except Exception:  # noqa: BLE001 - already failing
@@ -416,6 +424,7 @@ class Snapshot:
             commit_nonce=commit_nonce,
             counter_baseline=counter_baseline,
             trace_mark=trace_mark,
+            progress_tracker=tracker,
         )
 
     @classmethod
@@ -431,6 +440,7 @@ class Snapshot:
         incremental_base: Optional[Any] = None,
         record_digests: bool = False,
         _custom_array_prepare_func=None,
+        progress_tracker: Optional[_progress.ProgressTracker] = None,
     ) -> Tuple[PendingIOWork, Optional[SnapshotMetadata]]:
         """Shared take core (reference snapshot.py:316-440). The returned
         metadata is None on non-leader ranks (manifests gather to rank 0
@@ -563,6 +573,7 @@ class Snapshot:
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
             event_loop=event_loop,
+            progress=progress_tracker,
         )
         if incr_ctx is not None:
             # Referenced blobs were not rewritten, so their checksums come
@@ -654,6 +665,8 @@ class Snapshot:
         restore_span = recorder.begin(
             telemetry.names.SPAN_RESTORE, path=self.path, rank=rank
         )
+        tracker = _progress.track("restore", self.path, rank)
+        op_error: Optional[BaseException] = None
         pipeline_sink: List[dict] = []
 
         def key_barrier(i: int) -> Optional[LinearBarrier]:
@@ -700,6 +713,7 @@ class Snapshot:
                             rank=rank,
                             checksum_table=checksum_table,
                             pipeline_sink=pipeline_sink,
+                            progress_tracker=tracker,
                         )
                 if barrier is not None:
                     barrier.arrive()
@@ -719,6 +733,7 @@ class Snapshot:
                     rank=rank,
                     checksum_table=checksum_table,
                     pipeline_sink=pipeline_sink,
+                    progress_tracker=tracker,
                 )
             event_loop.run_until_complete(storage.close())
             recorder.end(restore_span)
@@ -731,7 +746,11 @@ class Snapshot:
                 nonce=restore_nonce,
                 trace_mark=trace_mark,
             )
+        except BaseException as e:
+            op_error = e
+            raise
         finally:
+            tracker.finish(op_error)
             recorder.end(restore_span)  # no-op if already closed
             event_loop.close()
 
@@ -840,6 +859,7 @@ class Snapshot:
         rank: int,
         checksum_table=None,
         pipeline_sink: Optional[List[dict]] = None,
+        progress_tracker: Optional[_progress.ProgressTracker] = None,
     ) -> None:
         """Memory-frugal restore of one stateful: reuse the leaves already
         allocated in its current state dict as read destinations so peak
@@ -868,6 +888,7 @@ class Snapshot:
             event_loop=event_loop,
             checksum_table=checksum_table,
             on_req_complete=placer.on_req_complete,
+            progress=progress_tracker,
         )
         if pipeline_sink is not None:
             pipeline_sink.append(pipeline_telemetry)
@@ -1275,6 +1296,7 @@ class PendingSnapshot:
         commit_nonce: str = "",
         counter_baseline: Optional[Dict[str, float]] = None,
         trace_mark: Optional[TraceMark] = None,
+        progress_tracker: Optional[_progress.ProgressTracker] = None,
     ) -> None:
         import threading
 
@@ -1287,6 +1309,7 @@ class PendingSnapshot:
         self._pending_io_work = pending_io_work
         self._counter_baseline = counter_baseline or {}
         self._trace_mark = trace_mark
+        self._progress_tracker = progress_tracker
         self._exc_info: Optional[BaseException] = None
         self._done = threading.Event()
         self._thread = threading.Thread(
@@ -1350,6 +1373,8 @@ class PendingSnapshot:
                         "Failed to report snapshot error to peers: %r", report_exc
                     )
         finally:
+            if self._progress_tracker is not None:
+                self._progress_tracker.finish(self._exc_info)
             recorder.end(commit_span)  # no-op if already closed
             self._event_loop.close()
             self._done.set()
@@ -1406,6 +1431,11 @@ class PendingRestore:
         self._world_size = world_size
         self._counter_baseline = counter_baseline or {}
         self._trace_mark = trace_mark
+        # Created on the initiating thread; fed and settled by the
+        # background read thread.
+        self._progress_tracker = _progress.track(
+            "async_restore", path, rank
+        )
         self._pipeline_telemetry: Optional[dict] = None
         self._exc_info: Optional[BaseException] = None
         self._applied = False
@@ -1448,6 +1478,7 @@ class PendingRestore:
                 event_loop=event_loop,
                 checksum_table=checksum_table,
                 on_req_complete=placer.on_req_complete,
+                progress=self._progress_tracker,
             )
             placer.flush()
             # Whatever didn't stream (flush disabled, zero-read leaves)
@@ -1463,6 +1494,7 @@ class PendingRestore:
             self._exc_info = e
             logger.error("Async restore failed: %r", e)
         finally:
+            self._progress_tracker.finish(self._exc_info)
             _trace_recorder().end(reads_span)
             event_loop.close()
             self._done.set()

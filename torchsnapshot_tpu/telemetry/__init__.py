@@ -18,13 +18,23 @@ layers — exported per operation as Chrome trace JSON (knob-gated, like
 the sinks), merged cross-rank by ``python -m torchsnapshot_tpu.telemetry
 trace``, and patrolled by the stall watchdog (watchdog.py).
 
+Three further layers make the telemetry *operable*: live per-rank
+progress heartbeats for operations in flight (progress.py —
+``current_progress()`` in-process, atomically-rewritten
+``.progress-rank<r>.json`` files for external pollers), the rule-based
+**checkpoint doctor** (doctor.py — ``python -m
+torchsnapshot_tpu.telemetry doctor <snapshot>`` emits ranked,
+evidence-cited verdicts from the recorded artifacts), and a rolling
+per-manager step history with median±MAD trend regression detection
+(history.py, ``doctor --trend``).
+
 See docs/observability.md for the metric inventory, span inventory,
 report schema, sink knobs, and CLI.
 """
 
 from __future__ import annotations
 
-from . import names, trace, watchdog
+from . import doctor, history, names, progress, trace, watchdog
 from .registry import (
     DEFAULT_SECONDS_BUCKETS,
     MetricsRegistry,
@@ -38,9 +48,11 @@ from .report import (
     clock_offsets_from_gather,
     merge_pipeline_telemetry,
 )
+from .progress import current_progress
 from .sink import (
     emit_report,
     events_path_for,
+    last_report,
     load_events,
     render_prometheus,
     write_prometheus_textfile,
@@ -53,14 +65,19 @@ __all__ = [
     "aggregate_across_ranks",
     "build_report",
     "clock_offsets_from_gather",
+    "current_progress",
+    "doctor",
     "emit_report",
     "events_path_for",
+    "history",
+    "last_report",
     "load_events",
     "merge_pipeline_telemetry",
     "metrics",
     "names",
     "observe_io",
     "parse_series_key",
+    "progress",
     "record_phase",
     "render_prometheus",
     "reset_metrics",

@@ -1,0 +1,822 @@
+"""The checkpoint doctor: rule-based diagnosis over checkpoint telemetry.
+
+Until now the only consumer that *interpreted* telemetry (rather than
+rendering it) was ~150 lines of private heuristics inside ``bench.py``
+— no production caller could ask "why was that take slow?". The doctor
+is that shared diagnosis layer: a declared registry of rules, each
+consuming a completed (or live) operation's artifacts — SnapshotReport
+JSONL, merged trace spans, progress heartbeats, mirror state, fsck
+results — and emitting ranked, evidence-cited :class:`Verdict`\\ s.
+
+Every verdict id is declared exactly once in ``telemetry/names.py``
+(``RULE_`` constants, kebab-case); snaplint's ``doctor-rule-ids`` rule
+fails the lane on a literal id at a ``doctor_rule``/``Verdict`` emit
+site, so the id namespace stays stable enough for alerting to key off.
+
+Entry points:
+
+- ``python -m torchsnapshot_tpu.telemetry doctor <snapshot>`` — diagnose
+  one snapshot's recorded artifacts;
+- ``... doctor --trend <manager-root>`` — flag per-step regressions
+  against a rolling median ± MAD baseline (telemetry/history.py);
+- library: :func:`diagnose_snapshot`, :func:`diagnose_reports`,
+  :func:`diagnose_take_trial` (the bench's per-trial stall/efficiency
+  epistemics — ``bench.py`` calls these so the bench and production
+  agree on what "stalled" means).
+
+Thresholds are module constants (documented in docs/observability.md);
+rules cite the exact metric values that triggered them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from . import names
+from .history import detect_trend_regressions
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+# -- thresholds (each rule cites the one it used) ---------------------------
+
+# d2h-bound: staging consumed at least this fraction of the take wall.
+D2H_BOUND_STAGING_FRAC = 0.7
+# storage-tier-slow: the post-staging write drain is at least this
+# multiple of staging AND at least this many seconds.
+STORAGE_SLOW_DRAIN_FACTOR = 2.0
+STORAGE_SLOW_MIN_S = 0.25
+# budget-starved: cumulative budget wait at least this fraction of the
+# pipeline wall clock.
+BUDGET_STARVED_WAIT_FRAC = 0.25
+# straggler-rank: a rank's phase time at least this multiple of the
+# cross-rank median AND at least this many seconds beyond it.
+STRAGGLER_FACTOR = 2.0
+STRAGGLER_MIN_DELTA_S = 1.0
+# mirror-lagging: durability lag beyond this, or this many snapshots
+# queued behind the mirror.
+MIRROR_LAG_S = 60.0
+MIRROR_QUEUE_DEPTH = 2
+# write-tail-stall: one storage-write span at least this fraction of
+# the op's longest span AND at least this many ms.
+TAIL_SPAN_FRAC = 0.5
+TAIL_SPAN_MIN_MS = 1000.0
+# retry-storm: at least this many retry attempts inside one op window.
+RETRY_STORM_ATTEMPTS = 3
+# interrupted-take: a non-terminal heartbeat only counts as a crash
+# once it is stale — this many missed writer intervals (with an
+# absolute floor, below) — so diagnosing a snapshot DURING a healthy
+# take never raises a false critical.
+INTERRUPTED_STALE_INTERVALS = 10.0
+INTERRUPTED_STALE_MIN_S = 30.0
+# Bench-trial epistemics (formerly private to bench.py):
+# adjacent probes disagreeing beyond this factor = unstable link;
+# achieved/bracket below this ratio on a stable bracket = in-take stall.
+UNSTABLE_BRACKET_FACTOR = 1.5
+STALL_EFFICIENCY_RATIO = 0.5
+
+_SEVERITY_ORDER = {"critical": 0, "warning": 1, "info": 2}
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One diagnosis: a declared rule id, a one-line summary, and the
+    metric values that triggered it (``evidence``) with the artifact
+    they came from (``source``)."""
+
+    rule: str
+    summary: str
+    evidence: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    severity: str = "warning"
+    source: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        ev = ", ".join(f"{k}={v}" for k, v in sorted(self.evidence.items()))
+        src = f" [{self.source}]" if self.source else ""
+        return f"{self.severity.upper():>8} {self.rule}: {self.summary} ({ev}){src}"
+
+
+class _DoctorRule:
+    __slots__ = ("rule_id", "fn")
+
+    def __init__(self, rule_id: str, fn: Callable) -> None:
+        self.rule_id = rule_id
+        self.fn = fn
+
+
+_REPORT_RULES: List[_DoctorRule] = []
+_EVIDENCE_RULES: List[_DoctorRule] = []
+
+
+def doctor_rule(
+    rule_id: str, scope: str = "report"
+) -> Callable[[Callable], Callable]:
+    """Register a diagnosis rule under a declared id. ``scope`` is
+    "report" (called once per SnapshotReport dict) or "evidence"
+    (called once with the full artifact bundle). The decorated function
+    returns a verdict-shaped dict (summary/evidence/severity/source),
+    a list of them, or None; the engine stamps the registered id so no
+    literal id ever appears at an emit site."""
+
+    def deco(fn: Callable) -> Callable:
+        bucket = _REPORT_RULES if scope == "report" else _EVIDENCE_RULES
+        bucket.append(_DoctorRule(rule_id, fn))
+        return fn
+
+    return deco
+
+
+def registered_rule_ids() -> List[str]:
+    """Every registered verdict id (the rule catalogue), sorted."""
+    static = [
+        names.RULE_IN_TAKE_STALL,
+        names.RULE_LINK_UNSTABLE,
+        names.RULE_TREND_REGRESSION,
+    ]
+    return sorted(
+        {r.rule_id for r in _REPORT_RULES + _EVIDENCE_RULES} | set(static)
+    )
+
+
+def _as_verdicts(rule_id: str, raw: Any) -> List[Verdict]:
+    if raw is None:
+        return []
+    items = raw if isinstance(raw, list) else [raw]
+    out = []
+    for item in items:
+        out.append(
+            Verdict(
+                rule=rule_id,
+                summary=item.get("summary", rule_id),
+                evidence=dict(item.get("evidence", {})),
+                severity=item.get("severity", "warning"),
+                source=item.get("source", ""),
+            )
+        )
+    return out
+
+
+def rank_verdicts(verdicts: List[Verdict]) -> List[Verdict]:
+    """Severity first, then the rule id for a stable order."""
+    return sorted(
+        verdicts,
+        key=lambda v: (_SEVERITY_ORDER.get(v.severity, 9), v.rule, v.source),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Evidence bundle
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Evidence:
+    """Everything the doctor reads about one snapshot: recorded reports,
+    trace-span summaries, progress heartbeats (live or leftover), and
+    the process mirror's state (None when unavailable)."""
+
+    path: str
+    reports: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    trace_spans: Dict[str, List[Dict[str, Any]]] = dataclasses.field(
+        default_factory=dict
+    )
+    # Trace files that exist but could not be parsed (file -> error):
+    # an audit surface must list a corrupt artifact, not drop it.
+    trace_unreadable: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
+    trace_stalls: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    progress: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    progress_files: List[str] = dataclasses.field(default_factory=list)
+    mirror_state: Optional[Dict[str, Any]] = None
+    fsck_problems: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+def gather_evidence(snapshot_path: str) -> Evidence:
+    """Collect one snapshot's on-disk artifacts. Every source is
+    optional — the doctor diagnoses from whatever was recorded."""
+    from .stats import find_events_for
+    from .trace import find_trace_files, longest_spans_from_doc
+    from .progress import find_progress_files, load_progress_file
+
+    ev = Evidence(path=snapshot_path)
+    try:
+        ev.reports = find_events_for(snapshot_path)
+    except Exception as e:  # noqa: BLE001 - diagnose from what exists
+        logger.warning("doctor: could not load events: %r", e)
+    try:
+        import json as _json
+
+        for tf in find_trace_files(snapshot_path):
+            # One parse per trace file: the span summary and the
+            # watchdog-stall scan both read the same loaded doc.
+            try:
+                with open(tf, "r", encoding="utf-8") as f:
+                    doc = _json.load(f)
+            except (OSError, ValueError) as e:
+                ev.trace_unreadable[tf] = repr(e)
+                continue
+            try:
+                ev.trace_spans[tf] = longest_spans_from_doc(doc, 10)
+            except Exception as e:  # noqa: BLE001
+                ev.trace_unreadable[tf] = repr(e)
+                continue
+            for event in doc.get("traceEvents", []):
+                if (
+                    event.get("ph") == "i"
+                    and event.get("name") == names.INSTANT_WATCHDOG_STALL
+                ):
+                    ev.trace_stalls.append(
+                        {"file": tf, **(event.get("args") or {})}
+                    )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("doctor: could not scan traces: %r", e)
+    try:
+        for pf in find_progress_files(snapshot_path):
+            ev.progress_files.append(pf)
+            doc = load_progress_file(pf)
+            if doc is not None:
+                doc["file"] = pf
+                ev.progress.append(doc)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("doctor: could not load progress files: %r", e)
+    try:
+        from ..tiered.mirror import mirror_state_for_path
+
+        ev.mirror_state = mirror_state_for_path(snapshot_path)
+    except Exception:  # noqa: BLE001 - mirror state is optional evidence
+        pass
+    return ev
+
+
+# ---------------------------------------------------------------------------
+# Report-scope rules
+# ---------------------------------------------------------------------------
+
+
+def _take_phases(report: Dict[str, Any]):
+    """(staging_s, wall_s) for a write-pipeline report; None for reads.
+    Phases are completion offsets, so ``writing`` includes staging and
+    the max is the pipeline's wall clock."""
+    phases = report.get("phases") or {}
+    if "staging" not in phases:
+        return None
+    staging = float(phases["staging"])
+    wall = max(float(v) for v in phases.values())
+    return staging, wall
+
+
+@doctor_rule(names.RULE_D2H_BOUND)
+def _d2h_bound(report: Dict[str, Any]):
+    tp = _take_phases(report)
+    if tp is None:
+        return None
+    staging, wall = tp
+    if wall <= 0 or staging / wall < D2H_BOUND_STAGING_FRAC:
+        return None
+    return {
+        "summary": (
+            "staging (D2H + serialize) consumed most of the take; the "
+            "device link, not storage, bounds this checkpoint"
+        ),
+        "evidence": {
+            "staging_s": staging,
+            "wall_s": wall,
+            "staging_frac": round(staging / wall, 3),
+            "threshold_frac": D2H_BOUND_STAGING_FRAC,
+        },
+    }
+
+
+@doctor_rule(names.RULE_STORAGE_TIER_SLOW)
+def _storage_tier_slow(report: Dict[str, Any]):
+    tp = _take_phases(report)
+    if tp is None:
+        return None
+    staging, wall = tp
+    drain = wall - staging
+    if drain < STORAGE_SLOW_MIN_S or drain < STORAGE_SLOW_DRAIN_FACTOR * max(
+        staging, 1e-9
+    ):
+        return None
+    from . import safe_rate_mb_s
+
+    return {
+        "summary": (
+            "the write drain after staging dominates the take: the "
+            "storage tier (or its link) is the bottleneck"
+        ),
+        "evidence": {
+            "staging_s": staging,
+            "write_drain_s": round(drain, 3),
+            "wall_s": wall,
+            "write_mb_s": round(
+                safe_rate_mb_s(report.get("bytes_moved", 0), drain), 3
+            ),
+            "threshold_factor": STORAGE_SLOW_DRAIN_FACTOR,
+        },
+    }
+
+
+@doctor_rule(names.RULE_BUDGET_STARVED)
+def _budget_starved(report: Dict[str, Any]):
+    phases = report.get("phases") or {}
+    wall = max((float(v) for v in phases.values()), default=0.0)
+    wait = float(report.get("budget_wait_s", 0.0))
+    if wall <= 0 or wait / wall < BUDGET_STARVED_WAIT_FRAC:
+        return None
+    return {
+        "summary": (
+            "requests spent a large fraction of the op blocked on the "
+            "host-memory budget; raise "
+            "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES or reduce "
+            "concurrency"
+        ),
+        "evidence": {
+            "budget_wait_s": wait,
+            "wall_s": wall,
+            "wait_frac": round(wait / wall, 3),
+            "peak_staged_bytes": report.get("peak_staged_bytes", 0),
+            "threshold_frac": BUDGET_STARVED_WAIT_FRAC,
+        },
+    }
+
+
+@doctor_rule(names.RULE_STRAGGLER_RANK)
+def _straggler_rank(report: Dict[str, Any]):
+    agg = report.get("aggregated") or {}
+    out = []
+    for metric, spread in sorted(agg.items()):
+        if not metric.startswith("phase_"):
+            continue
+        median = float(spread.get("median", 0.0))
+        mx = float(spread.get("max", 0.0))
+        if (
+            mx >= STRAGGLER_FACTOR * max(median, 1e-9)
+            and mx - median >= STRAGGLER_MIN_DELTA_S
+        ):
+            out.append(
+                {
+                    "summary": (
+                        f"rank {spread.get('straggler')} is a straggler "
+                        f"for {metric}: {mx}s against a {median}s median"
+                    ),
+                    "evidence": {
+                        "metric": metric,
+                        "straggler_rank": spread.get("straggler"),
+                        "max_s": mx,
+                        "median_s": median,
+                        "threshold_factor": STRAGGLER_FACTOR,
+                    },
+                }
+            )
+    return out or None
+
+
+@doctor_rule(names.RULE_MIRROR_LAGGING)
+def _mirror_lagging(report: Dict[str, Any]):
+    mirror = report.get("mirror") or {}
+    lag = float(mirror.get("upload_lag_s", mirror.get("lag_s", 0.0)) or 0.0)
+    depth = int(mirror.get("snapshots_pending", 0) or 0)
+    if lag < MIRROR_LAG_S and depth < MIRROR_QUEUE_DEPTH:
+        return None
+    return {
+        "summary": (
+            "the durable-tier mirror is falling behind the take "
+            "cadence; durability trails the fast-tier commit"
+        ),
+        "evidence": {
+            "upload_lag_s": lag,
+            "snapshots_pending": depth,
+            "blobs_pending": mirror.get("blobs_pending", 0),
+            "threshold_lag_s": MIRROR_LAG_S,
+            "threshold_depth": MIRROR_QUEUE_DEPTH,
+        },
+    }
+
+
+@doctor_rule(names.RULE_RETRY_STORM)
+def _retry_storm(report: Dict[str, Any]):
+    retries = report.get("retries") or {}
+    attempts = float(retries.get("attempts", 0.0)) + float(
+        retries.get("gcs_recover_attempts", 0.0)
+    )
+    if attempts < RETRY_STORM_ATTEMPTS:
+        return None
+    return {
+        "summary": (
+            "storage retries clustered inside this op: the backend was "
+            "throwing transient errors while the checkpoint ran"
+        ),
+        "evidence": {
+            "attempts": attempts,
+            "backoff_s": retries.get("backoff_s", 0.0),
+            "exhausted": retries.get("exhausted", 0.0),
+            "threshold_attempts": RETRY_STORM_ATTEMPTS,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Evidence-scope rules
+# ---------------------------------------------------------------------------
+
+
+@doctor_rule(names.RULE_WRITE_TAIL_STALL, scope="evidence")
+def _write_tail_stall(ev: Evidence):
+    out = []
+    for tf, spans in sorted(ev.trace_spans.items()):
+        if not spans:
+            continue
+        op_ms = max(float(s.get("dur_ms", 0.0)) for s in spans)
+        writes = [
+            s
+            for s in spans
+            if s.get("name")
+            in (names.SPAN_STORAGE_WRITE, names.SPAN_MIRROR_BLOB)
+        ]
+        if not writes:
+            continue
+        worst = max(writes, key=lambda s: float(s.get("dur_ms", 0.0)))
+        worst_ms = float(worst.get("dur_ms", 0.0))
+        if worst_ms < TAIL_SPAN_MIN_MS or worst_ms < TAIL_SPAN_FRAC * op_ms:
+            continue
+        out.append(
+            {
+                "summary": (
+                    "a single blob write dominated the op: a stuck/slow "
+                    "write tail, not uniform slowness"
+                ),
+                "evidence": {
+                    "span": worst.get("name"),
+                    "blob": worst.get("blob", "?"),
+                    "span_ms": worst_ms,
+                    "op_ms": op_ms,
+                    "threshold_frac": TAIL_SPAN_FRAC,
+                },
+                "source": os.path.basename(tf),
+            }
+        )
+    return out or None
+
+
+@doctor_rule(names.RULE_WATCHDOG_STALLED, scope="evidence")
+def _watchdog_stalled(ev: Evidence):
+    out = []
+    for stall in ev.trace_stalls:
+        out.append(
+            {
+                "summary": (
+                    "the stall watchdog fired during this op; the trace "
+                    "names the culprit span"
+                ),
+                "evidence": {
+                    "span": stall.get("span"),
+                    "age_s": stall.get("age_s"),
+                    "idle_s": stall.get("idle_s"),
+                },
+                "source": os.path.basename(stall.get("file", "")),
+                "severity": "critical",
+            }
+        )
+    return out or None
+
+
+@doctor_rule(names.RULE_INTERRUPTED_TAKE, scope="evidence")
+def _interrupted_take(ev: Evidence):
+    import time as _time
+
+    out = []
+    for doc in ev.progress:
+        terminal = doc.get("terminal")
+        if terminal == "done":
+            continue
+        if terminal is None:
+            # Non-terminal heartbeat: a crash leftover only once it is
+            # STALE relative to the writer's own recorded cadence — a
+            # fresh one is a healthy op running right now (the live
+            # case the heartbeat exists to serve, not a finding). A
+            # heartbeat with no timestamp at all is treated as stale
+            # (nothing can refresh it).
+            updated = doc.get("updated_unix_ts")
+            if updated is not None:
+                interval = float(doc.get("interval_s") or 0.0)
+                stale_after = max(
+                    INTERRUPTED_STALE_INTERVALS * interval,
+                    INTERRUPTED_STALE_MIN_S,
+                )
+                if _time.time() - float(updated) < stale_after:
+                    continue
+        severity = "critical" if terminal is None else "warning"
+        what = (
+            "died mid-flight without settling (crash or preemption)"
+            if terminal is None
+            else f"ended {terminal}: {doc.get('error')}"
+        )
+        out.append(
+            {
+                "summary": (
+                    f"a {doc.get('kind', '?')} on rank "
+                    f"{doc.get('rank', '?')} {what}; its heartbeat shows "
+                    f"how far it got"
+                ),
+                "evidence": {
+                    "phase": doc.get("phase"),
+                    "written_bytes": doc.get("written_bytes"),
+                    "planned_bytes": doc.get("planned_bytes"),
+                    "items_done": doc.get("items_done"),
+                    "planned_items": doc.get("planned_items"),
+                },
+                "source": os.path.basename(doc.get("file", "")),
+                "severity": severity,
+            }
+        )
+    return out or None
+
+
+@doctor_rule(names.RULE_MIRROR_LAGGING, scope="evidence")
+def _mirror_lagging_live(ev: Evidence):
+    m = ev.mirror_state
+    if m is None:
+        return None
+    lag = float(m.get("upload_lag_s", 0.0))
+    depth = int(m.get("snapshots_pending", 0))
+    if lag < MIRROR_LAG_S and depth < MIRROR_QUEUE_DEPTH:
+        return None
+    return {
+        "summary": (
+            "the live process mirror is behind right now (queue state "
+            "at diagnosis time, not from a recorded report)"
+        ),
+        "evidence": {
+            "upload_lag_s": lag,
+            "snapshots_pending": depth,
+            "blobs_pending": m.get("blobs_pending", 0),
+            "threshold_lag_s": MIRROR_LAG_S,
+            "threshold_depth": MIRROR_QUEUE_DEPTH,
+        },
+        "source": "live-mirror",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+def diagnose_reports(reports: Sequence[Dict[str, Any]]) -> List[Verdict]:
+    """Run every report-scope rule over each report dict."""
+    verdicts: List[Verdict] = []
+    for report in reports:
+        src = f"{report.get('kind', '?')}@rank{report.get('rank', 0)}"
+        for rule in _REPORT_RULES:
+            try:
+                raw = rule.fn(report)
+            except Exception as e:  # noqa: BLE001 - a broken rule must not
+                # take down the diagnosis
+                logger.warning(
+                    "doctor: rule %s failed: %r", rule.rule_id, e
+                )
+                continue
+            for v in _as_verdicts(rule.rule_id, raw):
+                if not v.source:
+                    v.source = src
+                verdicts.append(v)
+    return verdicts
+
+
+def diagnose_evidence(ev: Evidence) -> List[Verdict]:
+    """Report-scope rules over the recorded reports plus evidence-scope
+    rules over the whole bundle, ranked most-severe first."""
+    verdicts = diagnose_reports(ev.reports)
+    for rule in _EVIDENCE_RULES:
+        try:
+            raw = rule.fn(ev)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("doctor: rule %s failed: %r", rule.rule_id, e)
+            continue
+        verdicts.extend(_as_verdicts(rule.rule_id, raw))
+    return rank_verdicts(verdicts)
+
+
+def diagnose_snapshot(snapshot_path: str) -> List[Verdict]:
+    """The library entry point ``fsck``/operators use: gather the
+    snapshot's artifacts, run every rule, return ranked verdicts."""
+    return diagnose_evidence(gather_evidence(snapshot_path))
+
+
+# ---------------------------------------------------------------------------
+# Bench-trial epistemics (shared with bench.py)
+# ---------------------------------------------------------------------------
+
+
+def bracket_stable(probe_a: float, probe_b: float) -> bool:
+    """Two temporally-adjacent link probes agree within the stability
+    factor (both positive). An unstable bracket means the link itself
+    moved; efficiency ratios over it carry no blame signal."""
+    lo, hi = min(probe_a, probe_b), max(probe_a, probe_b)
+    return lo > 0 and hi / lo <= UNSTABLE_BRACKET_FACTOR
+
+
+def probes_unstable(probes: Sequence[float]) -> bool:
+    """Any adjacent probe pair in the series disagrees beyond the
+    stability factor — the series-level ``link_unstable`` flag."""
+    return any(
+        not bracket_stable(a, b)
+        for a, b in zip(probes, probes[1:])
+        if min(a, b) > 0
+    )
+
+
+def diagnose_take_trial(
+    take_s: float,
+    gib: float,
+    probe_before_gbps: float,
+    probe_after_gbps: float,
+    phases: Optional[Dict[str, float]] = None,
+) -> List[Verdict]:
+    """Diagnose one bracketed take trial (bench.py's former private
+    ``in_take_stall`` / ``link_unstable`` internals). The bracket's max
+    is the tightest attainable-bandwidth estimate covering the trial's
+    window; a *stable* bracket with achieved/bracket below the stall
+    ratio means the slowdown happened inside the take."""
+    verdicts: List[Verdict] = []
+    bracket = max(probe_before_gbps, probe_after_gbps)
+    achieved = gib / take_s if take_s > 0 else 0.0
+    ratio = achieved / bracket if bracket > 0 else None
+    stable = bracket_stable(probe_before_gbps, probe_after_gbps)
+    if not stable:
+        verdicts.append(
+            Verdict(
+                rule=names.RULE_LINK_UNSTABLE,
+                summary=(
+                    "the bracketing probes disagree beyond the stability "
+                    "factor; the link moved during the trial window"
+                ),
+                evidence={
+                    "probe_before_gbps": round(probe_before_gbps, 3),
+                    "probe_after_gbps": round(probe_after_gbps, 3),
+                    "threshold_factor": UNSTABLE_BRACKET_FACTOR,
+                },
+                severity="info",
+            )
+        )
+    if stable and ratio is not None and ratio < STALL_EFFICIENCY_RATIO:
+        evidence: Dict[str, Any] = {
+            "take_s": round(take_s, 2),
+            "achieved_gbps": round(achieved, 3),
+            "bracket_gbps": round(bracket, 3),
+            "ratio": round(ratio, 3),
+            "threshold_ratio": STALL_EFFICIENCY_RATIO,
+        }
+        for phase in ("staging", "writing"):
+            if phases and phases.get(phase) is not None:
+                evidence[f"{phase}_done_s"] = phases[phase]
+        verdicts.append(
+            Verdict(
+                rule=names.RULE_IN_TAKE_STALL,
+                summary=(
+                    "achieved throughput fell below half of a stable "
+                    "attainable-bandwidth bracket: the slowdown happened "
+                    "inside the take"
+                ),
+                evidence=evidence,
+            )
+        )
+    return verdicts
+
+
+# ---------------------------------------------------------------------------
+# Trend diagnosis (history.py consumer)
+# ---------------------------------------------------------------------------
+
+
+def diagnose_trend(
+    records: List[Dict[str, Any]], window: int = 0
+) -> List[Verdict]:
+    """Trend verdicts over a manager's step history (oldest first)."""
+    from .history import TREND_WINDOW
+
+    rows = detect_trend_regressions(
+        records, window=window or TREND_WINDOW
+    )
+    verdicts = []
+    for row in rows:
+        step = row.get("step")
+        where = f"step {step}" if step is not None else f"record {row['index']}"
+        verdicts.append(
+            Verdict(
+                rule=names.RULE_TREND_REGRESSION,
+                summary=(
+                    f"{where} regressed on {row['metric']}: "
+                    f"{row['value']} against a rolling baseline median "
+                    f"of {row['baseline_median']}"
+                ),
+                evidence={
+                    k: v for k, v in row.items() if k not in ("path",)
+                },
+                source=str(row.get("path") or ""),
+            )
+        )
+    return rank_verdicts(verdicts)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _resolve_history_path(target: str) -> Optional[str]:
+    from .history import HISTORY_BASENAME, history_path_for
+
+    if os.path.isfile(target):
+        return target
+    if target.endswith(HISTORY_BASENAME):
+        return target
+    return history_path_for(target)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import json as _json
+
+    p = argparse.ArgumentParser(
+        prog="torchsnapshot_tpu.telemetry doctor",
+        description=(
+            "Diagnose a snapshot's recorded telemetry (reports, traces, "
+            "progress heartbeats) or a manager's step-history trend."
+        ),
+    )
+    p.add_argument(
+        "target",
+        help="snapshot path, or (with --trend) a manager root / "
+        ".telemetry-history.jsonl file",
+    )
+    p.add_argument(
+        "--trend",
+        action="store_true",
+        help="trend mode: flag per-step regressions against a rolling "
+        "median +/- MAD baseline",
+    )
+    p.add_argument(
+        "--window",
+        type=int,
+        default=0,
+        help="trend baseline window (default: history.TREND_WINDOW)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable verdict list instead of the text report",
+    )
+    args = p.parse_args(list(argv) if argv is not None else None)
+
+    if args.trend:
+        from .history import HISTORY_BASENAME, load_history
+
+        path = _resolve_history_path(args.target)
+        if path is None or not os.path.exists(path):
+            print(
+                f"doctor: no step history found for {args.target!r} "
+                f"(history records at <root>/{HISTORY_BASENAME}; "
+                f"enable with TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS > 0)"
+            )
+            return 1
+        records = load_history(path)
+        verdicts = diagnose_trend(records, window=args.window)
+        if args.json:
+            print(_json.dumps([v.to_dict() for v in verdicts], indent=1))
+        else:
+            print(
+                f"doctor trend: {len(records)} step record(s) in {path}"
+            )
+            if not verdicts:
+                print("no regressions against the rolling baseline")
+            for v in verdicts:
+                print(v.format())
+        return 0 if not verdicts else 2
+
+    verdicts = diagnose_snapshot(args.target)
+    if args.json:
+        print(_json.dumps([v.to_dict() for v in verdicts], indent=1))
+        return 0 if not verdicts else 2
+    print(f"doctor: {args.target}")
+    if not verdicts:
+        print(
+            "no findings (nothing recorded, or everything within "
+            "thresholds); record artifacts with "
+            "TORCHSNAPSHOT_TPU_TELEMETRY=1 / TORCHSNAPSHOT_TPU_TRACE=1"
+        )
+        return 0
+    for v in verdicts:
+        print(v.format())
+    return 2

@@ -121,3 +121,51 @@ INSTANT_RSS_PEAK = "rss:peak"
 
 # telemetry/watchdog.py: an open span outlived the stall deadline
 INSTANT_WATCHDOG_STALL = "watchdog:stall"
+
+# ---------------------------------------------------------------------------
+# Checkpoint-doctor verdict ids (telemetry/doctor.py).
+#
+# Same single-registration rule as the metrics and spans above, with a
+# kebab-case convention (``what-is-wrong``) so verdict ids read like
+# alert names. ``RULE_``-prefixed constants name diagnosis rules; the
+# snaplint ``doctor-rule-ids`` rule lints both halves: declared exactly
+# once here, kebab-case values, no string literals at
+# ``doctor_rule``/``Verdict`` emit sites.
+# ---------------------------------------------------------------------------
+
+# The take's wall clock is the staging (D2H + serialize) phase: the
+# device link, not storage, bounds the checkpoint.
+RULE_D2H_BOUND = "d2h-bound"
+# Requests spent a large fraction of the op blocked in
+# MemoryBudget.acquire: the host-memory budget, not I/O, is the limit.
+RULE_BUDGET_STARVED = "budget-starved"
+# Cross-rank aggregation shows one rank far beyond the median for a
+# phase: page that rank, not the storage team.
+RULE_STRAGGLER_RANK = "straggler-rank"
+# The write drain after staging dominates the take: the storage tier
+# (or its link) is the bottleneck.
+RULE_STORAGE_TIER_SLOW = "storage-tier-slow"
+# The background mirror's durability lag / queue depth is growing
+# faster than the take cadence drains it.
+RULE_MIRROR_LAGGING = "mirror-lagging"
+# One blob's write span dominates the op: a single stuck/slow write
+# tail, not uniform slowness.
+RULE_WRITE_TAIL_STALL = "write-tail-stall"
+# A non-terminal progress heartbeat was left behind: an op died
+# mid-flight (crash, preemption) without finishing.
+RULE_INTERRUPTED_TAKE = "interrupted-take"
+# The stall watchdog fired during this op (the trace carries the
+# culprit span).
+RULE_WATCHDOG_STALLED = "watchdog-stalled"
+# Storage retries during the op exceeded the storm threshold.
+RULE_RETRY_STORM = "retry-storm"
+# Bench-trial rules (bench.py's former private heuristics): the take's
+# achieved throughput fell below half of a *stable* bracketing probe
+# pair — the slowdown happened inside the take.
+RULE_IN_TAKE_STALL = "in-take-stall"
+# Adjacent link probes disagreed beyond the stability factor: the
+# link itself was moving; efficiency ratios are not trustworthy.
+RULE_LINK_UNSTABLE = "link-unstable"
+# Trend analysis: a step's metric sits beyond median + k*MAD of its
+# rolling baseline.
+RULE_TREND_REGRESSION = "trend-regression"

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 from typing import List, Optional
 
 from .. import knobs
@@ -34,6 +35,48 @@ logger: logging.Logger = logging.getLogger(__name__)
 
 EVENTS_BASENAME = "events.jsonl"
 SNAPSHOT_EVENTS_BASENAME = ".telemetry.jsonl"
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Atomic file publish (pid-suffixed tmp + rename, parent created):
+    a concurrent reader never observes a torn document. The one
+    implementation behind every telemetry artifact that gets rewritten
+    in place — the Prometheus textfile, trace exports, progress
+    heartbeats, the step history."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+# Last emitted report per (kind, snapshot path) — process-wide,
+# lock-guarded: the in-memory channel the manager's step-history
+# recorder reads — a save that just committed needs its own take
+# report without re-parsing the sink file (which may not even be
+# enabled). Keyed by path too: overlapping async saves (step N's
+# commit thread finishing after step N+1's) must each find THEIR
+# report, not whichever landed last.
+_LAST_REPORTS: dict = {}
+_LAST_REPORTS_LOCK = threading.Lock()
+
+
+def last_report(
+    *kinds: str, path: Optional[str] = None
+) -> Optional[SnapshotReport]:
+    """The most recent report emitted in this process among ``kinds``
+    (any kind when none given), optionally restricted to one snapshot
+    ``path``; None before a matching emission."""
+    with _LAST_REPORTS_LOCK:
+        candidates = [
+            r
+            for (k, p), r in _LAST_REPORTS.items()
+            if (not kinds or k in kinds) and (path is None or p == path)
+        ]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda r: r.unix_ts)
 
 
 def local_fs_root(url_path: Optional[str]) -> Optional[str]:
@@ -85,6 +128,13 @@ def emit_report(
 
         registry = metrics()
     registry.counter_inc(names.SNAPSHOT_REPORTS_TOTAL, kind=report.kind)
+    with _LAST_REPORTS_LOCK:
+        _LAST_REPORTS[(report.kind, report.path)] = report
+        # Bounded: retention keyed by arbitrary paths must not grow
+        # with an arbitrarily long run (one manager produces a new
+        # path per step).
+        while len(_LAST_REPORTS) > 64:
+            _LAST_REPORTS.pop(next(iter(_LAST_REPORTS)))
     path: Optional[str] = None
     try:
         path = events_path_for(report.path)
@@ -170,10 +220,4 @@ def write_prometheus_textfile(
         from . import metrics
 
         registry = metrics()
-    parent = os.path.dirname(path)
-    if parent:
-        os.makedirs(parent, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        f.write(render_prometheus(registry))
-    os.replace(tmp, path)
+    atomic_write_text(path, render_prometheus(registry))

@@ -10,6 +10,10 @@ CLI (also ``python -m torchsnapshot_tpu.telemetry`` and
     snapshot-stats <events.jsonl> [--kind take] [--path-contains step_]
     snapshot-stats trace <snapshot-dir>   # merge per-rank flight-recorder
                                           # traces (telemetry/trace.py)
+    snapshot-stats doctor <snapshot-dir>  # rule-based diagnosis
+                                          # (telemetry/doctor.py)
+    snapshot-stats trend <manager-root>   # per-step regression check
+                                          # (doctor --trend shorthand)
 
 Output: one row per (path, kind, rank) record — phase durations,
 bytes, throughput, budget wait, retries — followed by a per-tier
@@ -181,6 +185,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .trace import main as trace_main
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "doctor":
+        # ``python -m torchsnapshot_tpu.telemetry doctor <snapshot>``
+        # (and ``doctor --trend <root>``): rule-based diagnosis.
+        from .doctor import main as doctor_main
+
+        return doctor_main(argv[1:])
+    if argv and argv[0] == "trend":
+        # ``snapshot-stats trend <root>``: shorthand for doctor --trend.
+        from .doctor import main as doctor_main
+
+        return doctor_main(["--trend", *argv[1:]])
 
     p = argparse.ArgumentParser(
         prog="snapshot-stats",

@@ -441,13 +441,9 @@ def chrome_trace(
 def write_trace_file(path: str, doc: Dict[str, Any]) -> None:
     """Atomic write (tmp + rename): a concurrent reader/merger never
     sees a torn trace."""
-    parent = os.path.dirname(path)
-    if parent:
-        os.makedirs(parent, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f, separators=(",", ":"))
-    os.replace(tmp, path)
+    from .sink import atomic_write_text
+
+    atomic_write_text(path, json.dumps(doc, separators=(",", ":")))
 
 
 def trace_path_for(
@@ -610,13 +606,12 @@ def spans_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return spans
 
 
-def longest_spans(
-    trace_path: str, n: int = 3
+def longest_spans_from_doc(
+    doc: Dict[str, Any], n: int = 3
 ) -> List[Dict[str, Any]]:
-    """Top-``n`` longest spans of one trace file, for embedding in
-    stall diagnoses (bench.py): ``{"name", "dur_ms", "blob"?}``."""
-    with open(trace_path, "r", encoding="utf-8") as f:
-        doc = json.load(f)
+    """Top-``n`` longest spans of an already-loaded trace document —
+    for callers (the checkpoint doctor) that also scan the same doc for
+    other events and must not parse a multi-MB trace twice."""
     spans = sorted(spans_from_chrome(doc), key=lambda s: -s["dur_us"])
     out = []
     for s in spans[:n]:
@@ -626,6 +621,16 @@ def longest_spans(
             entry["blob"] = blob
         out.append(entry)
     return out
+
+
+def longest_spans(
+    trace_path: str, n: int = 3
+) -> List[Dict[str, Any]]:
+    """Top-``n`` longest spans of one trace file, for embedding in
+    stall diagnoses (bench.py): ``{"name", "dur_ms", "blob"?}``."""
+    with open(trace_path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    return longest_spans_from_doc(doc, n)
 
 
 def summarize_merged(doc: Dict[str, Any], top: int = 5) -> str:

@@ -71,6 +71,26 @@ def _thread_stacks() -> str:
     return "\n".join(chunks)
 
 
+def _progress_rows() -> List[str]:
+    """Compact per-op live-progress lines for the stall instant/log:
+    how far each active operation got when the process wedged."""
+    from .progress import current_progress
+
+    rows: List[str] = []
+    try:
+        for p in current_progress()[:8]:
+            rows.append(
+                f"{p['kind']} rank{p['rank']} {p['phase']}: "
+                f"{p['written_bytes']}/{p['planned_bytes']}B "
+                f"items {p['items_done']}/{p['planned_items']} "
+                f"(inflight {p['items_inflight']}, "
+                f"budget_wait {p['budget_wait_s']}s)"
+            )
+    except Exception as e:  # noqa: BLE001 - the stall report must land
+        rows.append(f"(progress unavailable: {e!r})")
+    return rows
+
+
 def _span_tree(open_spans: List[Dict]) -> str:
     """Open spans grouped per track, indented by begin order — the
     'what is the process inside right now' view."""
@@ -153,6 +173,10 @@ class StallWatchdog:
         # span: that's where the wall time is actually going.
         culprit = min(stalled, key=lambda s: s["age_s"])
         tree = _span_tree(open_spans)
+        # Live-progress snapshot of every active op: the stall report
+        # says how FAR each op got (bytes written vs planned, in-flight
+        # items), not just which spans are open.
+        progress_rows = _progress_rows()
         # count_as_progress=False: the stall marker itself must not
         # reset the idle clock and make the stall look resolved.
         self._recorder.instant(
@@ -166,6 +190,7 @@ class StallWatchdog:
             open_spans=[
                 f"{s['name']}@{s['age_s']}s" for s in open_spans[:16]
             ],
+            progress=progress_rows,
         )
         from . import metrics
 
@@ -173,12 +198,13 @@ class StallWatchdog:
         logger.error(
             "watchdog: span %r open for %.1fs with no recorder activity "
             "for %.1fs (deadline %.1fs); open-span tree:\n%s\n"
-            "thread stacks:\n%s",
+            "op progress:\n%s\nthread stacks:\n%s",
             culprit["name"],
             culprit["age_s"],
             idle_s,
             deadline_s,
             tree,
+            "\n".join(f"  {row}" for row in progress_rows) or "  (none)",
             _thread_stacks(),
         )
 

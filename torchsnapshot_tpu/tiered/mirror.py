@@ -555,6 +555,19 @@ def get_mirror() -> Mirror:
         return _default_mirror
 
 
+def mirror_state_for_path(path_url: str) -> Optional[Dict[str, float]]:
+    """The process mirror's queue/lag state when ``path_url`` is
+    tiered, else None — the ONE tiered-path-detection + metrics-read
+    used by snapshot reports, progress heartbeats, and the checkpoint
+    doctor (three consumers, one implementation)."""
+    try:
+        if split_tiered_url(path_url) is None:
+            return None
+    except ValueError:
+        return None
+    return dict(get_mirror().metrics())
+
+
 def reset_mirror() -> None:
     """Stop and discard the process-wide mirror (tests simulating a
     process restart)."""
