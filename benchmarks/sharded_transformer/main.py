@@ -83,13 +83,16 @@ def main() -> None:
         if args.async_take:
             pending = ts.Snapshot.async_take(path, {"train": ts.PyTreeState(tree)})
             blocked = time.perf_counter() - t0
+            pending.wait(phase="staged")
+            staged = time.perf_counter() - t0
             pending.wait()
             total = time.perf_counter() - t0
             say(
-                f"async save: blocked {blocked:.3f}s, total {total:.2f}s "
-                f"({nbytes / (1 << 30) / total:.2f} GB/s)"
+                f"async save: blocked {blocked:.3f}s, staged {staged:.2f}s, "
+                f"total {total:.2f}s ({nbytes / (1 << 30) / total:.2f} GB/s)"
             )
             record["stall_ms"] = round(blocked * 1000, 1)
+            record["staged_ms"] = round(staged * 1000, 1)
             record["save_total_s"] = round(total, 2)
         else:
             ts.Snapshot.take(path, {"train": ts.PyTreeState(tree)})

@@ -233,6 +233,238 @@ def test_sync_take_peer_failure_fails_fast_no_commit(pg) -> None:
     assert not os.path.exists(os.path.join(path, SNAPSHOT_METADATA_FNAME))
 
 
+# ---------------------------------------------------------------------------
+# Device-snapshot deferral (round 6): size-independent visible span,
+# wait(phase=), mutation-after-return, drain-failure semantics.
+# ---------------------------------------------------------------------------
+
+
+def _sleepy_stage(delay_s: float):
+    """Patch ArrayBufferStager's staging kernel to sleep first — makes
+    'did staging run inside async_take?' observable on a fast CPU."""
+    from torchsnapshot_tpu.io_preparer import ArrayBufferStager
+
+    orig = ArrayBufferStager._stage_sync_impl
+
+    def slow(self):
+        time.sleep(delay_s)
+        return orig(self)
+
+    return mock.patch.object(ArrayBufferStager, "_stage_sync_impl", slow)
+
+
+def test_async_take_returns_before_staging(tmp_path) -> None:
+    """The device-snapshot default: async_take returns after capture
+    dispatch; the (deliberately slow) staging runs on the background
+    drain, observable at wait(phase="staged")."""
+    app_state = {"p": ts.PyTreeState({"w": jnp.arange(512.0)})}
+    with _sleepy_stage(0.4):
+        t0 = time.monotonic()
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        returned_at = time.monotonic() - t0
+        assert returned_at < 0.4, "staging ran inside the visible span"
+        assert pending.wait(phase="staged") is None
+        assert pending.staged()
+        snapshot = pending.wait()
+    fresh = {"p": ts.PyTreeState({"w": jnp.zeros(512)})}
+    snapshot.restore(fresh)
+    np.testing.assert_array_equal(
+        np.asarray(fresh["p"].tree["w"]), np.arange(512.0)
+    )
+
+
+def test_async_take_device_snapshot_disabled_stages_before_return(
+    tmp_path,
+) -> None:
+    """The kill-switch restores the pre-deferral contract: staging
+    completes before async_take returns."""
+    from torchsnapshot_tpu import knobs
+
+    app_state = {"p": ts.PyTreeState({"w": jnp.arange(64.0)})}
+    with knobs.disable_async_device_snapshot(), _sleepy_stage(0.3):
+        t0 = time.monotonic()
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        returned_at = time.monotonic() - t0
+        assert returned_at >= 0.3, "staging was deferred despite the knob"
+        assert pending.staged()  # staged at construction
+        pending.wait()
+
+
+def test_async_take_wait_phase_validation_and_ordering(tmp_path) -> None:
+    """wait(phase="staged") precedes the commit marker (storage writes
+    still draining); wait() produces it; bogus phases are rejected."""
+    with _patch_plugin(SlowFSStoragePlugin):
+        app_state = {"p": ts.PyTreeState({"w": jnp.ones(64)})}
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        with pytest.raises(ValueError, match="staged"):
+            pending.wait(phase="flushed")
+        assert pending.wait(phase="staged") is None
+        # Staged is the D2H boundary, not the commit: the slow writes
+        # (>= DELAY_S each) are still draining behind it.
+        assert not os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+        snapshot = pending.wait(phase="committed")
+        assert snapshot is not None
+    assert os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+
+
+@pytest.mark.parametrize(
+    "shape", [(64,), (513, 257), (128, 1024)], ids=["tiny", "odd", "wide"]
+)
+def test_async_take_mutation_after_return_roundtrip(tmp_path, shape) -> None:
+    """Train-step-style in-place donation/update of the live arrays
+    immediately after async_take returns must not corrupt the restored
+    bytes (the on-device clone is the consistency point)."""
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    original = jax.random.normal(key, shape, dtype=jnp.float32)
+    expected = np.array(np.asarray(original))  # pre-mutation truth
+    counter = np.arange(8.0)  # mutable host leaf
+    app_state = {
+        "p": ts.PyTreeState({"w": original}),
+        "s": ts.StateDict(counter=counter),
+    }
+    pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+    # Donation-shaped mutation the moment control returns: the donated
+    # buffer may be reused by XLA for the output; the numpy leaf is
+    # overwritten in place.
+    donate = jax.jit(lambda x: x * -2.0 + 1.0, donate_argnums=0)
+    clobbered = donate(original)
+    jax.block_until_ready(clobbered)
+    del original
+    counter[:] = -1.0
+    snapshot = pending.wait()
+    fresh = {
+        "p": ts.PyTreeState({"w": jnp.zeros(shape, jnp.float32)}),
+        "s": ts.StateDict(counter=np.zeros(8)),
+    }
+    snapshot.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh["p"].tree["w"]), expected)
+    np.testing.assert_array_equal(fresh["s"]["counter"], np.arange(8.0))
+
+
+def test_async_take_mutation_after_return_incremental(tmp_path) -> None:
+    """The incremental variant: unchanged chunks reference the base (no
+    clone, no write), changed chunks are captured — mutation after
+    return corrupts neither."""
+    import jax
+
+    from torchsnapshot_tpu import knobs
+
+    base_w = jnp.arange(4096.0)
+    base_path = str(tmp_path / "base")
+    with knobs.override_incremental_chunk_size_bytes(4096):
+        ts.Snapshot.take(
+            base_path,
+            {"p": ts.PyTreeState({"w": base_w})},
+            record_digests=True,
+        )
+        # Change one region; the rest of the chunks match the base.
+        changed = base_w.at[:512].set(-3.0)
+        expected = np.array(np.asarray(changed))
+        pending = ts.Snapshot.async_take(
+            str(tmp_path / "incr"),
+            {"p": ts.PyTreeState({"w": changed})},
+            incremental_base=base_path,
+        )
+        donate = jax.jit(lambda x: x * 0.0, donate_argnums=0)
+        jax.block_until_ready(donate(changed))
+        del changed
+        snapshot = pending.wait()
+    fresh = {"p": ts.PyTreeState({"w": jnp.zeros(4096)})}
+    snapshot.restore(fresh)
+    np.testing.assert_array_equal(np.asarray(fresh["p"].tree["w"]), expected)
+
+
+def test_async_take_drain_failure_surfaces_on_wait_heartbeat_terminal(
+    tmp_path,
+) -> None:
+    """A background-drain failure AFTER async_take returned must (a)
+    surface on wait() — once recorded, every wait observes the same
+    error, staged and committed alike — and (b) settle the progress
+    heartbeat TERMINAL ("failed"), never a crash-shaped non-terminal
+    leftover the doctor would misread as interrupted-take."""
+    import json
+
+    from torchsnapshot_tpu import knobs
+
+    fail_after = [0]
+
+    def should_fail(path: str) -> bool:
+        # Let a couple of writes through so the failure lands mid-drain.
+        if path == SNAPSHOT_METADATA_FNAME:
+            return False
+        fail_after[0] += 1
+        return fail_after[0] > 2
+
+    plugin_cls = faulty_fs_plugin(should_fail, delay_s=0.02)
+    state = {
+        f"w{i}": jnp.full((256,), float(i)) for i in range(8)
+    }
+    with knobs.override_progress_interval_seconds(0.01), _patch_plugin(
+        plugin_cls
+    ):
+        pending = ts.Snapshot.async_take(
+            str(tmp_path), {"p": ts.PyTreeState(state)}
+        )
+        with pytest.raises(OSError, match="injected storage failure") as e1:
+            pending.wait()
+        # Idempotent re-raise: the SAME recorded failure, both phases.
+        with pytest.raises(OSError) as e2:
+            pending.wait()
+        with pytest.raises(OSError):
+            pending.wait(phase="staged")
+        assert e2.value is e1.value
+    assert not os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+    heartbeat = tmp_path / ".progress-rank0.json"
+    assert heartbeat.exists(), "failed op must leave a terminal heartbeat"
+    doc = json.loads(heartbeat.read_text())
+    assert doc["terminal"] == "failed"
+    assert "injected storage failure" in (doc["error"] or "")
+
+
+def test_async_take_staging_failure_unblocks_staged_wait(tmp_path) -> None:
+    """A failure BEFORE the staged boundary must not strand
+    wait(phase="staged"): the drain settles and the wait raises."""
+    from torchsnapshot_tpu.io_preparer import ArrayBufferStager
+
+    def boom(self):
+        raise RuntimeError("injected staging failure")
+
+    app_state = {"p": ts.PyTreeState({"w": jnp.ones(256)})}
+    with mock.patch.object(ArrayBufferStager, "_stage_sync_impl", boom):
+        pending = ts.Snapshot.async_take(str(tmp_path), app_state)
+        with pytest.raises(RuntimeError, match="injected staging failure"):
+            pending.wait(phase="staged")
+        with pytest.raises(RuntimeError, match="injected staging failure"):
+            pending.wait()
+    assert not os.path.exists(tmp_path / SNAPSHOT_METADATA_FNAME)
+
+
+def test_async_take_visible_staged_split_in_report(tmp_path) -> None:
+    """The emitted async_take SnapshotReport carries the visible/staged
+    phase split (the doctor's async-visible-stall evidence)."""
+    from torchsnapshot_tpu import knobs, telemetry
+
+    with knobs.enable_telemetry():
+        pending = ts.Snapshot.async_take(
+            str(tmp_path), {"p": ts.PyTreeState({"w": jnp.ones(512)})}
+        )
+        pending.wait()
+        events_path = telemetry.events_path_for(str(tmp_path))
+    events = telemetry.load_events(events_path)
+    reports = [e for e in events if e.get("kind") == "async_take"]
+    assert reports, "async_take must emit a report"
+    report = reports[-1]
+    assert report["visible_s"] is not None and report["visible_s"] >= 0
+    assert report["staged_s"] is not None
+    assert report["staged_s"] >= report["visible_s"]
+    # The pool geometry that bounded the drain rides along (the context
+    # for reading peak_staged_bytes on a pool-bounded pipeline).
+    assert report["staging_pool"]["slabs"] >= 1
+    assert report["staging_pool"]["capacity_bytes"] >= 1
+
+
 @multiprocess_test(nproc=2)
 def test_async_take_distributed_commit(pg) -> None:
     import jax.numpy as jnp

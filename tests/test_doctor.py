@@ -136,6 +136,81 @@ def test_straggler_rank_names_the_rank():
     assert verdicts[0].evidence["metric"] == "phase_writing_s"
 
 
+def test_async_visible_stall_rule(tmp_path):
+    """The async-visible-stall rule: fires on an async_take whose
+    visible span exceeds the knob budget, citing stage-span evidence;
+    silent for fast takes, other kinds, missing fields, and a disabled
+    (<= 0) budget."""
+    from torchsnapshot_tpu import knobs
+
+    regressed = _report(
+        kind="async_take",
+        visible_s=99.7,
+        staged_s=99.8,
+        phases={"staging": 99.6, "writing": 101.1},
+    )
+    healthy = _report(
+        kind="async_take",
+        visible_s=0.02,
+        staged_s=1.4,
+        phases={"staging": 1.4, "writing": 2.0},
+    )
+    sync_take = _report(kind="take", visible_s=None)
+    legacy = _report(kind="async_take")  # pre-round-6 report: no field
+    assert names.RULE_ASYNC_VISIBLE_STALL in _rules_for([regressed])
+    assert names.RULE_ASYNC_VISIBLE_STALL not in _rules_for([healthy])
+    assert names.RULE_ASYNC_VISIBLE_STALL not in _rules_for([sync_take])
+    assert names.RULE_ASYNC_VISIBLE_STALL not in _rules_for([legacy])
+    verdict = [
+        v
+        for v in doctor.diagnose_reports([regressed])
+        if v.rule == names.RULE_ASYNC_VISIBLE_STALL
+    ][0]
+    assert verdict.evidence["visible_s"] == 99.7
+    assert verdict.evidence["staging_s"] == 99.6
+    assert verdict.evidence["budget_s"] == 5.0
+    with knobs.override_async_visible_budget_seconds(0.0):
+        assert names.RULE_ASYNC_VISIBLE_STALL not in _rules_for([regressed])
+    with knobs.override_async_visible_budget_seconds(0.01):
+        assert names.RULE_ASYNC_VISIBLE_STALL in _rules_for([healthy])
+
+
+def test_async_visible_stall_end_to_end(tmp_path):
+    """diagnose_snapshot over a real recorded async take: the
+    device-snapshot default stays under the budget (no verdict); an
+    injected synchronous-staging regression (deferral knob off + a
+    sub-visible budget) makes the same diagnosis fire."""
+    import jax.numpy as jnp
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu import knobs
+
+    state = {"w": jnp.ones((256, 64))}
+    with knobs.enable_telemetry():
+        pending = ts.Snapshot.async_take(
+            str(tmp_path / "ok"), {"p": ts.PyTreeState(state)}
+        )
+        pending.wait()
+    rules = {v.rule for v in doctor.diagnose_snapshot(str(tmp_path / "ok"))}
+    assert names.RULE_ASYNC_VISIBLE_STALL not in rules
+
+    # Regression injection: staging back in the visible span, budget
+    # below any real visible time.
+    with knobs.enable_telemetry(), knobs.disable_async_device_snapshot(), (
+        knobs.override_async_visible_budget_seconds(1e-9)
+    ):
+        pending = ts.Snapshot.async_take(
+            str(tmp_path / "bad"), {"p": ts.PyTreeState(state)}
+        )
+        pending.wait()
+        verdicts = doctor.diagnose_snapshot(str(tmp_path / "bad"))
+    fired = [
+        v for v in verdicts if v.rule == names.RULE_ASYNC_VISIBLE_STALL
+    ]
+    assert fired, f"expected async-visible-stall, got {verdicts}"
+    assert fired[0].evidence["visible_s"] > 0
+
+
 def test_mirror_lagging_and_retry_storm_thresholds():
     lagging = _report(
         mirror={"upload_lag_s": 120.0, "snapshots_pending": 1},

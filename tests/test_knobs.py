@@ -108,6 +108,41 @@ def test_progress_knobs() -> None:
     assert knobs.get_progress_dir() is None
 
 
+def test_async_device_snapshot_knob() -> None:
+    """Device-snapshot deferral is the DEFAULT async story; only an
+    explicit "0" opts back into staging-before-return."""
+    assert knobs.is_async_device_snapshot_enabled()
+    with knobs.disable_async_device_snapshot():
+        assert not knobs.is_async_device_snapshot_enabled()
+    assert knobs.is_async_device_snapshot_enabled()
+    os.environ["TORCHSNAPSHOT_TPU_ASYNC_DEVICE_SNAPSHOT"] = "1"
+    try:
+        assert knobs.is_async_device_snapshot_enabled()
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_ASYNC_DEVICE_SNAPSHOT"]
+
+
+def test_staging_pool_knobs() -> None:
+    assert knobs.get_staging_pool_slab_bytes() == 128 * 1024 * 1024
+    assert knobs.get_staging_pool_slabs() == 2
+    with knobs.override_staging_pool_slab_bytes(4096):
+        assert knobs.get_staging_pool_slab_bytes() == 4096
+    with knobs.override_staging_pool_slabs(3):
+        assert knobs.get_staging_pool_slabs() == 3
+    assert knobs.get_staging_pool_slab_bytes() == 128 * 1024 * 1024
+    assert knobs.get_staging_pool_slabs() == 2
+
+
+def test_async_visible_budget_knob() -> None:
+    assert knobs.get_async_visible_budget_seconds() == 5.0
+    with knobs.override_async_visible_budget_seconds(0.25):
+        assert knobs.get_async_visible_budget_seconds() == 0.25
+    with knobs.override_async_visible_budget_seconds(0):
+        # <= 0 disables the doctor rule; the getter reports it raw.
+        assert knobs.get_async_visible_budget_seconds() == 0.0
+    assert knobs.get_async_visible_budget_seconds() == 5.0
+
+
 def test_history_max_records_knob() -> None:
     assert knobs.get_history_max_records() == 0  # conftest zeroes it
     with knobs.override_history_max_records(7):

@@ -67,6 +67,24 @@ def test_async_save_commits_on_wait(tmp_path) -> None:
     assert mgr.restore_latest(dst) == 6
 
 
+def test_async_save_staged_wait_does_not_index(tmp_path) -> None:
+    """wait(phase="staged") observes D2H completion only: the step must
+    not enter the index (a half-drained step must never be visible to
+    restore_latest); the committed wait indexes it exactly once."""
+    mgr = ts.CheckpointManager(str(tmp_path))
+    pending = mgr.async_save(3, _state(3.0))
+    assert pending.wait(phase="staged") is None
+    assert pending.staged()
+    assert 3 not in mgr.all_steps()
+    # A typo'd phase must not silently become a committed wait with
+    # index/retention side effects (same contract as PendingSnapshot).
+    with pytest.raises(ValueError, match="staged"):
+        pending.wait(phase="stagd")
+    snapshot = pending.wait()
+    assert snapshot is not None
+    assert mgr.all_steps() == [3]
+
+
 def test_uncommitted_step_invisible(tmp_path) -> None:
     """A step directory without a commit marker (crashed take) must never
     appear in the index or be restored."""

@@ -152,6 +152,40 @@ async def bounded(fut):
     assert _run(tmp_path, source, "async-blocking-call").new_findings == []
 
 
+# The round-6 background-drain bug class: threading primitives (staged
+# Events, the commit thread) living right next to the drain's
+# coroutines — a non-awaited .wait()/.join() inside one either blocks
+# the loop (threading) or silently drops a coroutine (asyncio).
+_ASYNC_WAIT_BAD = """
+async def drain(staged_event, commit_thread):
+    staged_event.wait()
+    commit_thread.join()
+"""
+
+_ASYNC_WAIT_FIXED = """
+import asyncio
+import os
+
+async def drain(staged_event, commit_thread, loop, executor):
+    await staged_event.wait()
+    await loop.run_in_executor(executor, commit_thread.join)
+    # String building and path building are not synchronization:
+    label = ", ".join(["a", "b"])
+    path = os.path.join("/tmp", "x")
+    return label, path
+"""
+
+
+def test_async_rule_flags_non_awaited_wait_and_join(tmp_path):
+    bad = _run(tmp_path, _ASYNC_WAIT_BAD, "async-blocking-call")
+    msgs = _messages(bad)
+    assert len(bad.new_findings) == 2
+    assert any(".wait()" in m for m in msgs)
+    assert any(".join()" in m for m in msgs)
+    fixed = _run(tmp_path, _ASYNC_WAIT_FIXED, "async-blocking-call")
+    assert fixed.new_findings == []
+
+
 # ---------------------------------------------------------------------------
 # span-and-budget-balance
 # ---------------------------------------------------------------------------

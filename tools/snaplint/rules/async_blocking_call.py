@@ -12,7 +12,15 @@ and unlike a slow await, nothing else runs meanwhile. Flagged inside
   the loop thread; executor hops must be awaited via
   ``run_in_executor``),
 - ``subprocess.run/call/check_call/check_output`` (block until the
-  child exits).
+  child exits),
+- non-awaited ``.wait()`` / ``.join()`` (the background-drain bug
+  class: device-snapshot async takes put threading primitives —
+  staged/done Events, the commit thread — right next to the drain's
+  coroutines, and a ``threading.Event.wait()`` or ``Thread.join()``
+  inside one freezes the whole pipeline; a non-awaited
+  ``asyncio.Event().wait()`` is a silently-dropped coroutine, the same
+  bug in different clothes). ``"sep".join(...)`` / f-string receivers
+  and ``os.path.join`` are excluded.
 
 A sync helper *defined* inside an async function is not flagged — the
 repo pattern is to hand those to an executor.
@@ -88,6 +96,26 @@ class AsyncBlockingCall(Rule):
                 reason = (
                     f"subprocess.{chain[1]}() blocks until the child "
                     f"exits; use an executor or asyncio.subprocess"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("wait", "join")
+                and not isinstance(parents.get(node), ast.Await)
+                # str.join: a literal/f-string separator is string
+                # building, not synchronization. (A *variable* string
+                # separator can't be told apart statically; suppress
+                # with a disable comment in that rare shape.)
+                and not isinstance(
+                    node.func.value, (ast.Constant, ast.JoinedStr)
+                )
+                # os.path.join / posixpath.join: path building.
+                and not (chain and chain[0] in ("os", "posixpath", "ntpath"))
+            ):
+                reason = (
+                    f"non-awaited .{node.func.attr}() inside a coroutine "
+                    f"either blocks the event loop (threading "
+                    f"Event/Thread) or drops an asyncio wait entirely; "
+                    f"await the asyncio form or run_in_executor"
                 )
             if reason is not None:
                 yield Finding(

@@ -120,6 +120,17 @@ class BatchedBufferStager(BufferStager):
         )
         self._staging_cost = self.total + pack_bytes + peak_member
 
+    def capture(self, cache: dict) -> None:
+        """Device-snapshot capture recurses into the slab's members:
+        each member stager pins its own source (shared ``cache``, so a
+        leaf split across slabs still snapshots once). The group split
+        computed at construction still holds — jax members clone to jax
+        arrays on the same devices, so pack eligibility is unchanged
+        (and the pack path degrades to sequential staging on any
+        surprise, as it always has)."""
+        for req, _, _ in self.members:
+            req.buffer_stager.capture(cache)
+
     # Per-dispatch member cap: an N-ary concat program's trace/compile
     # time grows with N, and one compile per distinct slab layout must
     # stay cheap.

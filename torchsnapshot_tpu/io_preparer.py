@@ -128,7 +128,20 @@ class ArrayBufferStager(BufferStager):
         self.is_async_snapshot = is_async_snapshot
         self.slc = slc
         self.array_prepare_func = array_prepare_func
-        if is_jax_array(arr) and slc is None and not self._may_device_pack():
+        # Whether capture() already pinned a consistent copy of a
+        # mutable (numpy) source — staging must not copy it again.
+        self._captured = False
+        # Device-snapshot async takes skip the D2H prefetch on purpose:
+        # capture() pins an ON-DEVICE clone instead, and the background
+        # drain's staging pool is what bounds host memory — an eager
+        # whole-state prefetch here would fill jax's host-copy cache
+        # with the entire checkpoint outside the pool's accounting.
+        if (
+            is_jax_array(arr)
+            and slc is None
+            and not (is_async_snapshot and knobs.is_async_device_snapshot_enabled())
+            and not self._may_device_pack()
+        ):
             try:
                 arr.copy_to_host_async()
             except Exception:
@@ -156,6 +169,49 @@ class ArrayBufferStager(BufferStager):
         return (
             self.get_staging_cost_bytes() < knobs.get_slab_size_threshold_bytes()
         )
+
+    def capture(self, cache: dict) -> None:
+        """Device-snapshot capture (the deferred-staging async take's
+        pre-return consistency point):
+
+        - jax leaves get an on-device clone — dispatched asynchronously,
+          so the visible cost is the dispatch, not the copy — making the
+          snapshot immune to the application donating (or deleting) the
+          live buffers after ``async_take`` returns;
+        - mutable numpy leaves get the defensive host copy that staging
+          would otherwise have made (staging now runs after control
+          returned to training, too late to be a consistency point);
+        - either way the copy is made once per underlying array
+          (``cache``), however many chunk/shard stagers slice it.
+
+        A jax clone that fails (e.g. a multi-process array this process
+        cannot re-materialize on device) falls back to an eager HOST
+        snapshot of the bytes — slower (it pays the D2H in the visible
+        span, for that leaf only) but never inconsistent."""
+        arr = self.arr
+        if arr is None:
+            return
+        key = id(arr)
+        if key in cache:
+            self.arr = cache[key]
+            self._captured = True
+            return
+        if is_jax_array(arr):
+            try:
+                import jax.numpy as jnp
+
+                snap = jnp.copy(arr)
+            except Exception:  # noqa: BLE001 - host fallback, never torn
+                snap = np.ascontiguousarray(np.asarray(arr))
+        elif isinstance(arr, np.ndarray):
+            snap = np.array(arr, order="C", copy=True)
+        else:
+            # Exotic array-like: materialize through numpy now — the
+            # generic consistency fallback.
+            snap = np.array(np.asarray(arr), order="C", copy=True)
+        cache[key] = snap
+        self.arr = snap
+        self._captured = True
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         # Tiny host-resident leaves (torchrec-style 1e5-leaf manifests are
@@ -191,9 +247,11 @@ class ArrayBufferStager(BufferStager):
             host = np.ascontiguousarray(host)
         else:
             host = np.asarray(arr)
-            if self.is_async_snapshot:
+            if self.is_async_snapshot and not self._captured:
                 # Mutable leaf: snapshot a consistent copy before returning
                 # control to training (reference io_preparer.py:555-565).
+                # A captured source was already copied at async_take time
+                # (device-snapshot mode) and nothing mutates it now.
                 host = np.array(host, order="C", copy=True)
             else:
                 host = np.ascontiguousarray(host)
@@ -545,14 +603,29 @@ class ChunkedArrayIOPreparer:
 class ObjectBufferStager(BufferStager):
     def __init__(self, obj: Any) -> None:
         self.obj = obj
+        self._buf: Optional[bytes] = None
+
+    def capture(self, cache: dict) -> None:
+        """Objects are snapshotted by pickling them NOW: deferred
+        staging would otherwise serialize a mutable object (a metrics
+        dict, a dataloader state) after training resumed mutating it.
+        Objects are metadata-sized in practice; the pickle cost sits in
+        the visible span by design — consistency over latency here."""
+        if self._buf is None:
+            self._buf = pickle_save_as_bytes(self.obj)
+            self.obj = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        if self._buf is not None:
+            return self._buf
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             executor, pickle_save_as_bytes, self.obj
         )
 
     def get_staging_cost_bytes(self) -> int:
+        if self._buf is not None:
+            return len(self._buf)
         return sys.getsizeof(self.obj)
 
 
@@ -668,6 +741,20 @@ def prepare_write(
             array_prepare_func, incremental=incremental,
         )
     return ObjectIOPreparer.prepare_write(obj, logical_path, rank, replicated)
+
+
+def capture_write_reqs(write_reqs: List[WriteReq]) -> int:
+    """Device-snapshot capture pass over a take's write plan: every
+    stager pins a consistent copy of its source (``BufferStager.capture``
+    — on-device clones for jax leaves, host copies for mutable numpy
+    leaves, eager pickles for objects) so ``async_take`` may return
+    before any staging ran. One shared cache keyed by the source
+    object: a leaf sliced into many chunk/shard stagers is snapshotted
+    once. Returns the number of distinct sources captured."""
+    cache: dict = {}
+    for req in write_reqs:
+        req.buffer_stager.capture(cache)
+    return len(cache)
 
 
 def prepare_read(

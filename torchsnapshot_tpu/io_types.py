@@ -58,6 +58,17 @@ class BufferStager(abc.ABC):
     @abc.abstractmethod
     def get_staging_cost_bytes(self) -> int: ...
 
+    def capture(self, cache: dict) -> None:
+        """Pin a consistent snapshot of this stager's source *before*
+        ``async_take`` returns, so the application may mutate (or
+        donate) the live state while staging runs on the background
+        drain. ``cache`` is shared across one take's stagers, keyed by
+        ``id(source)``, so several stagers over one leaf (chunked
+        writes, shard pieces) snapshot it once. Default: no-op —
+        stagers whose source cannot change under them (or that stage
+        before the take returns) need nothing."""
+        return None
+
 
 class BufferConsumer(abc.ABC):
     @abc.abstractmethod

@@ -42,12 +42,20 @@ _WAIT_DURABLE_TIMEOUT_ENV = "TORCHSNAPSHOT_TPU_WAIT_DURABLE_TIMEOUT_SECONDS"
 _PROGRESS_SECONDS_ENV = "TORCHSNAPSHOT_TPU_PROGRESS_SECONDS"
 _PROGRESS_DIR_ENV = "TORCHSNAPSHOT_TPU_PROGRESS_DIR"
 _HISTORY_MAX_RECORDS_ENV = "TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS"
+_ASYNC_DEVICE_SNAPSHOT_ENV = "TORCHSNAPSHOT_TPU_ASYNC_DEVICE_SNAPSHOT"
+_STAGING_POOL_SLAB_BYTES_ENV = "TORCHSNAPSHOT_TPU_STAGING_POOL_SLAB_BYTES"
+_STAGING_POOL_SLABS_ENV = "TORCHSNAPSHOT_TPU_STAGING_POOL_SLABS"
+_ASYNC_VISIBLE_BUDGET_ENV = "TORCHSNAPSHOT_TPU_ASYNC_VISIBLE_BUDGET_SECONDS"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
 _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS: float = 1800.0
 _DEFAULT_PROGRESS_SECONDS: float = 1.0
 _DEFAULT_HISTORY_MAX_RECORDS: int = 512
+
+_DEFAULT_STAGING_POOL_SLAB_BYTES: int = 128 * 1024 * 1024
+_DEFAULT_STAGING_POOL_SLABS: int = 2
+_DEFAULT_ASYNC_VISIBLE_BUDGET_SECONDS: float = 5.0
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
@@ -273,6 +281,50 @@ def get_history_max_records() -> int:
     return _DEFAULT_HISTORY_MAX_RECORDS
 
 
+def is_async_device_snapshot_enabled() -> bool:
+    """Default-on device-snapshot async takes: ``async_take`` pins a
+    consistent snapshot before returning (on-device clones for jax
+    leaves — dispatched, not awaited; host copies for mutable numpy
+    leaves; eager pickles for objects) and defers the whole D2H +
+    serialize + write pipeline to the background commit thread, so the
+    training-visible span is independent of checkpoint size. Costs a
+    transient ~1x copy of the saved device state in HBM. Set to ``"0"``
+    to restore the pre-deferral behavior (staging completes before
+    ``async_take`` returns; no device clone, no extra HBM)."""
+    return os.environ.get(_ASYNC_DEVICE_SNAPSHOT_ENV, "1") != "0"
+
+
+def get_staging_pool_slab_bytes() -> int:
+    """Slab size of the background drain's host staging pool
+    (scheduler.StagingPool). Together with the slab count this bounds
+    the deferred async take's host staging footprint; the pool never
+    exceeds the process memory budget it is accounted against."""
+    return _get_int_env(
+        _STAGING_POOL_SLAB_BYTES_ENV, _DEFAULT_STAGING_POOL_SLAB_BYTES
+    )
+
+
+def get_staging_pool_slabs() -> int:
+    """Slab count of the background drain's host staging pool. The
+    default of 2 is classic double buffering: one slab's worth of
+    requests stages (D2H + serialize) while the previous slab's worth
+    drains to storage."""
+    return _get_int_env(_STAGING_POOL_SLABS_ENV, _DEFAULT_STAGING_POOL_SLABS)
+
+
+def get_async_visible_budget_seconds() -> float:
+    """Threshold for the checkpoint doctor's ``async-visible-stall``
+    rule: an async take whose training-visible span (``async_take``
+    return-to-caller time, recorded as ``visible_s`` in its
+    SnapshotReport) exceeds this budget is flagged — with device
+    snapshotting on, the visible span should be plan + capture dispatch,
+    never the D2H drain. <= 0 disables the rule."""
+    val = os.environ.get(_ASYNC_VISIBLE_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_ASYNC_VISIBLE_BUDGET_SECONDS
+
+
 def get_prometheus_textfile() -> Optional[str]:
     """Prometheus text-exposition file, rewritten (atomically) after
     every report emission — the node-exporter textfile-collector
@@ -439,6 +491,32 @@ def override_progress_dir(path: str) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_history_max_records(n: int) -> Generator[None, None, None]:
     with _override_env(_HISTORY_MAX_RECORDS_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def disable_async_device_snapshot() -> Generator[None, None, None]:
+    with _override_env(_ASYNC_DEVICE_SNAPSHOT_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_staging_pool_slab_bytes(nbytes: int) -> Generator[None, None, None]:
+    with _override_env(_STAGING_POOL_SLAB_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_staging_pool_slabs(n: int) -> Generator[None, None, None]:
+    with _override_env(_STAGING_POOL_SLABS_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_async_visible_budget_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_ASYNC_VISIBLE_BUDGET_ENV, str(seconds)):
         yield
 
 

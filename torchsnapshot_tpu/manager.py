@@ -111,7 +111,23 @@ class _PendingManagedSnapshot:
         self._committed = False
         self._commit_lock = threading.Lock()
 
-    def wait(self) -> Snapshot:
+    def wait(self, phase: str = "committed") -> Optional[Snapshot]:
+        """Passes ``phase`` through to :meth:`PendingSnapshot.wait`.
+        Index update + retention run only on the ``"committed"`` wait —
+        a ``"staged"`` wait observes D2H completion without making the
+        step visible to ``restore_latest`` (the drain paths that flush
+        checkpoints before teardown must wait for ``"committed"``, and
+        this wrapper's default does)."""
+        if phase not in ("staged", "committed"):
+            # Same contract as PendingSnapshot.wait: a typo'd phase must
+            # not silently become a committed wait with index/retention
+            # side effects.
+            raise ValueError(
+                f'phase must be "staged" or "committed", got {phase!r}'
+            )
+        if phase == "staged":
+            self._pending.wait(phase="staged")
+            return None
         snapshot = self._pending.wait()  # raises on failed take: no index entry
         # Idempotent join, lock-guarded: wait() may be called from more
         # than one place (progress loop + shutdown path, possibly on
@@ -132,6 +148,9 @@ class _PendingManagedSnapshot:
 
     def done(self) -> bool:
         return self._pending.done()
+
+    def staged(self) -> bool:
+        return self._pending.staged()
 
 
 class CheckpointManager:

@@ -15,9 +15,13 @@ whole budget is admitted only when nothing else is in flight (reference rule,
 scheduler.py:266-271), so huge buffers serialize instead of deadlocking.
 
 ``execute_write_reqs`` returns a :class:`PendingIOWork` as soon as *staging*
-has finished for every request — the async-snapshot unblock point
-(scheduler.py:224-234): from then on the application may mutate/free device
-arrays while storage I/O drains in the background.
+has finished for every request (scheduler.py:224-234): from then on the
+application may mutate/free device arrays while storage I/O drains in the
+background. Device-snapshot async takes go further: :class:`DeferredIOWork`
+defers the WHOLE pipeline to the background commit thread, running it
+through a :class:`StagingPool` (a slab-bounded admission controller) so
+host staging memory never scales with checkpoint size — the training-
+visible span ends at capture, before any staging ran (docs/async.md).
 """
 
 from __future__ import annotations
@@ -166,6 +170,49 @@ class MemoryBudget:
             self._cond.notify_all()
 
 
+class StagingPool(MemoryBudget):
+    """Double-buffered host staging pool for background D2H drains.
+
+    A device-snapshot async take runs its whole staging pipeline on the
+    background commit thread; this pool is that pipeline's admission
+    controller. Capacity is ``slabs x slab_bytes`` (knob-set; default
+    2 x 128 MiB — classic double buffering: one slab's worth of
+    requests stages D2H while the previous slab's worth drains to
+    storage), clamped to the process memory budget it is accounted
+    against — so a 1 GiB checkpoint drains through ~256 MiB of host
+    headroom instead of materializing entirely. Inherits the
+    idle-admission escape hatch: a single request larger than the whole
+    pool is admitted alone (it serializes instead of deadlocking), and
+    all of MemoryBudget's wait/peak telemetry.
+    """
+
+    def __init__(
+        self,
+        memory_budget_bytes: int,
+        slab_bytes: Optional[int] = None,
+        slabs: Optional[int] = None,
+    ) -> None:
+        self.slab_bytes = (
+            slab_bytes
+            if slab_bytes is not None
+            else knobs.get_staging_pool_slab_bytes()
+        )
+        self.slabs = (
+            slabs if slabs is not None else knobs.get_staging_pool_slabs()
+        )
+        self.memory_budget_bytes = memory_budget_bytes
+        super().__init__(
+            min(memory_budget_bytes, max(1, self.slab_bytes * self.slabs))
+        )
+
+    def geometry(self) -> dict:
+        return {
+            "capacity_bytes": self.total_bytes,
+            "slab_bytes": self.slab_bytes,
+            "slabs": self.slabs,
+        }
+
+
 class _PipelineStats:
     """Live counters backing the progress reporter."""
 
@@ -287,13 +334,16 @@ class _ProgressReporter:
 
     def pipeline_telemetry(self) -> dict:
         """This run's exact numbers for SnapshotReport assembly."""
-        return {
+        out = {
             "phases": dict(self.phase_s),
             "bytes_moved": self.stats.bytes_moved,
             "blobs": self.stats.done,
             "budget_wait_s": round(self.budget.wait_s, 6),
             "peak_staged_bytes": self.budget.peak_reserved_bytes,
         }
+        if isinstance(self.budget, StagingPool):
+            out["staging_pool"] = self.budget.geometry()
+        return out
 
 
 class PendingIOWork:
@@ -373,12 +423,21 @@ async def execute_write_reqs(
     memory_budget_bytes: int,
     rank: int,
     progress: Optional["ProgressTracker"] = None,
+    staging_pool: Optional[MemoryBudget] = None,
 ) -> PendingIOWork:
     """Run the staged write pipeline; returns once every request is past
     staging, with storage I/O continuing inside the returned handle.
     ``progress`` (the enclosing op's live-progress tracker) receives the
-    pipeline's plan and per-request counter updates."""
-    budget = MemoryBudget(memory_budget_bytes)
+    pipeline's plan and per-request counter updates. ``staging_pool``
+    substitutes a (typically much tighter) admission controller for the
+    raw budget — the background-drain path of device-snapshot async
+    takes, whose host staging footprint must be pool-bounded, not
+    checkpoint-sized."""
+    budget = (
+        staging_pool
+        if staging_pool is not None
+        else MemoryBudget(memory_budget_bytes)
+    )
     stats = _PipelineStats()
     stats.pending = len(write_reqs)
     reporter = _ProgressReporter(stats, budget, rank, len(write_reqs), progress)
@@ -550,6 +609,81 @@ def sync_execute_write_reqs(
             progress=progress,
         )
     )
+
+
+class DeferredIOWork:
+    """Write work whose staging has NOT run yet — the device-snapshot
+    async take's handle. ``async_take`` constructs one right after the
+    capture pass (on-device clones dispatched, mutable host leaves
+    copied) and returns; the background commit thread then calls
+    ``sync_complete``, which runs the WHOLE pipeline: staging (D2H +
+    serialize) through a :class:`StagingPool` so host memory stays
+    slab-bounded, overlapped with the storage writes by the ordinary
+    stage/write machinery of :func:`execute_write_reqs`.
+
+    Mirrors :class:`PendingIOWork`'s surface (``sync_complete`` /
+    ``finalize_checksums`` / ``checksums`` / ``checksum_finalizer`` /
+    ``pipeline_telemetry``) so ``PendingSnapshot`` drives either handle
+    identically. ``on_staged`` fires on the drain thread the moment
+    staging finished — the take's ``staged`` phase boundary
+    (``PendingSnapshot.wait(phase="staged")``).
+    """
+
+    def __init__(
+        self,
+        write_reqs: List[WriteReq],
+        storage: StoragePlugin,
+        memory_budget_bytes: int,
+        rank: int,
+        progress: Optional["ProgressTracker"] = None,
+    ) -> None:
+        self.write_reqs = write_reqs
+        self._storage = storage
+        self._memory_budget_bytes = memory_budget_bytes
+        self._rank = rank
+        self._progress = progress
+        # Same contract as PendingIOWork: filled as writes complete
+        # (rebound to the live pipeline's table once staging starts),
+        # stable only after sync_complete() returns.
+        self.checksums: ChecksumTable = {}
+        self.checksum_finalizer: Optional[Callable[[], None]] = None
+        self.on_staged: Optional[Callable[[], None]] = None
+        self._inner: Optional[PendingIOWork] = None
+
+    def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
+        pool = StagingPool(self._memory_budget_bytes)
+        inner = event_loop.run_until_complete(
+            execute_write_reqs(
+                write_reqs=self.write_reqs,
+                storage=self._storage,
+                memory_budget_bytes=self._memory_budget_bytes,
+                rank=self._rank,
+                progress=self._progress,
+                staging_pool=pool,
+            )
+        )
+        self._inner = inner
+        # The inner pipeline's table is the live one; expose it so the
+        # caller's checksum-table write (and an incremental take's
+        # inherit closure, which reads ``self.checksums`` at call time)
+        # see every recorded digest.
+        self.checksums = inner.checksums
+        self.write_reqs = []
+        if self.on_staged is not None:
+            self.on_staged()
+        inner.sync_complete(event_loop)
+
+    def finalize_checksums(self) -> None:
+        if self.checksum_finalizer is not None:
+            try:
+                self.checksum_finalizer()
+            finally:
+                self.checksum_finalizer = None
+
+    def pipeline_telemetry(self) -> dict:
+        return (
+            self._inner.pipeline_telemetry() if self._inner is not None else {}
+        )
 
 
 async def execute_read_reqs(

@@ -6,10 +6,14 @@ Reference parity: torchsnapshot/snapshot.py (991 LoC). Same protocol shape:
   ``.snapshot_metadata`` manifest (commit-after-barrier invariant,
   reference snapshot.py:230-237 — a snapshot without the metadata file never
   happened, which is what makes interrupted takes safe).
-- ``async_take``: returns a :class:`PendingSnapshot` as soon as staging
-  (D2H + serialization) completes; storage I/O and the commit run on a
-  background thread coordinated by a store-based :class:`LinearBarrier`
-  (never collectives — reference snapshot.py:948).
+- ``async_take``: returns a :class:`PendingSnapshot` in
+  checkpoint-size-independent time — the plan collectives run, a
+  consistent device snapshot is pinned (on-device clones, dispatched),
+  and staging (D2H + serialization), storage I/O and the commit all run
+  on a background thread coordinated by a store-based
+  :class:`LinearBarrier` (never collectives — reference
+  snapshot.py:948). ``wait(phase=)`` exposes the staged/committed
+  boundaries; docs/async.md has the full phase model.
 - ``restore``: per-stateful memory-frugal load — current leaves are reused
   as restore destinations so footprint stays ~1x (reference
   snapshot.py:682-692); JAX arrays are restored host-side then
@@ -46,6 +50,7 @@ from .dist_store import LinearBarrier
 from .flatten import flatten, inflate
 from .io_preparer import (
     ArrayIOPreparer,
+    capture_write_reqs,
     is_jax_array,
     prepare_read,
     prepare_write,
@@ -65,6 +70,7 @@ from .manifest import (
 from .pg_wrapper import PGWrapper
 from .rng_state import RngState
 from .scheduler import (
+    DeferredIOWork,
     PendingIOWork,
     get_process_memory_budget_bytes,
     sync_execute_read_reqs,
@@ -357,12 +363,26 @@ class Snapshot:
         record_digests: bool = False,
         _custom_array_prepare_func=None,
     ) -> "PendingSnapshot":
-        """Pipelined checkpoint: returns once staging completes; storage I/O
-        and the commit continue on a background thread (reference
-        snapshot.py:245-314). ``incremental_base``/``record_digests`` as in
-        :meth:`take`."""
+        """Pipelined checkpoint whose training-visible span is independent
+        of checkpoint size (docs/async.md): by default the call returns as
+        soon as the manifest/plan collectives finish and a consistent
+        device snapshot is pinned — on-device clones of the leaves the
+        write plan needs (dispatched, not awaited), host copies of mutable
+        numpy leaves — and the ENTIRE staging (D2H + serialize) plus
+        storage drain and commit run on a background thread through a
+        slab-bounded host staging pool (``scheduler.StagingPool``). The
+        application may mutate, donate, or delete the live arrays freely
+        once this returns. ``PendingSnapshot.wait(phase=)`` distinguishes
+        the ``"staged"`` point (D2H done; host buffers hold the bytes)
+        from the default ``"committed"`` barrier.
+
+        ``TORCHSNAPSHOT_TPU_ASYNC_DEVICE_SNAPSHOT=0`` restores the
+        pre-deferral behavior (staging completes before this returns —
+        reference snapshot.py:245-314 — costing no transient HBM copy).
+        ``incremental_base``/``record_digests`` as in :meth:`take`."""
         import uuid
 
+        op_begin = time.monotonic()
         pg_wrapper = PGWrapper(pg)
         path = pg_wrapper.broadcast_object(path)
         # Unique per-take commit nonce: barrier keys from any earlier take
@@ -385,6 +405,7 @@ class Snapshot:
         trace_mark = recorder.mark()
         storage = url_to_storage_plugin(path)
         tracker = _progress.track("async_take", path, pg_wrapper.get_rank())
+        defer_staging = knobs.is_async_device_snapshot_enabled()
         try:
             with recorder.span(
                 telemetry.names.SPAN_ASYNC_TAKE_STAGE,
@@ -403,6 +424,7 @@ class Snapshot:
                     record_digests=record_digests,
                     _custom_array_prepare_func=_custom_array_prepare_func,
                     progress_tracker=tracker,
+                    defer_staging=defer_staging,
                 )
         except BaseException as e:
             # The failure path owns the loop/storage (no PendingSnapshot
@@ -425,6 +447,7 @@ class Snapshot:
             counter_baseline=counter_baseline,
             trace_mark=trace_mark,
             progress_tracker=tracker,
+            op_begin=op_begin,
         )
 
     @classmethod
@@ -441,10 +464,18 @@ class Snapshot:
         record_digests: bool = False,
         _custom_array_prepare_func=None,
         progress_tracker: Optional[_progress.ProgressTracker] = None,
-    ) -> Tuple[PendingIOWork, Optional[SnapshotMetadata]]:
+        defer_staging: bool = False,
+    ) -> Tuple["PendingIOWork | DeferredIOWork", Optional[SnapshotMetadata]]:
         """Shared take core (reference snapshot.py:316-440). The returned
         metadata is None on non-leader ranks (manifests gather to rank 0
-        only; see :func:`_gather_manifest`)."""
+        only; see :func:`_gather_manifest`).
+
+        With ``defer_staging`` (device-snapshot async takes), no staging
+        runs here: the write plan's sources are captured (on-device
+        clones / host copies) and the returned :class:`DeferredIOWork`
+        runs the whole pool-bounded pipeline on the background commit
+        thread. Collectives still all happen on this (the calling)
+        thread either way."""
         _validate_app_state(app_state)
         rank = pg_wrapper.get_rank()
         world_size = pg_wrapper.get_world_size()
@@ -567,14 +598,46 @@ class Snapshot:
             else None
         )
 
-        pending_io_work = sync_execute_write_reqs(
-            write_reqs=write_reqs,
-            storage=storage,
-            memory_budget_bytes=memory_budget_bytes,
-            rank=rank,
-            event_loop=event_loop,
-            progress=progress_tracker,
-        )
+        if defer_staging:
+            # Device-snapshot point: pin every write source (on-device
+            # clone dispatch for jax leaves — cheap; host copies for
+            # mutable numpy leaves; eager pickles for objects), then
+            # hand the un-staged plan to the background drain. From the
+            # caller's return onward the live arrays are free to be
+            # mutated, donated, or deleted.
+            recorder = _trace_recorder()
+            with recorder.span(
+                telemetry.names.SPAN_DEVICE_CAPTURE,
+                rank=rank,
+                reqs=len(write_reqs),
+            ):
+                captured = capture_write_reqs(write_reqs)
+            logger.debug(
+                "async take captured %d device/host sources for %d "
+                "deferred write requests",
+                captured,
+                len(write_reqs),
+            )
+            if progress_tracker is not None:
+                progress_tracker.set_phase("captured")
+            pending_io_work: "PendingIOWork | DeferredIOWork" = (
+                DeferredIOWork(
+                    write_reqs=write_reqs,
+                    storage=storage,
+                    memory_budget_bytes=memory_budget_bytes,
+                    rank=rank,
+                    progress=progress_tracker,
+                )
+            )
+        else:
+            pending_io_work = sync_execute_write_reqs(
+                write_reqs=write_reqs,
+                storage=storage,
+                memory_budget_bytes=memory_budget_bytes,
+                rank=rank,
+                event_loop=event_loop,
+                progress=progress_tracker,
+            )
         if incr_ctx is not None:
             # Referenced blobs were not rewritten, so their checksums come
             # from the base snapshot's tables (keyed by the ref location):
@@ -1278,17 +1341,30 @@ class _StatefulLoadPlan:
 class PendingSnapshot:
     """Handle on an in-flight async snapshot (reference snapshot.py:904-991).
 
-    A background thread drains storage I/O, synchronizes through a
-    store-based :class:`LinearBarrier` (collectives are not thread-safe to
-    issue off the main thread — reference comment snapshot.py:948), and
-    rank 0 writes the commit marker only if every rank succeeded. Errors
-    propagate to every rank through the barrier and re-raise in ``wait()``.
+    A background thread drains staging (for device-snapshot takes) and
+    storage I/O, synchronizes through a store-based
+    :class:`LinearBarrier` (collectives are not thread-safe to issue off
+    the main thread — reference comment snapshot.py:948), and rank 0
+    writes the commit marker only if every rank succeeded. Errors
+    propagate to every rank through the barrier and re-raise in
+    ``wait()``.
+
+    The snapshot moves through three phases (docs/async.md):
+
+    - **visible** — over by the time the caller holds this handle: the
+      plan collectives ran and a consistent snapshot is pinned (device
+      clones / host copies); the live state is free.
+    - **staged** — background D2H + serialization finished; the bytes
+      sit in host buffers (and, for tiered paths, partly in the fast
+      tier). ``wait(phase="staged")``.
+    - **committed** — every rank's writes are durable and the commit
+      marker exists. ``wait()`` / ``wait(phase="committed")``.
     """
 
     def __init__(
         self,
         path: str,
-        pending_io_work: PendingIOWork,
+        pending_io_work: "PendingIOWork | DeferredIOWork",
         pg_wrapper: PGWrapper,
         metadata: Optional[SnapshotMetadata],
         storage: StoragePlugin,
@@ -1297,6 +1373,7 @@ class PendingSnapshot:
         counter_baseline: Optional[Dict[str, float]] = None,
         trace_mark: Optional[TraceMark] = None,
         progress_tracker: Optional[_progress.ProgressTracker] = None,
+        op_begin: Optional[float] = None,
     ) -> None:
         import threading
 
@@ -1312,6 +1389,25 @@ class PendingSnapshot:
         self._progress_tracker = progress_tracker
         self._exc_info: Optional[BaseException] = None
         self._done = threading.Event()
+        self._staged = threading.Event()
+        # Phase-split telemetry, relative to async_take's entry: the
+        # visible span is over by construction time (this handle IS the
+        # return value); staged_s is stamped by the drain callback.
+        self._op_begin = op_begin if op_begin is not None else time.monotonic()
+        self._visible_s = time.monotonic() - self._op_begin
+        self._staged_s: Optional[float] = None
+        if isinstance(pending_io_work, DeferredIOWork):
+            # Wired BEFORE the thread starts: the drain may reach the
+            # staged boundary arbitrarily fast.
+            def _mark_staged() -> None:
+                self._staged_s = time.monotonic() - self._op_begin
+                self._staged.set()
+
+            pending_io_work.on_staged = _mark_staged
+        else:
+            # Non-deferred takes staged before this handle existed.
+            self._staged_s = self._visible_s
+            self._staged.set()
         self._thread = threading.Thread(
             target=self._complete_snapshot, name="snapshot-commit", daemon=True
         )
@@ -1350,12 +1446,18 @@ class PendingSnapshot:
             # Store-based gather + local file append only — safe on this
             # background thread (no collectives), same rule the commit
             # barrier follows. Post-close so a tiered take's report sees
-            # its just-enqueued mirror job.
+            # its just-enqueued mirror job. The pipeline dict carries the
+            # visible/staged phase split for the doctor's
+            # async-visible-stall rule.
+            pipeline = dict(self._pending_io_work.pipeline_telemetry())
+            pipeline["visible_s"] = round(self._visible_s, 6)
+            if self._staged_s is not None:
+                pipeline["staged_s"] = round(self._staged_s, 6)
             _emit_snapshot_report(
                 kind="async_take",
                 path=self.path,
                 pg_wrapper=self.pg,
-                pipeline=self._pending_io_work.pipeline_telemetry(),
+                pipeline=pipeline,
                 counter_baseline=self._counter_baseline,
                 nonce=self.commit_nonce,
                 trace_mark=self._trace_mark,
@@ -1373,13 +1475,42 @@ class PendingSnapshot:
                         "Failed to report snapshot error to peers: %r", report_exc
                     )
         finally:
+            # Ordering matters on the failure path: the error is recorded
+            # and the heartbeat settled TERMINAL ("failed", never a
+            # crash-shaped non-terminal leftover) before the staged/done
+            # events release any waiter — a woken wait() must observe the
+            # final state, exactly once, not a half-settled one.
             if self._progress_tracker is not None:
                 self._progress_tracker.finish(self._exc_info)
             recorder.end(commit_span)  # no-op if already closed
             self._event_loop.close()
+            self._staged.set()  # no-op if staging completed normally
             self._done.set()
 
-    def wait(self) -> Snapshot:
+    def wait(self, phase: str = "committed") -> Optional[Snapshot]:
+        """Block until the snapshot reaches ``phase``:
+
+        - ``"staged"`` — background staging (D2H + serialize) finished;
+          returns None (there is no committed snapshot yet). The legacy
+          unblock point: everything the pre-deferral ``async_take``
+          guaranteed at return time holds here.
+        - ``"committed"`` (default) — storage drain + commit barrier
+          done on every rank; returns the committed :class:`Snapshot`.
+
+        A background failure re-raises here — on the first ``wait()``
+        that observes it and on every later one (callers polling
+        ``wait(phase="staged")`` then ``wait()`` see it at both, rather
+        than a success after an error). The progress heartbeat is
+        settled terminal by the drain thread before any waiter wakes."""
+        if phase not in ("staged", "committed"):
+            raise ValueError(
+                f'phase must be "staged" or "committed", got {phase!r}'
+            )
+        if phase == "staged":
+            self._staged.wait()
+            if self._exc_info is not None:
+                raise self._exc_info
+            return None
         self._thread.join()
         if self._exc_info is not None:
             raise self._exc_info
@@ -1391,6 +1522,12 @@ class PendingSnapshot:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def staged(self) -> bool:
+        """True once background staging finished (``wait(phase="staged")``
+        will not block). Also true after a failed drain — ``wait`` then
+        raises instead of blocking."""
+        return self._staged.is_set()
 
 
 class PendingRestore:

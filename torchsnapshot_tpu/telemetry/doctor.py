@@ -36,6 +36,7 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from . import names
+from .. import knobs
 from .history import detect_trend_regressions
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -401,6 +402,42 @@ def _mirror_lagging(report: Dict[str, Any]):
             "threshold_lag_s": MIRROR_LAG_S,
             "threshold_depth": MIRROR_QUEUE_DEPTH,
         },
+    }
+
+
+@doctor_rule(names.RULE_ASYNC_VISIBLE_STALL)
+def _async_visible_stall(report: Dict[str, Any]):
+    """An async take blocked its caller beyond the visible-time budget
+    (TORCHSNAPSHOT_TPU_ASYNC_VISIBLE_BUDGET_SECONDS): with device
+    snapshotting on, the visible span is plan + capture dispatch and
+    must not scale with checkpoint size — a breach means staging leaked
+    back into the training thread (knob off, a capture fallback paying
+    D2H eagerly, or a regression in the deferral path). Cites the
+    stage-span evidence: where the drain's staging actually happened
+    relative to the visible span."""
+    if report.get("kind") != "async_take":
+        return None
+    visible = report.get("visible_s")
+    if visible is None:
+        return None
+    budget = knobs.get_async_visible_budget_seconds()
+    if budget <= 0 or float(visible) <= budget:
+        return None
+    phases = report.get("phases") or {}
+    return {
+        "summary": (
+            "async_take blocked training beyond the visible budget: "
+            "staging ran in the caller's span instead of the "
+            "background drain"
+        ),
+        "evidence": {
+            "visible_s": float(visible),
+            "budget_s": budget,
+            "staged_s": report.get("staged_s"),
+            "staging_s": phases.get("staging"),
+            "wall_s": max((float(v) for v in phases.values()), default=0.0),
+        },
+        "severity": "warning",
     }
 
 

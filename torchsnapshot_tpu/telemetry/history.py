@@ -96,6 +96,12 @@ def summarize_report(
         "mb_s": round(safe_rate_mb_s(report.bytes_moved, take_s), 3),
         "budget_wait_s": round(report.budget_wait_s, 6),
         "peak_staged_bytes": report.peak_staged_bytes,
+        # Async takes: the training-visible span — None elsewhere.
+        # Rides into doctor --trend so a step whose visible time creeps
+        # up (a deferral regression) flags like any other metric.
+        "visible_s": (
+            round(report.visible_s, 6) if report.visible_s is not None else None
+        ),
         "error": report.error,
     }
 
@@ -160,6 +166,11 @@ _TREND_METRICS = {
     "take_s": ("take wall clock", 1),
     "budget_wait_s": ("memory-budget wait", 1),
     "mb_s": ("throughput", -1),
+    # Async takes' training-visible span (None/0 for sync takes —
+    # all-zero baselines never flag): a step whose visible time creeps
+    # up is a deferral regression, the same defect the doctor's
+    # async-visible-stall rule catches per-op.
+    "visible_s": ("async visible span", 1),
 }
 
 

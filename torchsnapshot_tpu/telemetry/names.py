@@ -98,6 +98,10 @@ SPAN_PIPELINE_CONSUME = "pipeline:consume"
 # D2H+serialize and deserialize+copy inside the pipeline spans above)
 SPAN_LEAF_STAGE = "stage:leaf"
 SPAN_LEAF_CONSUME = "consume:leaf"
+# Device-snapshot async takes: the pre-return capture pass (on-device
+# clone dispatch + mutable-host-leaf copies) — the only staging-flavored
+# work left inside async_take's training-visible span.
+SPAN_DEVICE_CAPTURE = "stage:device_capture"
 
 # storage plugins (fs/s3/gcs); the fs native fast path additionally
 # stamps its executor-thread kernel I/O
@@ -159,6 +163,11 @@ RULE_INTERRUPTED_TAKE = "interrupted-take"
 RULE_WATCHDOG_STALLED = "watchdog-stalled"
 # Storage retries during the op exceeded the storm threshold.
 RULE_RETRY_STORM = "retry-storm"
+# An async take's training-visible span (async_take return-to-caller
+# time) exceeded the visible-budget knob: staging leaked back into the
+# caller's thread — the regression the device-snapshot path exists to
+# prevent.
+RULE_ASYNC_VISIBLE_STALL = "async-visible-stall"
 # Bench-trial rules (bench.py's former private heuristics): the take's
 # achieved throughput fell below half of a *stable* bracketing probe
 # pair — the slowdown happened inside the take.

@@ -86,6 +86,17 @@ class SnapshotReport:
     blobs: int = 0
     budget_wait_s: float = 0.0
     peak_staged_bytes: int = 0
+    # Async takes only (None elsewhere): the training-visible span
+    # (async_take return-to-caller) and the op-relative time at which
+    # background staging (D2H + serialize) completed — the
+    # visible / staged / committed phase split docs/async.md describes.
+    visible_s: Optional[float] = None
+    staged_s: Optional[float] = None
+    # Device-snapshot drains only: the StagingPool geometry
+    # ({capacity_bytes, slab_bytes, slabs}) that bounded this
+    # pipeline's host staging — the context an operator needs to read
+    # peak_staged_bytes / budget_wait_s on a pool-bounded drain.
+    staging_pool: Optional[Dict[str, int]] = None
     retries: Dict[str, float] = dataclasses.field(default_factory=dict)
     mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
     aggregated: Optional[Dict[str, Dict[str, float]]] = None
@@ -184,6 +195,21 @@ def build_report(
         blobs=int(pipeline.get("blobs", 0)),
         budget_wait_s=float(pipeline.get("budget_wait_s", 0.0)),
         peak_staged_bytes=int(pipeline.get("peak_staged_bytes", 0)),
+        visible_s=(
+            float(pipeline["visible_s"])
+            if pipeline.get("visible_s") is not None
+            else None
+        ),
+        staged_s=(
+            float(pipeline["staged_s"])
+            if pipeline.get("staged_s") is not None
+            else None
+        ),
+        staging_pool=(
+            dict(pipeline["staging_pool"])
+            if pipeline.get("staging_pool")
+            else None
+        ),
         retries=retries_from_deltas(counter_deltas),
         mirror=dict(mirror or {}),
         error=error,
