@@ -70,6 +70,10 @@ TS_BENCH_TRIALS overrides the take-trial count (still deadline-guarded).
 TS_BENCH_SKIP_PROTOCOL=1 skips the CPU-mesh subprocess legs (the cold
 restore leg still runs — it is part of the restore story).
 TS_BENCH_BUDGET_S overrides the wall-clock budget.
+TS_BENCH_STEADY_TAKES overrides the steady-state autotune leg's take
+count. ``--json-out PATH`` additionally writes the final record to a
+file (the stdout tail can be truncated by the driver's capture —
+BENCH_r04/r05 both parsed null for exactly that reason).
 """
 
 import atexit
@@ -90,6 +94,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs as ts_knobs
 from torchsnapshot_tpu import scheduler as ts_scheduler
 from torchsnapshot_tpu.telemetry import doctor as ts_doctor
 from torchsnapshot_tpu.telemetry import names as ts_names
@@ -120,6 +125,9 @@ RESULT = {
     "budget_s": BUDGET_S,
 }
 _FINAL_EMITTED = False
+# --json-out: a file that receives the same final JSON record the last
+# stdout line carries (set in __main__; None = stdout only).
+_JSON_OUT = None
 _OVERRIDES = [
     k
     for k in (
@@ -127,6 +135,7 @@ _OVERRIDES = [
         "TS_BENCH_TRIALS",
         "TS_BENCH_SKIP_PROTOCOL",
         "TS_BENCH_BUDGET_S",
+        "TS_BENCH_STEADY_TAKES",
     )
     if os.environ.get(k)
 ]
@@ -197,13 +206,28 @@ def _finalize_record(complete: bool) -> None:
         write_signal_of_record(RESULT)
 
 
+def _write_json_out() -> None:
+    """Best-effort copy of the final record to the --json-out file: a
+    parse surface the driver's stdout capture cannot truncate."""
+    if _JSON_OUT is None:
+        return
+    try:
+        Path(_JSON_OUT).write_text(json.dumps(RESULT, indent=1))
+    except OSError as e:
+        _log(f"bench: could not write --json-out {_JSON_OUT}: {e!r}")
+
+
 def _emit_final(complete: bool) -> None:
     global _FINAL_EMITTED
     if _FINAL_EMITTED:
         return
     _FINAL_EMITTED = True
     _finalize_record(complete)
-    print(json.dumps(RESULT), flush=True)
+    _write_json_out()
+    # The final bare JSON line — the ONLY unprefixed stdout line, last,
+    # single-line (compact separators keep it well under pipe-buffer
+    # sizes so a tail capture gets all of it or none).
+    print(json.dumps(RESULT, separators=(",", ":")), flush=True)
 
 
 def _on_signal(signum, frame):  # noqa: ANN001 - signal handler signature
@@ -219,8 +243,9 @@ def _on_signal(signum, frame):  # noqa: ANN001 - signal handler signature
         RESULT["terminated_by"] = signal.Signals(signum).name
         RESULT["complete"] = False
         RESULT["elapsed_s"] = round(time.monotonic() - START, 1)
-        os.write(1, (json.dumps(RESULT) + "\n").encode())
+        os.write(1, (json.dumps(RESULT, separators=(",", ":")) + "\n").encode())
         try:
+            _write_json_out()
             _write_partial_file()
             if not _OVERRIDES:
                 write_signal_of_record(RESULT)
@@ -321,7 +346,7 @@ def _median_range(samples):
     ]
 
 
-def _bracketed_efficiency(times_s, probes_gbps, gib):
+def _bracketed_efficiency(times_s, probes_gbps, gib, warmup=0):
     """Shared bracketed-efficiency epistemics for save AND restore (one
     definition, so the two legs can never drift apart): transfer i's
     ratio is achieved / max(probe_before, probe_after) — probes are
@@ -329,14 +354,23 @@ def _bracketed_efficiency(times_s, probes_gbps, gib):
     estimate covering that window. Stability thresholds now live in the
     checkpoint doctor (telemetry/doctor.py) so the bench and production
     agree on what "unstable" means; ``link_unstable`` is the doctor's
-    series-level probe check. Returns
+    series-level probe check.
+
+    ``warmup`` transfers are excluded from the MEDIAN efficiency and the
+    instability check (r05's 0.429 first-take ratio was compile/pool
+    warm-up, not link behavior, yet it dragged the reported mean and
+    tripped link_unstable) — the raw per-transfer ratio list still
+    carries every transfer, warm-up included. With too few transfers to
+    spare the warm-up (len <= warmup) the full series is used. Returns
     (brackets, ratios, median_efficiency, link_unstable)."""
     brackets = [
         max(probes_gbps[i], probes_gbps[i + 1]) for i in range(len(times_s))
     ]
     ratios = [(gib / t) / b for t, b in zip(times_s, brackets) if b > 0]
-    efficiency = statistics.median(ratios) if ratios else 0.0
-    unstable = ts_doctor.probes_unstable(probes_gbps)
+    if not (0 < warmup < len(ratios)):
+        warmup = 0
+    efficiency = statistics.median(ratios[warmup:]) if ratios else 0.0
+    unstable = ts_doctor.probes_unstable(probes_gbps[warmup:])
     return brackets, ratios, efficiency, unstable
 
 
@@ -483,6 +517,95 @@ def cold_start_rows() -> None:
             )
     finally:
         shutil.rmtree(snap_dir, ignore_errors=True)
+
+
+def steady_state_leg(
+    workdir: str,
+    total_bytes: int,
+    gib: float,
+    probe_streams: int,
+    link_est: float,
+    est_take_s: float,
+) -> None:
+    """Leg 7: steady-state multi-take convergence under the autotuner.
+
+    The single-take legs above measure the pipeline as configured; this
+    leg measures whether the closed loop (tuner/autotuner.py) *improves*
+    it across a recurring-checkpoint run: a CheckpointManager saves N
+    fresh states through the same bracketed-probe epistemics as the
+    headline leg, the autotuner adjusting knobs between takes, and the
+    record carries per-take efficiency + the applied knob trajectory so
+    convergence (or thrashing) is visible in the BENCH_r* series.
+    Fail-soft and budget-gated per take like every other context leg."""
+    takes = int(os.environ.get("TS_BENCH_STEADY_TAKES", "5"))
+    per_take_est = est_take_s + PROBE_TARGET_S
+    if not _have_budget("steady_state", per_take_est * min(takes, 2)):
+        return
+    from torchsnapshot_tpu.tuner import state as tuner_state_mod
+    from torchsnapshot_tpu.tuner import reset_overrides
+
+    root = os.path.join(workdir, "steady")
+    autotune_on = ts_knobs.is_autotune_enabled()
+    times, probes, effs, knob_traj = [], [], [], []
+    try:
+        mgr = ts.CheckpointManager(root, keep_last_n=1)
+        est = max(link_est, 1e-3)
+
+        def probe(tag: str) -> None:
+            nonlocal est
+            chunk = _scaled_chunk_mib(est, probe_streams)
+            p = probe_d2h(probe_streams, chunk_mib=chunk)
+            probes.append(p)
+            est = p
+            _log(f"bench: steady-state probe {tag}: {p:.3f} GB/s")
+
+        probe("before steady 0")
+        for i in range(takes):
+            if i > 0 and not _have_budget(f"steady{i}", per_take_est):
+                break
+            state = make_state(total_bytes, seed=31 + i)
+            knob_traj.append(ts_knobs.tunable_snapshot())
+            t0 = time.perf_counter()
+            mgr.save(i, {"state": ts.PyTreeState(state)})
+            times.append(time.perf_counter() - t0)
+            del state
+            probe(f"after steady {i}")
+            effs.append((gib / times[-1]) / max(probes[-2], probes[-1]))
+            _log(
+                f"bench: steady take {i}: {times[-1]:.2f} s, "
+                f"efficiency {effs[-1]:.3f}x of bracket"
+            )
+        decisions = []
+        st = tuner_state_mod.load_state(root)
+        if st is not None:
+            decisions = [
+                {
+                    "step": d.get("step"),
+                    "action": d["decision"].get("action"),
+                    "tunable": d["decision"].get("tunable"),
+                    "reason": d["decision"].get("reason"),
+                }
+                for d in st.decisions
+            ]
+        RESULT["steady_state"] = {
+            "autotune": autotune_on,
+            "takes": len(times),
+            "take_times_s": [round(t, 2) for t in times],
+            "per_take_efficiency": [round(e, 3) for e in effs],
+            "d2h_probes": [round(p, 3) for p in probes],
+            "final_efficiency": round(effs[-1], 3) if effs else None,
+            "knob_trajectory": knob_traj,
+            "decisions": decisions,
+        }
+        if effs:
+            RESULT["steady_state_final_efficiency"] = round(effs[-1], 3)
+    except Exception as e:  # noqa: BLE001 - context leg, fail-soft
+        _log(f"bench: steady-state leg failed: {e!r}")
+    finally:
+        # The tuned vector must not leak into later probes/legs or a
+        # reused process: the leg measures the loop, not the residue.
+        reset_overrides()
+    _emit_partial("steady_state")
 
 
 DOC_BLOCK_RE = re.compile(
@@ -721,8 +844,12 @@ def main() -> None:
         # are unchanged for BENCH_r* comparability; each diagnostic
         # additionally embeds the doctor's verdict ids.
         denom = statistics.median(matched_probes)
+        # warmup=1: the first take pays one-time costs (event loop,
+        # thread pools, XLA transfer program, staging-pool creation)
+        # that say nothing about steady-state pipeline efficiency; its
+        # raw ratio stays in efficiency_ratios.
         brackets, ratios, efficiency, link_unstable = _bracketed_efficiency(
-            take_times, matched_probes, gib
+            take_times, matched_probes, gib, warmup=1
         )
         diagnostics = []
         for i, t in enumerate(take_times):
@@ -767,6 +894,7 @@ def main() -> None:
                 "take_times_s": [round(t, 2) for t in take_times],
                 "d2h_matched_probes": [round(c, 3) for c in matched_probes],
                 "efficiency_ratios": [round(r, 3) for r in ratios],
+                "efficiency_warmup_takes": 1 if len(ratios) > 1 else 0,
                 "link_unstable": link_unstable,
                 "take_diagnostics": diagnostics,
             }
@@ -964,6 +1092,11 @@ def main() -> None:
                 _log(f"bench: async stall measurement failed: {e!r}")
             _emit_partial("async_stall")
 
+        # ---- Leg 7: steady-state multi-take autotune convergence ----
+        steady_state_leg(
+            workdir, total_bytes, gib, probe_streams, link_est, est_take_s
+        )
+
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -980,4 +1113,10 @@ def main() -> None:
 if __name__ == "__main__":
     if "--sync-docs" in sys.argv[1:]:
         sys.exit(sync_docs())
+    if "--json-out" in sys.argv[1:]:
+        idx = sys.argv.index("--json-out")
+        if idx + 1 >= len(sys.argv):
+            _log("bench: --json-out requires a path argument")
+            sys.exit(2)
+        _JSON_OUT = sys.argv[idx + 1]
     main()

@@ -42,6 +42,71 @@ def test_bracketed_efficiency_stable_link_not_flagged():
     assert abs(eff - (0.1 / 0.12)) < 1e-9
 
 
+def test_bracketed_efficiency_warmup_exclusion():
+    """warmup=1 drops the first (compile/warm-up) take from the MEDIAN
+    and the instability check, but the raw ratio list keeps it; with
+    nothing to spare (single trial) the full series is used."""
+    bench = _load_bench()
+    # First take 0.429-style slow, the rest steady: warm-up noise.
+    times = [23.3, 10.0, 10.0]
+    probes = [0.1, 0.2, 0.1, 0.1]
+    _, ratios_all, eff_all, unstable_all = bench._bracketed_efficiency(
+        times, probes, gib=1.0
+    )
+    _, ratios, eff, unstable = bench._bracketed_efficiency(
+        times, probes, gib=1.0, warmup=1
+    )
+    assert ratios == ratios_all  # raw per-take list keeps the warm-up
+    assert len(ratios) == 3
+    # Full-series median is dragged to 0.5 by the warm-up take; the
+    # steady-state median over takes 1..2 is 0.75.
+    assert abs(eff_all - 0.5) < 1e-9
+    assert abs(eff - 0.75) < 1e-9
+    assert unstable_all  # the 0.1 -> 0.2 warm-up swing trips it...
+    assert unstable  # ...and this tail genuinely moves 2x, still flagged
+    # A steady post-warm-up tail is NOT flagged even when the warm-up
+    # probe pair alone would have tripped the check.
+    _, _, _, unstable_steady = bench._bracketed_efficiency(
+        [23.3, 10.0, 10.0], [0.2, 0.11, 0.1, 0.11], gib=1.0, warmup=1
+    )
+    assert not unstable_steady
+    _, _, _, unstable_full = bench._bracketed_efficiency(
+        [23.3, 10.0, 10.0], [0.2, 0.11, 0.1, 0.11], gib=1.0
+    )
+    assert unstable_full
+    # Single trial: warm-up cannot be spared; full series used.
+    _, r1, e1, _ = bench._bracketed_efficiency(
+        [10.0], [0.1, 0.12], gib=1.0, warmup=1
+    )
+    assert abs(e1 - r1[0]) < 1e-9
+
+
+def test_final_line_round_trips_json_and_json_out(tmp_path, capsys, monkeypatch):
+    """The final stdout line must json.loads cleanly (BENCH_r04/r05
+    parsed null on a truncated prose-adjacent tail), and --json-out
+    mirrors the same record to a file the driver can read even when
+    stdout capture is lossy."""
+    import json
+
+    bench = _load_bench()
+    out_path = tmp_path / "record.json"
+    monkeypatch.setattr(bench, "_FINAL_EMITTED", False)
+    monkeypatch.setattr(bench, "_JSON_OUT", str(out_path))
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", tmp_path / "partial.json")
+    # Non-default-run marker: the helper must not rewrite BENCH.md.
+    monkeypatch.setattr(bench, "_OVERRIDES", ["TS_BENCH_GB"])
+    bench.RESULT["value"] = 1.23
+    bench._emit_final(True)
+    out_lines = capsys.readouterr().out.strip().splitlines()
+    record = json.loads(out_lines[-1])  # the round-trip contract
+    assert record["value"] == 1.23
+    assert record["complete"] is True
+    assert "\n" not in out_lines[-1]
+    file_record = json.loads(out_path.read_text())
+    assert file_record["value"] == record["value"]
+    assert file_record["complete"] is True
+
+
 def test_scaled_chunk_targets_probe_seconds_within_clamp():
     bench = _load_bench()
     # 0.015 GB/s link, 4 streams, 12 s target -> ~46 MiB per stream.

@@ -372,6 +372,154 @@ def test_fsck_stats_lists_progress_leftovers(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# tuner-thrashing (ISSUE 7): oscillating knob adjustments in the
+# .tuner-state.json decision log
+# ---------------------------------------------------------------------------
+
+
+def _tuner_decisions(values, tunable="io_concurrency", action="adjust"):
+    """Decision-log records whose applied vector walks ``values``."""
+    return [
+        {
+            "step": i,
+            "decision": {"action": action, "tunable": tunable},
+            "vector": {tunable: v, "staging_threads": 4},
+        }
+        for i, v in enumerate(values)
+    ]
+
+
+def test_tuner_thrashing_rule_flags_a_b_a_cycles():
+    """A -> B -> A inside the thrash window fires, citing the concrete
+    decision-log entries; monotone trajectories and short logs stay
+    silent."""
+    osc = doctor.Evidence(
+        path="x",
+        tuner_state={"decisions": _tuner_decisions([16, 32, 16, 32])},
+        tuner_state_file="/root/.tuner-state.json",
+    )
+    verdicts = [
+        v
+        for v in doctor.diagnose_evidence(osc)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ]
+    assert len(verdicts) == 1
+    ev = verdicts[0].evidence
+    assert ev["tunable"] == "io_concurrency"
+    assert ev["values"] == [16, 32, 16]
+    assert ev["steps"] == [0, 1, 2]
+    assert verdicts[0].source == ".tuner-state.json"
+
+    monotone = doctor.Evidence(
+        path="x",
+        tuner_state={"decisions": _tuner_decisions([16, 32, 64, 64])},
+    )
+    assert [
+        v
+        for v in doctor.diagnose_evidence(monotone)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ] == []
+    # A single adjust -> revert cycle is the revert-on-regression guard
+    # rail working once (the move cools down) — not thrashing. The same
+    # revert-closed cycle RECURRING is.
+    one_revert = _tuner_decisions([16, 32])
+    one_revert += [
+        {
+            "step": 2,
+            "decision": {"action": "revert", "tunable": "io_concurrency"},
+            "vector": {"io_concurrency": 16, "staging_threads": 4},
+        }
+    ]
+    healthy = doctor.Evidence(
+        path="x", tuner_state={"decisions": list(one_revert)}
+    )
+    assert [
+        v
+        for v in doctor.diagnose_evidence(healthy)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ] == []
+    repeated = list(one_revert) + [
+        {
+            "step": 3,
+            "decision": {"action": "adjust", "tunable": "io_concurrency"},
+            "vector": {"io_concurrency": 32, "staging_threads": 4},
+        },
+        {
+            "step": 4,
+            "decision": {"action": "revert", "tunable": "io_concurrency"},
+            "vector": {"io_concurrency": 16, "staging_threads": 4},
+        },
+    ]
+    rep_ev = doctor.Evidence(
+        path="x", tuner_state={"decisions": repeated}
+    )
+    rep_verdicts = [
+        v
+        for v in doctor.diagnose_evidence(rep_ev)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ]
+    assert rep_verdicts and rep_verdicts[0].evidence["cycles_in_window"] >= 2
+    short = doctor.Evidence(
+        path="x", tuner_state={"decisions": _tuner_decisions([16, 32])}
+    )
+    assert [
+        v
+        for v in doctor.diagnose_evidence(short)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ] == []
+    # Oscillation older than the thrash window no longer fires.
+    aged = doctor.Evidence(
+        path="x",
+        tuner_state={
+            "decisions": _tuner_decisions(
+                [16, 32, 16] + [64] * doctor.TUNER_THRASH_WINDOW
+            )
+        },
+    )
+    assert [
+        v
+        for v in doctor.diagnose_evidence(aged)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ] == []
+
+
+def test_tuner_thrashing_end_to_end_injection(tmp_path, capsys):
+    """diagnose over a real manager step: an injected oscillating
+    decision log at the manager root makes the CLI fire with the
+    decision-log evidence; a healthy log stays silent."""
+    root = tmp_path / "ckpt"
+    from torchsnapshot_tpu.manager import CheckpointManager
+
+    mgr = CheckpointManager(str(root))
+    mgr.save(0, {"s": ts.PyTreeState(_state(n=2, size=128))})
+    snap = os.path.join(str(root), "step_0000000000")
+
+    # Healthy (monotone) log at the manager root: silent.
+    (root / ".tuner-state.json").write_text(
+        json.dumps({"decisions": _tuner_decisions([16, 32, 64, 64])})
+    )
+    rules = {v.rule for v in doctor.diagnose_snapshot(snap)}
+    assert names.RULE_TUNER_THRASHING not in rules
+
+    # Injected oscillation: the step-dir diagnosis finds the ROOT's
+    # decision log (parent lookup) and cites it.
+    (root / ".tuner-state.json").write_text(
+        json.dumps({"decisions": _tuner_decisions([16, 32, 16, 32, 16])})
+    )
+    rc = stats_main(["doctor", snap])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert names.RULE_TUNER_THRASHING in out
+    assert "io_concurrency" in out
+    verdicts = [
+        v
+        for v in doctor.diagnose_snapshot(snap)
+        if v.rule == names.RULE_TUNER_THRASHING
+    ]
+    assert verdicts and verdicts[0].evidence["values"][:2] == [16, 32]
+
+
+# ---------------------------------------------------------------------------
 # Bench-trial epistemics (shared with bench.py)
 # ---------------------------------------------------------------------------
 

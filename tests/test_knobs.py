@@ -143,6 +143,60 @@ def test_async_visible_budget_knob() -> None:
     assert knobs.get_async_visible_budget_seconds() == 5.0
 
 
+def test_autotune_kill_switch_knob() -> None:
+    """Suite default (conftest) is "0" = off; the packaged default (no
+    env var) is ON — recurring saves are the tuner's training signal."""
+    assert not knobs.is_autotune_enabled()  # conftest kill switch
+    with knobs.enable_autotune():
+        assert knobs.is_autotune_enabled()
+    assert not knobs.is_autotune_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_AUTOTUNE", None)
+    try:
+        assert knobs.is_autotune_enabled()
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_AUTOTUNE"] = prev
+
+
+def test_memory_budget_fraction_knob() -> None:
+    assert knobs.get_memory_budget_fraction() == 0.6
+    with knobs.override_memory_budget_fraction(0.3):
+        assert knobs.get_memory_budget_fraction() == 0.3
+    assert knobs.get_memory_budget_fraction() == 0.6
+
+
+def test_tuner_override_layer_precedence() -> None:
+    """The chain every tunable getter resolves: env var (operator) >
+    programmatic tuner override > documented default."""
+    assert knobs.get_staging_threads() == 4
+    knobs.set_tuner_override("TORCHSNAPSHOT_TPU_STAGING_THREADS", 9)
+    try:
+        assert knobs.get_staging_threads() == 9
+        # Env always wins over an installed override.
+        with knobs.override_staging_threads(2):
+            assert knobs.get_staging_threads() == 2
+        assert knobs.get_staging_threads() == 9
+    finally:
+        knobs.clear_tuner_overrides()
+    assert knobs.get_staging_threads() == 4
+    assert knobs.get_tuner_overrides() == {}
+
+
+def test_tunable_snapshot_reports_effective_values() -> None:
+    snap = knobs.tunable_snapshot()
+    assert snap["staging_threads"] == 4
+    assert snap["io_concurrency"] == 16
+    assert snap["memory_budget_fraction"] == 0.6
+    knobs.set_tuner_override("TORCHSNAPSHOT_TPU_PER_RANK_IO_CONCURRENCY", 32)
+    try:
+        assert knobs.tunable_snapshot()["io_concurrency"] == 32
+        with knobs.override_per_rank_io_concurrency(8):
+            assert knobs.tunable_snapshot()["io_concurrency"] == 8
+    finally:
+        knobs.clear_tuner_overrides()
+    assert knobs.tunable_snapshot()["io_concurrency"] == 16
+
+
 def test_history_max_records_knob() -> None:
     assert knobs.get_history_max_records() == 0  # conftest zeroes it
     with knobs.override_history_max_records(7):

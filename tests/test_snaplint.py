@@ -302,6 +302,63 @@ def test_knob_env_literal_detects_and_accepts_fix(tmp_path):
     assert fixed.new_findings == []
 
 
+_ENV_OVERRIDE_BAD = """
+import os
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu import knobs as ts_knobs
+from torchsnapshot_tpu.knobs import _STAGING_THREADS_ENV
+
+def threads():
+    # Bypasses the tuner override layer: reads only the env half of
+    # env > set_tuner_override > default.
+    return os.environ.get(knobs._STAGING_THREADS_ENV, "4")
+
+def threads_imported():
+    return os.getenv(_STAGING_THREADS_ENV)
+
+def threads_aliased():
+    # An aliased knobs import must not slip past the rule.
+    return os.environ.get(ts_knobs._STAGING_THREADS_ENV)
+
+def pinned():
+    return knobs._PER_RANK_IO_CONCURRENCY_ENV in os.environ
+"""
+
+_ENV_OVERRIDE_FIXED = """
+import os
+from torchsnapshot_tpu import knobs
+
+def threads():
+    return knobs.get_staging_threads()
+
+def subprocess_env():
+    # Writes stay exempt: shipping the constant to a child env is how
+    # the override context managers legitimately work.
+    os.environ[knobs._STAGING_THREADS_ENV] = "8"
+
+def unrelated_suffix():
+    # A non-knobs _ENV name is out of scope.
+    MY_ENV = "SOMETHING_ELSE"
+    return os.environ.get(MY_ENV)
+"""
+
+
+def test_knob_env_literal_covers_override_layer_constants(tmp_path):
+    """The tuner extension: an env read keyed by a knobs ``_*_ENV``
+    constant (attribute or imported name) outside knobs.py forks the
+    env > tuner-override > default precedence chain."""
+    bad = _run(tmp_path, _ENV_OVERRIDE_BAD, "knob-env-literal")
+    msgs = _messages(bad)
+    assert len(bad.new_findings) == 4, msgs
+    assert any("knobs._STAGING_THREADS_ENV" in m for m in msgs)
+    assert any("_STAGING_THREADS_ENV bypasses" in m for m in msgs)
+    assert any("ts_knobs._STAGING_THREADS_ENV" in m for m in msgs)
+    assert any("_PER_RANK_IO_CONCURRENCY_ENV" in m for m in msgs)
+    assert all("override-aware getter" in m for m in msgs)
+    fixed = _run(tmp_path, _ENV_OVERRIDE_FIXED, "knob-env-literal")
+    assert fixed.new_findings == []
+
+
 def test_knob_env_literal_exempts_knobs_py_and_writes(tmp_path):
     knobs_src = """
 import os

@@ -1,0 +1,354 @@
+"""Write-path autotuner: tunable bounds/steps, env-always-wins
+precedence, policy verdict->direction mapping, decision-log
+round-trips, revert-on-regression, the manager's closed loop, the
+kill switch, and cross-rank decision consistency.
+
+Acceptance pins (ISSUE 7): all ranks apply the same decided values for
+a given step (broadcast via dist_store); a tuner move that makes the
+take worse is reverted to the prior known-good vector on the next step
+(fault-injection); TORCHSNAPSHOT_TPU_AUTOTUNE=0 means no tuner
+reads/writes at all.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.manager import CheckpointManager
+from torchsnapshot_tpu.telemetry import names
+from torchsnapshot_tpu.test_utils import run_multiprocess
+from torchsnapshot_tpu.tuner import (
+    Autotuner,
+    TUNABLES,
+    TunerState,
+    autotuner as autotuner_mod,
+    policy,
+    state as tuner_state,
+    tunables,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_overrides():
+    telemetry.reset_metrics()
+    knobs.clear_tuner_overrides()
+    yield
+    knobs.clear_tuner_overrides()
+    telemetry.reset_metrics()
+
+
+def _state(seed=0, n=2048):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal(n).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Tunables: bounds, steps, env pinning
+# ---------------------------------------------------------------------------
+
+
+def test_tunable_move_is_bounded_and_clamped():
+    t = TUNABLES["staging_threads"]
+    assert t.move(4, +1) == 8
+    assert t.move(4, -1) == 2
+    assert t.move(32, +1) == 32  # clamped at hi
+    assert t.saturated(32, +1)
+    assert t.move(1, -1) == 1  # clamped at lo
+    assert t.saturated(1, -1)
+    # int tunables always move by at least 1 (no rounding stall).
+    slabs = TUNABLES["staging_pool_slabs"]
+    assert slabs.move(3, +1) >= 4
+    frac = TUNABLES["memory_budget_fraction"]
+    assert frac.move(0.6, +1) == pytest.approx(0.75)
+    assert frac.move(0.9, +1) == pytest.approx(0.9)
+
+
+def test_apply_vector_respects_env_and_budget_clamp():
+    with knobs.override_staging_threads(3):
+        applied = tunables.apply_vector(
+            {"staging_threads": 16, "io_concurrency": 32}
+        )
+        # Env-pinned tunable keeps the operator's value; the other
+        # entry lands through the override layer.
+        assert applied["staging_threads"] == 3
+        assert applied["io_concurrency"] == 32
+        assert knobs.get_per_rank_io_concurrency() == 32
+    # Pool geometry never exceeds the process budget it is clamped to.
+    applied = tunables.apply_vector(
+        {
+            "staging_pool_slabs": 4,
+            "staging_pool_slab_bytes": 512 * tunables.MIB,
+        },
+        memory_budget_bytes=256 * tunables.MIB,
+    )
+    assert (
+        applied["staging_pool_slabs"] * applied["staging_pool_slab_bytes"]
+        <= 256 * tunables.MIB
+    )
+    # A budget below slabs x slab-bytes-floor shrinks the slab COUNT
+    # too (the slab-bytes lower bound must not re-overcommit the pool).
+    clamped = tunables.clamp_vector(
+        {
+            "staging_pool_slabs": 4,
+            "staging_pool_slab_bytes": 512 * tunables.MIB,
+        },
+        memory_budget_bytes=40 * tunables.MIB,
+    )
+    assert clamped["staging_pool_slab_bytes"] == 16 * tunables.MIB
+    assert clamped["staging_pool_slabs"] == 2
+    assert (
+        clamped["staging_pool_slabs"] * clamped["staging_pool_slab_bytes"]
+        <= 40 * tunables.MIB
+    )
+
+
+# ---------------------------------------------------------------------------
+# Policy: verdict -> direction table
+# ---------------------------------------------------------------------------
+
+
+def test_policy_maps_verdicts_to_directions():
+    vec = tunables.current_vector()
+    d, _ = policy.decide([names.RULE_BUDGET_STARVED], vec, {}, 0, 0)
+    assert (d.tunable, d.direction) == ("memory_budget_fraction", +1)
+    d, _ = policy.decide([names.RULE_WRITE_TAIL_STALL], vec, {}, 0, 0)
+    assert (d.tunable, d.direction) == ("io_concurrency", +1)
+    d, _ = policy.decide([names.RULE_RETRY_STORM], vec, {}, 0, 0)
+    assert (d.tunable, d.direction) == ("io_concurrency", -1)
+    d, _ = policy.decide([names.RULE_D2H_BOUND], vec, {}, 0, 0)
+    assert d.action == "hold"  # at the ceiling: back off
+    # Priority: a starved take gets its budget fix even when also
+    # d2h-bound.
+    d, _ = policy.decide(
+        [names.RULE_D2H_BOUND, names.RULE_BUDGET_STARVED], vec, {}, 0, 0
+    )
+    assert d.tunable == "memory_budget_fraction"
+
+
+def test_policy_falls_through_saturated_and_cooling_candidates():
+    vec = dict(tunables.current_vector())
+    vec["memory_budget_fraction"] = 0.9  # saturated up
+    d, _ = policy.decide([names.RULE_BUDGET_STARVED], vec, {}, 0, 0)
+    assert d.tunable == "staging_pool_slab_bytes"  # next candidate
+    # A cooling-down move is skipped; beyond the cooldown it is legal
+    # again.
+    cooldowns = {policy.move_key("io_concurrency", +1): 0}
+    d, _ = policy.decide([names.RULE_WRITE_TAIL_STALL], vec, cooldowns, 1, 0)
+    assert (d.tunable, d.direction) == ("max_chunk_size_bytes", -1)
+    d, _ = policy.decide(
+        [names.RULE_WRITE_TAIL_STALL],
+        vec,
+        cooldowns,
+        policy.COOLDOWN_DECISIONS + 1,
+        0,
+    )
+    assert (d.tunable, d.direction) == ("io_concurrency", +1)
+
+
+def test_policy_exploration_round_robin_and_convergence():
+    vec = dict(tunables.current_vector())
+    d, idx = policy.decide([], vec, {}, 0, 0)
+    assert (d.reason, d.tunable) == ("explore", "staging_threads")
+    d, idx = policy.decide([], vec, {}, 1, idx)
+    assert d.tunable == "io_concurrency"
+    d, idx = policy.decide([], vec, {}, 2, idx)
+    assert d.tunable == "staging_pool_slab_bytes"
+    # Everything saturated -> converged hold.
+    maxed = dict(vec)
+    for name in tunables.explore_order():
+        maxed[name] = TUNABLES[name].hi
+    d, _ = policy.decide([], maxed, {}, 3, 0)
+    assert d.action == "hold"
+    assert "converged" in d.reason
+
+
+# ---------------------------------------------------------------------------
+# State: crash-safe decision log
+# ---------------------------------------------------------------------------
+
+
+def test_state_round_trips_and_bounds(tmp_path):
+    root = str(tmp_path)
+    st = TunerState(vector={"staging_threads": 8}, known_good={})
+    for i in range(tuner_state.MAX_DECISIONS + 5):
+        st.record_decision({"step": i, "decision": {"action": "hold"}})
+    path = tuner_state.save_state(root, st)
+    assert path is not None and os.path.basename(path) == ".tuner-state.json"
+    loaded = tuner_state.load_state(root)
+    assert loaded.vector == {"staging_threads": 8}
+    assert len(loaded.decisions) == tuner_state.MAX_DECISIONS
+    assert loaded.decision_count == tuner_state.MAX_DECISIONS + 5
+    # Corrupt state restarts the climb instead of failing a save.
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert tuner_state.load_state(root) is None
+    # Object-store roots have no local decision log.
+    assert tuner_state.state_path_for("s3://bucket/ckpt") is None
+
+
+# ---------------------------------------------------------------------------
+# Autotuner: observe -> decide -> revert-on-regression
+# ---------------------------------------------------------------------------
+
+
+def _fake_report(take_s, mb=128):
+    nbytes = mb * 1024 * 1024
+    return {
+        "kind": "take",
+        "rank": 0,
+        "phases": {"staging": round(take_s * 0.4, 3), "writing": take_s},
+        "bytes_moved": nbytes,
+        "budget_wait_s": 0.0,
+        "retries": {},
+        "mirror": {},
+        "tunables": knobs.tunable_snapshot(),
+    }
+
+
+def test_autotuner_reverts_on_regression_and_cools_down(tmp_path):
+    """Fault injection: the tuner makes a move, the next take is far
+    worse -> the prior known-good vector is restored and the offending
+    move goes on cooldown (the MAD trend math doctor --trend ships)."""
+    root = str(tmp_path)
+    at = Autotuner(root)
+    for step in range(3):
+        at._decide(step, _fake_report(take_s=1.0))
+    st = tuner_state.load_state(root)
+    last = st.decisions[-1]["decision"]
+    assert last["action"] == "adjust"
+    known_good = dict(st.known_good)
+    adjusted_vector = dict(st.vector)
+    assert adjusted_vector != known_good
+
+    vec = at._decide(3, _fake_report(take_s=3.0))  # injected regression
+    st = tuner_state.load_state(root)
+    reverted = st.decisions[-1]["decision"]
+    assert reverted["action"] == "revert"
+    assert reverted["tunable"] == last["tunable"]
+    assert "regression" in reverted["reason"]
+    assert vec == known_good  # the prior known-good vector is back
+    assert st.vector == known_good
+    key = policy.move_key(last["tunable"], last["direction"])
+    assert key in st.cooldowns
+
+
+def test_autotuner_survives_restart_from_state_file(tmp_path):
+    root = str(tmp_path)
+    at = Autotuner(root)
+    at._decide(0, _fake_report(take_s=1.0))
+    saved = tuner_state.load_state(root)
+    fresh = Autotuner(root)  # new process, same root
+    vec = fresh._decide(1, _fake_report(take_s=1.0))
+    st = tuner_state.load_state(root)
+    assert len(st.decisions) == 2
+    assert st.decisions[0]["step"] == 0 and st.decisions[1]["step"] == 1
+    # The climb resumed from the persisted vector (step 0's adjustment
+    # is still present in step 1's decided vector), and the exploration
+    # round-robin continued instead of restarting.
+    first = saved.decisions[-1]["decision"]
+    assert vec[first["tunable"]] == first["to_value"]
+    second = st.decisions[-1]["decision"]
+    assert (second.get("tunable"), second.get("action")) != (
+        first["tunable"],
+        "adjust",
+    ) or second["from_value"] == first["to_value"]
+
+
+# ---------------------------------------------------------------------------
+# Manager closed loop
+# ---------------------------------------------------------------------------
+
+
+def test_manager_closed_loop_records_decisions_and_knob_trajectory(
+    tmp_path,
+):
+    root = str(tmp_path / "ckpt")
+    with knobs.enable_autotune(), knobs.override_history_max_records(16):
+        mgr = CheckpointManager(root, keep_last_n=2)
+        for step in range(3):
+            mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+        state_path = os.path.join(root, ".tuner-state.json")
+        assert os.path.exists(state_path)
+        doc = json.load(open(state_path))
+        assert [d["step"] for d in doc["decisions"]] == [0, 1, 2]
+        for d in doc["decisions"]:
+            assert d["decision"]["action"] in ("adjust", "hold", "revert")
+            assert d["vector"]  # replayable: every record carries it
+        # The take reports and history rows carry the knob snapshot the
+        # step ran under.
+        report = telemetry.last_report("take")
+        assert report.tunables["staging_threads"] >= 1
+        from torchsnapshot_tpu.telemetry import history
+
+        rows = history.load_history(history.history_path_for(root))
+        assert len(rows) == 3
+        assert all(r.get("tunables") for r in rows)
+
+
+def test_kill_switch_means_no_tuner_reads_or_writes(tmp_path):
+    """TORCHSNAPSHOT_TPU_AUTOTUNE=0 (the suite default): no
+    .tuner-state.json, no overrides installed, no autotuner object —
+    the only schema addition anywhere is the report's knob snapshot."""
+    assert not knobs.is_autotune_enabled()
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, keep_last_n=2)
+    for step in range(2):
+        mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+    assert not os.path.exists(os.path.join(root, ".tuner-state.json"))
+    assert knobs.get_tuner_overrides() == {}
+    assert mgr._autotuner is None
+    # The knob snapshot field is recorded either way.
+    assert telemetry.last_report("take").tunables is not None
+
+
+def test_autotuner_holds_without_a_report(tmp_path):
+    at = Autotuner(str(tmp_path))
+    vec = at._decide(0, None)
+    assert vec == tunables.current_vector()
+    assert tuner_state.load_state(str(tmp_path)) is None  # nothing observed
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank consistency (broadcast via dist_store)
+# ---------------------------------------------------------------------------
+
+
+def _rank_consistency_worker(pg, root: str):
+    from torchsnapshot_tpu import knobs as _knobs
+    from torchsnapshot_tpu.tuner import (
+        state as _tuner_state,
+        tunables as _tunables,
+    )
+
+    with _knobs.enable_autotune():
+        mgr = CheckpointManager(root, pg=pg)
+        rng = np.random.default_rng(pg.rank)
+        state = {"w": rng.standard_normal(2048).astype(np.float32)}
+        applied = []
+        for step in range(3):
+            mgr.save(step, {"s": ts.PyTreeState(state)})
+            applied.append(dict(_tunables.current_vector()))
+        st = _tuner_state.load_state(root) if pg.rank == 0 else None
+        decided_steps = [d["step"] for d in st.decisions] if st else None
+        return applied, decided_steps
+
+
+def test_all_ranks_apply_the_same_decided_vector(tmp_path):
+    """Rank 0 decides; the decision is broadcast over the dist_store
+    coordinator and applied identically — ranks never run mixed
+    geometries."""
+    results = run_multiprocess(
+        _rank_consistency_worker, nproc=2, args=(str(tmp_path / "ckpt"),)
+    )
+    assert len(results) == 2
+    vectors = [r[0] for r in results]
+    for step_idx in range(3):
+        assert vectors[0][step_idx] == vectors[1][step_idx], (
+            f"rank vectors diverged at step {step_idx}"
+        )
+    # The loop really ran: rank 0's decision log names every step.
+    assert results[0][1] == [0, 1, 2]

@@ -8,6 +8,13 @@ renames miss it. Flags ``os.environ[...]`` / ``.get`` / ``in
 os.environ`` / ``os.getenv`` whose key is a ``TORCHSNAPSHOT_TPU_``
 literal or a module-level constant bound to one.
 
+The rule also covers the tuner's programmatic override layer: TUNABLE
+knobs resolve env > ``knobs.set_tuner_override`` > default, so an env
+read keyed by one of knobs.py's ``_*_ENV`` name constants (e.g.
+``os.environ.get(knobs._STAGING_THREADS_ENV)``) outside knobs.py is
+flagged too — it would read the env half of the chain and silently
+ignore an applied autotuner value. Go through the knob's getter.
+
 Writes (``os.environ[...] = ...``) are not flagged: the override
 context managers in conftest-adjacent code legitimately set knob vars
 for subprocesses.
@@ -16,13 +23,14 @@ for subprocesses.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, Optional, Set
 
 from ..core import Finding, ModuleInfo, Project, Rule, register
 from .. import scopes
 
 PREFIX = "TORCHSNAPSHOT_TPU_"
 _ENV_READ_METHODS = {"get", "pop", "setdefault", "__contains__"}
+_ENV_CONST_SUFFIX = "_ENV"
 
 
 def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
@@ -46,6 +54,57 @@ def _key_value(expr: ast.AST, consts: Dict[str, str]) -> Optional[str]:
     return None
 
 
+def _knobs_env_imports(tree: ast.Module) -> Set[str]:
+    """Names bound by ``from ...knobs import _X_ENV``-style imports:
+    knob env-var name constants reachable as bare names."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and (node.module or "").endswith(
+            "knobs"
+        ):
+            for alias in node.names:
+                if alias.name.endswith(_ENV_CONST_SUFFIX):
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _knobs_module_aliases(tree: ast.Module) -> Set[str]:
+    """Every local name a knobs module is reachable under:
+    ``import ...knobs [as k]`` and ``from ... import knobs [as k]`` —
+    an aliased import must not slip env-constant reads past the rule."""
+    out: Set[str] = {"knobs"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "knobs" or alias.name.endswith(".knobs"):
+                    out.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "knobs":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _knobs_const_ref(
+    expr: ast.AST, imported_env_names: Set[str], module_aliases: Set[str]
+) -> Optional[str]:
+    """A reference to one of knobs.py's ``_*_ENV`` name constants used
+    as an env-read key: ``<knobs-alias>._X_ENV`` (any name the knobs
+    module was imported under) or a bare name imported from a knobs
+    module. Returns a display string for the message, None otherwise."""
+    if isinstance(expr, ast.Attribute) and expr.attr.endswith(
+        _ENV_CONST_SUFFIX
+    ):
+        chain = scopes.attr_chain(expr)
+        if chain and (
+            chain[-2:-1] == ["knobs"] or chain[0] in module_aliases
+        ):
+            return ".".join(chain)
+    if isinstance(expr, ast.Name) and expr.id in imported_env_names:
+        return expr.id
+    return None
+
+
 def _is_environ(expr: ast.AST) -> bool:
     chain = scopes.attr_chain(expr)
     return bool(chain) and chain[-1] == "environ"
@@ -65,28 +124,33 @@ class KnobEnvLiteral(Rule):
         if module.relpath.endswith("knobs.py"):
             return
         consts = _module_str_constants(module.tree)
+        imported_env_names = _knobs_env_imports(module.tree)
+        module_aliases = _knobs_module_aliases(module.tree)
         for node in ast.walk(module.tree):
             key = None
+            key_expr = None
             if isinstance(node, ast.Call):
                 chain = scopes.call_chain(node)
                 if chain and chain[-1] == "getenv" and node.args:
-                    key = _key_value(node.args[0], consts)
+                    key_expr = node.args[0]
                 elif (
                     isinstance(node.func, ast.Attribute)
                     and node.func.attr in _ENV_READ_METHODS
                     and _is_environ(node.func.value)
                     and node.args
                 ):
-                    key = _key_value(node.args[0], consts)
+                    key_expr = node.args[0]
             elif isinstance(node, ast.Subscript) and _is_environ(node.value):
                 # Reads only: a Store assignment target has ctx=Store.
                 if isinstance(node.ctx, ast.Load):
-                    key = _key_value(node.slice, consts)
+                    key_expr = node.slice
             elif isinstance(node, ast.Compare) and len(node.ops) == 1:
                 if isinstance(
                     node.ops[0], (ast.In, ast.NotIn)
                 ) and _is_environ(node.comparators[0]):
-                    key = _key_value(node.left, consts)
+                    key_expr = node.left
+            if key_expr is not None:
+                key = _key_value(key_expr, consts)
             if key is not None and key.startswith(PREFIX):
                 yield Finding(
                     rule=self.name,
@@ -99,3 +163,20 @@ class KnobEnvLiteral(Rule):
                         f"manager) and call that instead"
                     ),
                 )
+            elif key_expr is not None:
+                const_ref = _knobs_const_ref(
+                    key_expr, imported_env_names, module_aliases
+                )
+                if const_ref is not None:
+                    yield Finding(
+                        rule=self.name,
+                        path=module.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"env read keyed by knobs constant "
+                            f"{const_ref} bypasses the tuner override "
+                            f"layer (env > override > default) — call "
+                            f"the knob's override-aware getter instead"
+                        ),
+                    )

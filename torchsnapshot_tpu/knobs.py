@@ -5,13 +5,25 @@ size, max shard size, slab threshold, batching toggle, per-rank memory budget
 override, partitioner kill-switch), re-homed under the ``TORCHSNAPSHOT_TPU_``
 prefix. Values are read lazily on every call so tests and subprocesses can
 flip them at any time.
+
+Throughput-relevant knobs (the *tunable* set: staging threads, per-rank
+I/O concurrency, staging-pool geometry, memory-budget fraction,
+chunk/shard/slab-threshold sizes) additionally honor a **programmatic
+override layer** — the write surface of the closed-loop autotuner
+(``torchsnapshot_tpu/tuner``). Precedence is fixed: an env var (operator
+intent) always wins; a tuner override applies only where no env var is
+set; the documented default closes the chain. Everything below the env
+var is process-local state — nothing the tuner does leaks into
+subprocesses or survives a restart (the tuner's own decision log does,
+``.tuner-state.json``). See docs/tuning.md.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
-from typing import Generator, Optional
+import threading
+from typing import Dict, Generator, Optional, Union
 
 _MAX_CHUNK_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_MAX_CHUNK_SIZE_BYTES"
 _MAX_SHARD_SIZE_BYTES_ENV = "TORCHSNAPSHOT_TPU_MAX_SHARD_SIZE_BYTES"
@@ -46,6 +58,8 @@ _ASYNC_DEVICE_SNAPSHOT_ENV = "TORCHSNAPSHOT_TPU_ASYNC_DEVICE_SNAPSHOT"
 _STAGING_POOL_SLAB_BYTES_ENV = "TORCHSNAPSHOT_TPU_STAGING_POOL_SLAB_BYTES"
 _STAGING_POOL_SLABS_ENV = "TORCHSNAPSHOT_TPU_STAGING_POOL_SLABS"
 _ASYNC_VISIBLE_BUDGET_ENV = "TORCHSNAPSHOT_TPU_ASYNC_VISIBLE_BUDGET_SECONDS"
+_AUTOTUNE_ENV = "TORCHSNAPSHOT_TPU_AUTOTUNE"
+_MEMORY_BUDGET_FRACTION_ENV = "TORCHSNAPSHOT_TPU_MEMORY_BUDGET_FRACTION"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -62,6 +76,7 @@ _DEFAULT_MAX_SHARD_SIZE_BYTES: int = 512 * 1024 * 1024
 _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES: int = 128 * 1024 * 1024
 _DEFAULT_INCREMENTAL_CHUNK_SIZE_BYTES: int = 16 * 1024 * 1024
 _DEFAULT_RESTORE_FLUSH_BYTES: int = 128 * 1024 * 1024
+_DEFAULT_MEMORY_BUDGET_FRACTION: float = 0.6
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -71,19 +86,86 @@ def _get_int_env(name: str, default: int) -> int:
     return int(val)
 
 
+# ---------------------------------------------------------------------------
+# Programmatic tunable overrides (the autotuner's write surface).
+#
+# Keyed by env-var name so a tuner decision and the operator escape hatch
+# name the same thing. Guarded by a lock: the autotuner applies vectors
+# from async-save commit threads while pipelines read concurrently.
+# ---------------------------------------------------------------------------
+
+_TUNER_OVERRIDES: Dict[str, Union[int, float]] = {}
+_TUNER_OVERRIDES_LOCK = threading.Lock()
+
+
+def set_tuner_override(env_name: str, value: Union[int, float]) -> None:
+    """Install one tunable's programmatic value. Applies only while no
+    env var of the same name is set — env always wins (the operator's
+    hand-set value is the one thing the tuner must never fight)."""
+    with _TUNER_OVERRIDES_LOCK:
+        _TUNER_OVERRIDES[env_name] = value
+
+
+def clear_tuner_override(env_name: str) -> None:
+    with _TUNER_OVERRIDES_LOCK:
+        _TUNER_OVERRIDES.pop(env_name, None)
+
+
+def clear_tuner_overrides() -> None:
+    """Drop every programmatic override (kill switch / test teardown)."""
+    with _TUNER_OVERRIDES_LOCK:
+        _TUNER_OVERRIDES.clear()
+
+
+def get_tuner_overrides() -> Dict[str, Union[int, float]]:
+    """Snapshot of the active programmatic overrides (copy)."""
+    with _TUNER_OVERRIDES_LOCK:
+        return dict(_TUNER_OVERRIDES)
+
+
+def _get_tunable_int(name: str, default: int) -> int:
+    """Override-aware read for tunable knobs: env var > tuner override >
+    default. The accessor every tunable getter routes through (snaplint's
+    knob-env-literal rule keeps direct env reads of tunable names out of
+    the rest of the package, so the precedence chain cannot fork)."""
+    val = os.environ.get(name)
+    if val is not None:
+        return int(val)
+    with _TUNER_OVERRIDES_LOCK:
+        ov = _TUNER_OVERRIDES.get(name)
+    if ov is not None:
+        return int(ov)
+    return default
+
+
+def _get_tunable_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    if val is not None:
+        return float(val)
+    with _TUNER_OVERRIDES_LOCK:
+        ov = _TUNER_OVERRIDES.get(name)
+    if ov is not None:
+        return float(ov)
+    return default
+
+
 def get_max_chunk_size_bytes() -> int:
     """Arrays larger than this are split into chunks written independently."""
-    return _get_int_env(_MAX_CHUNK_SIZE_BYTES_ENV, _DEFAULT_MAX_CHUNK_SIZE_BYTES)
+    return _get_tunable_int(
+        _MAX_CHUNK_SIZE_BYTES_ENV, _DEFAULT_MAX_CHUNK_SIZE_BYTES
+    )
 
 
 def get_max_shard_size_bytes() -> int:
     """Device shards larger than this are subdivided before writing."""
-    return _get_int_env(_MAX_SHARD_SIZE_BYTES_ENV, _DEFAULT_MAX_SHARD_SIZE_BYTES)
+    return _get_tunable_int(
+        _MAX_SHARD_SIZE_BYTES_ENV, _DEFAULT_MAX_SHARD_SIZE_BYTES
+    )
 
 
 def get_slab_size_threshold_bytes() -> int:
     """Write requests smaller than this are eligible for slab batching."""
-    return _get_int_env(
+    return _get_tunable_int(
         _SLAB_SIZE_THRESHOLD_BYTES_ENV, _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES
     )
 
@@ -105,7 +187,7 @@ def is_partitioner_disabled() -> bool:
 
 def get_per_rank_io_concurrency() -> int:
     """Max concurrent storage I/O ops per process (reference: scheduler.py:30)."""
-    return _get_int_env(_PER_RANK_IO_CONCURRENCY_ENV, 16)
+    return _get_tunable_int(_PER_RANK_IO_CONCURRENCY_ENV, 16)
 
 
 def get_s3_endpoint_url() -> Optional[str]:
@@ -117,7 +199,7 @@ def get_s3_endpoint_url() -> Optional[str]:
 def get_staging_threads() -> int:
     """Threads for device->host staging / (de)serialization
     (reference: scheduler.py:29)."""
-    return _get_int_env(_STAGING_THREADS_ENV, 4)
+    return _get_tunable_int(_STAGING_THREADS_ENV, 4)
 
 
 def is_checksums_disabled() -> bool:
@@ -299,7 +381,7 @@ def get_staging_pool_slab_bytes() -> int:
     (scheduler.StagingPool). Together with the slab count this bounds
     the deferred async take's host staging footprint; the pool never
     exceeds the process memory budget it is accounted against."""
-    return _get_int_env(
+    return _get_tunable_int(
         _STAGING_POOL_SLAB_BYTES_ENV, _DEFAULT_STAGING_POOL_SLAB_BYTES
     )
 
@@ -309,7 +391,7 @@ def get_staging_pool_slabs() -> int:
     default of 2 is classic double buffering: one slab's worth of
     requests stages (D2H + serialize) while the previous slab's worth
     drains to storage."""
-    return _get_int_env(_STAGING_POOL_SLABS_ENV, _DEFAULT_STAGING_POOL_SLABS)
+    return _get_tunable_int(_STAGING_POOL_SLABS_ENV, _DEFAULT_STAGING_POOL_SLABS)
 
 
 def get_async_visible_budget_seconds() -> float:
@@ -323,6 +405,47 @@ def get_async_visible_budget_seconds() -> float:
     if val is not None:
         return float(val)
     return _DEFAULT_ASYNC_VISIBLE_BUDGET_SECONDS
+
+
+def is_autotune_enabled() -> bool:
+    """The write-path autotuner's kill switch: set to ``"0"`` and the
+    tuner never runs — no ``.tuner-state.json`` reads/writes, no knob
+    overrides, no cross-rank decision broadcast; behavior is identical
+    to a build without the tuner (pinned by test). Default on: recurring
+    manager saves are the tuner's training signal and the whole point is
+    working without per-environment hand-tuning. Hand-set env knobs are
+    individually respected either way (env always wins per knob)."""
+    return os.environ.get(_AUTOTUNE_ENV, "1") != "0"
+
+
+def get_memory_budget_fraction() -> float:
+    """Fraction of *available* host memory the per-process staging
+    budget may claim (scheduler.get_process_memory_budget_bytes; the
+    historical hard-coded 0.6). Tunable: the autotuner raises it on
+    ``budget-starved`` verdicts and backs off on regression. An explicit
+    TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES override bypasses
+    the fraction entirely, as before."""
+    return _get_tunable_float(
+        _MEMORY_BUDGET_FRACTION_ENV, _DEFAULT_MEMORY_BUDGET_FRACTION
+    )
+
+
+def tunable_snapshot() -> Dict[str, Union[int, float]]:
+    """Effective value of every tunable knob right now (env > tuner
+    override > default) — the ``tunables`` field each SnapshotReport
+    records so a history row / ``doctor --trend`` regression can be
+    correlated with the knob change that caused it. Keys are the short
+    tunable names the tuner's decision log uses (docs/tuning.md)."""
+    return {
+        "staging_threads": get_staging_threads(),
+        "io_concurrency": get_per_rank_io_concurrency(),
+        "staging_pool_slab_bytes": get_staging_pool_slab_bytes(),
+        "staging_pool_slabs": get_staging_pool_slabs(),
+        "memory_budget_fraction": get_memory_budget_fraction(),
+        "max_chunk_size_bytes": get_max_chunk_size_bytes(),
+        "max_shard_size_bytes": get_max_shard_size_bytes(),
+        "slab_size_threshold_bytes": get_slab_size_threshold_bytes(),
+    }
 
 
 def get_prometheus_textfile() -> Optional[str]:
@@ -517,6 +640,45 @@ def override_async_visible_budget_seconds(
     seconds: float,
 ) -> Generator[None, None, None]:
     with _override_env(_ASYNC_VISIBLE_BUDGET_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_autotune() -> Generator[None, None, None]:
+    """Force the autotuner ON for the block (the suite's conftest turns
+    it off process-wide); programmatic overrides installed inside the
+    block are cleared on exit so no tuned geometry leaks into the next
+    test."""
+    with _override_env(_AUTOTUNE_ENV, "1"):
+        try:
+            yield
+        finally:
+            clear_tuner_overrides()
+
+
+@contextlib.contextmanager
+def disable_autotune() -> Generator[None, None, None]:
+    with _override_env(_AUTOTUNE_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_memory_budget_fraction(
+    fraction: float,
+) -> Generator[None, None, None]:
+    with _override_env(_MEMORY_BUDGET_FRACTION_ENV, str(fraction)):
+        yield
+
+
+@contextlib.contextmanager
+def override_staging_threads(n: int) -> Generator[None, None, None]:
+    with _override_env(_STAGING_THREADS_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def override_per_rank_io_concurrency(n: int) -> Generator[None, None, None]:
+    with _override_env(_PER_RANK_IO_CONCURRENCY_ENV, str(n)):
         yield
 
 

@@ -143,6 +143,7 @@ class _PendingManagedSnapshot:
             )
             telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
             self._manager._record_step_history(self._step)
+            self._manager._autotune_step(self._step)
             self._committed = True
         return snapshot
 
@@ -206,6 +207,10 @@ class CheckpointManager:
         # sequence is shared across wrappers of the same pg (pg_wrapper).
         self._pg_arg = pg
         self._pg = PGWrapper(pg)
+        # Lazily-constructed write-path autotuner (tuner/autotuner.py);
+        # stays None while TORCHSNAPSHOT_TPU_AUTOTUNE=0 — the kill
+        # switch means no tuner object, no state file, no broadcast.
+        self._autotuner: Optional[Any] = None
 
     # ------------------------------------------------------------------
     # saving
@@ -262,6 +267,7 @@ class CheckpointManager:
         )
         telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         self._record_step_history(step)
+        self._autotune_step(step)
         return snapshot
 
     @staticmethod
@@ -320,6 +326,34 @@ class CheckpointManager:
         except Exception as e:  # noqa: BLE001 - history is best-effort
             logger.warning(
                 "could not record step %d telemetry history: %r", step, e
+            )
+
+    def _autotune_step(self, step: int) -> None:
+        """One closed-loop tuning pass after ``step`` committed: rank 0
+        reads the step's report, decides the next knob vector, and
+        every rank applies the broadcast decision (tuner/autotuner.py).
+        The TORCHSNAPSHOT_TPU_AUTOTUNE=0 kill switch must be set
+        uniformly across ranks (like every geometry-affecting knob) —
+        with it, this is a pure no-op. Best-effort: tuning must never
+        fail a save."""
+        if not knobs.is_autotune_enabled():
+            return
+        try:
+            if self._autotuner is None:
+                from .tuner import Autotuner
+
+                self._autotuner = Autotuner(self.root)
+            report = None
+            if self._pg.get_rank() == 0:
+                from .telemetry import last_report
+
+                report = last_report(
+                    "take", "async_take", path=self.step_path(step)
+                )
+            self._autotuner.tune_after_step(step, report, self._pg)
+        except Exception as e:  # noqa: BLE001 - tuning is best-effort
+            logger.warning(
+                "autotuner: skipped tuning after step %d: %r", step, e
             )
 
     # ------------------------------------------------------------------

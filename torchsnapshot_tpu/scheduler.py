@@ -81,7 +81,6 @@ def record_phase_timing(phase: str, elapsed_s: float) -> None:
 safe_rate_mb_s = telemetry.safe_rate_mb_s
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES: int = 32 * 1024 * 1024 * 1024
-_AVAILABLE_MEMORY_MULTIPLIER: float = 0.6
 _LOG_LINE_LIMIT = 8
 # Non-fused checksum compute runs inline (on the event loop) below this
 # size: even on the slicing-by-8 software CRC (~0.4 GB/s) 64 KiB stalls
@@ -93,16 +92,21 @@ _INLINE_CHECKSUM_BYTES = 64 * 1024
 def get_process_memory_budget_bytes(pg=None) -> int:
     """Per-process host-memory budget for staging/consuming buffers.
 
-    ``min(available_host_memory * 0.6 / local_world_size, 32 GiB)`` with an
-    env-var override (reference: scheduler.py:45-65). ``local_world_size``
-    counts co-hosted processes via a hostname all-gather on ``pg`` — on TPU
-    pods this is processes per host, not chips per host.
+    ``min(available_host_memory * fraction / local_world_size, 32 GiB)``
+    with an env-var override (reference: scheduler.py:45-65). The
+    fraction defaults to the historical 0.6 and is a tunable knob
+    (TORCHSNAPSHOT_TPU_MEMORY_BUDGET_FRACTION — the autotuner's
+    budget-starved lever). ``local_world_size`` counts co-hosted
+    processes via a hostname all-gather on ``pg`` — on TPU pods this is
+    processes per host, not chips per host.
     """
     override = knobs.get_per_rank_memory_budget_bytes_override()
     if override is not None:
         logger.info("Memory budget manually set to %d bytes", override)
         return override
-    available = int(psutil.virtual_memory().available * _AVAILABLE_MEMORY_MULTIPLIER)
+    available = int(
+        psutil.virtual_memory().available * knobs.get_memory_budget_fraction()
+    )
     local_world_size = 1
     if pg is not None and pg.get_world_size() > 1:
         import socket

@@ -140,6 +140,7 @@ def _emit_snapshot_report(
     nonce: Optional[str],
     error: Optional[BaseException] = None,
     trace_mark: Optional[TraceMark] = None,
+    tunables: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Assemble this rank's SnapshotReport, aggregate across ranks, and
     hand it to the sinks. Best-effort — telemetry must never fail a
@@ -165,6 +166,13 @@ def _emit_snapshot_report(
             counter_deltas=registry.counters_delta_since(counter_baseline),
             mirror=_mirror_state_for(path),
             error=repr(error) if error is not None else None,
+            # The knob values the op actually ran under. Callers capture
+            # the snapshot at op START: an async take's commit thread
+            # emits after the drain, by which time the autotuner may
+            # already have moved the vector for the next step.
+            tunables=(
+                tunables if tunables is not None else knobs.tunable_snapshot()
+            ),
         )
         if (
             nonce
@@ -277,6 +285,7 @@ class Snapshot:
             )
         event_loop = asyncio.new_event_loop()
         counter_baseline = telemetry.metrics().counters_snapshot()
+        tunables_at_start = knobs.tunable_snapshot()
         recorder = _trace_recorder()
         trace_mark = recorder.mark()
         take_span = recorder.begin(
@@ -338,6 +347,7 @@ class Snapshot:
                 counter_baseline=counter_baseline,
                 nonce=commit_nonce,
                 trace_mark=trace_mark,
+                tunables=tunables_at_start,
             )
         except BaseException as e:
             op_error = e
@@ -401,6 +411,7 @@ class Snapshot:
         )
         event_loop = asyncio.new_event_loop()
         counter_baseline = telemetry.metrics().counters_snapshot()
+        tunables_at_start = knobs.tunable_snapshot()
         recorder = _trace_recorder()
         trace_mark = recorder.mark()
         storage = url_to_storage_plugin(path)
@@ -448,6 +459,7 @@ class Snapshot:
             trace_mark=trace_mark,
             progress_tracker=tracker,
             op_begin=op_begin,
+            tunables=tunables_at_start,
         )
 
     @classmethod
@@ -723,6 +735,7 @@ class Snapshot:
         if pg_wrapper.get_world_size() > 1:
             restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
         counter_baseline = telemetry.metrics().counters_snapshot()
+        tunables_at_start = knobs.tunable_snapshot()
         recorder = _trace_recorder()
         trace_mark = recorder.mark()
         restore_span = recorder.begin(
@@ -808,6 +821,7 @@ class Snapshot:
                 counter_baseline=counter_baseline,
                 nonce=restore_nonce,
                 trace_mark=trace_mark,
+                tunables=tunables_at_start,
             )
         except BaseException as e:
             op_error = e
@@ -909,6 +923,7 @@ class Snapshot:
             restore_nonce=restore_nonce,
             counter_baseline=telemetry.metrics().counters_snapshot(),
             trace_mark=trace_mark,
+            tunables=knobs.tunable_snapshot(),
         )
 
     def _load_stateful(
@@ -1374,6 +1389,7 @@ class PendingSnapshot:
         trace_mark: Optional[TraceMark] = None,
         progress_tracker: Optional[_progress.ProgressTracker] = None,
         op_begin: Optional[float] = None,
+        tunables: Optional[Dict[str, Any]] = None,
     ) -> None:
         import threading
 
@@ -1386,6 +1402,10 @@ class PendingSnapshot:
         self._pending_io_work = pending_io_work
         self._counter_baseline = counter_baseline or {}
         self._trace_mark = trace_mark
+        # Effective tunable values captured at async_take entry — the
+        # ones the take ran under, regardless of what the autotuner
+        # applies between now and the commit thread's report emission.
+        self._tunables = tunables
         self._progress_tracker = progress_tracker
         self._exc_info: Optional[BaseException] = None
         self._done = threading.Event()
@@ -1461,6 +1481,7 @@ class PendingSnapshot:
                 counter_baseline=self._counter_baseline,
                 nonce=self.commit_nonce,
                 trace_mark=self._trace_mark,
+                tunables=self._tunables,
             )
         except BaseException as e:  # noqa: BLE001 - must propagate via wait()
             # Record the failure before telling peers: report_error talks to
@@ -1554,6 +1575,7 @@ class PendingRestore:
         restore_nonce: Optional[str] = None,
         counter_baseline: Optional[Dict[str, float]] = None,
         trace_mark: Optional[TraceMark] = None,
+        tunables: Optional[Dict[str, Any]] = None,
     ) -> None:
         import threading
 
@@ -1568,6 +1590,7 @@ class PendingRestore:
         self._world_size = world_size
         self._counter_baseline = counter_baseline or {}
         self._trace_mark = trace_mark
+        self._tunables = tunables
         # Created on the initiating thread; fed and settled by the
         # background read thread.
         self._progress_tracker = _progress.track(
@@ -1706,6 +1729,7 @@ class PendingRestore:
             counter_baseline=self._counter_baseline,
             nonce=None,
             trace_mark=self._trace_mark,
+            tunables=self._tunables,
         )
         # Release the checkpoint-sized host buffers the plans hold; the
         # handle itself may outlive the restore (done()-polling callers).

@@ -72,6 +72,11 @@ RETRY_STORM_ATTEMPTS = 3
 # take never raises a false critical.
 INTERRUPTED_STALE_INTERVALS = 10.0
 INTERRUPTED_STALE_MIN_S = 30.0
+# tuner-thrashing: an A -> B -> A value cycle for one tunable within
+# this many trailing decision-log entries (aligned with the trend
+# window: oscillation slower than the regression baseline can see is
+# indistinguishable from adaptation).
+TUNER_THRASH_WINDOW = 8
 # Bench-trial epistemics (formerly private to bench.py):
 # adjacent probes disagreeing beyond this factor = unstable link;
 # achieved/bracket below this ratio on a stable bracket = in-take stall.
@@ -200,6 +205,10 @@ class Evidence:
     fsck_problems: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list
     )
+    # The write-path autotuner's decision log (.tuner-state.json at the
+    # snapshot dir or its manager root), when one exists.
+    tuner_state: Optional[Dict[str, Any]] = None
+    tuner_state_file: str = ""
 
 
 def gather_evidence(snapshot_path: str) -> Evidence:
@@ -256,6 +265,27 @@ def gather_evidence(snapshot_path: str) -> Evidence:
         ev.mirror_state = mirror_state_for_path(snapshot_path)
     except Exception:  # noqa: BLE001 - mirror state is optional evidence
         pass
+    try:
+        import json as _json
+
+        from ..tuner.state import TUNER_STATE_BASENAME
+        from .sink import local_fs_root
+
+        local = local_fs_root(snapshot_path)
+        if local is not None:
+            # A manager step dir's tuner state lives at the manager
+            # ROOT (the parent); a root diagnosed directly carries it
+            # adjacent. Check both, nearest first.
+            parent = os.path.dirname(os.path.abspath(local))
+            for cand_dir in (local, parent):
+                cand = os.path.join(cand_dir, TUNER_STATE_BASENAME)
+                if os.path.exists(cand):
+                    with open(cand, "r", encoding="utf-8") as f:
+                        ev.tuner_state = _json.load(f)
+                    ev.tuner_state_file = cand
+                    break
+    except Exception as e:  # noqa: BLE001
+        logger.warning("doctor: could not load tuner state: %r", e)
     return ev
 
 
@@ -577,6 +607,77 @@ def _interrupted_take(ev: Evidence):
                 "severity": severity,
             }
         )
+    return out or None
+
+
+@doctor_rule(names.RULE_TUNER_THRASHING, scope="evidence")
+def _tuner_thrashing(ev: Evidence):
+    """The autotuner's decision log shows a tunable cycling A -> B -> A
+    inside the thrash window: the policy is applying and undoing the
+    same move (verdict flapping, or a knob whose effect straddles the
+    regression threshold) instead of converging. Evidence cites the
+    concrete decision-log entries (steps, values, actions) so the
+    operator can pin the oscillating tunable with an env var — env
+    always wins — or widen the knob's cooldown."""
+    st = ev.tuner_state
+    if not st:
+        return None
+    decisions = list(st.get("decisions") or [])[-TUNER_THRASH_WINDOW:]
+    if len(decisions) < 3:
+        return None
+    tunable_names = sorted(
+        {name for d in decisions for name in (d.get("vector") or {})}
+    )
+    out = []
+    for name in tunable_names:
+        series = [
+            (
+                d.get("step"),
+                (d.get("vector") or {}).get(name),
+                (d.get("decision") or {}).get("action"),
+            )
+            for d in decisions
+        ]
+        # Every A -> B -> A value cycle in the window. A SINGLE cycle
+        # closed by a "revert" is the revert-on-regression guard rail
+        # doing its one job (and the move then cools down) — not a
+        # finding; thrashing is a cycle closed by ADJUST decisions
+        # (verdict flapping pushing the knob both ways), or the same
+        # cycle recurring.
+        cycles = []
+        for i in range(len(series) - 2):
+            (s0, a, _), (s1, b, act1), (s2, c, act2) = series[i : i + 3]
+            if a is None or b is None or c is None:
+                continue
+            if a != b and b != c and a == c:
+                cycles.append(
+                    {"steps": [s0, s1, s2], "values": [a, b, c],
+                     "actions": [act1, act2]}
+                )
+        flagged = [c for c in cycles if "revert" not in c["actions"]]
+        if not flagged and len(cycles) >= 2:
+            flagged = cycles
+        if flagged:
+            cyc = flagged[0]
+            a, b, _ = cyc["values"]
+            out.append(
+                {
+                    "summary": (
+                        f"the autotuner is oscillating on {name}: "
+                        f"{a} -> {b} -> {a} within the last "
+                        f"{len(decisions)} decisions"
+                    ),
+                    "evidence": {
+                        "tunable": name,
+                        "steps": cyc["steps"],
+                        "values": cyc["values"],
+                        "actions": cyc["actions"],
+                        "cycles_in_window": len(cycles),
+                        "window": TUNER_THRASH_WINDOW,
+                    },
+                    "source": os.path.basename(ev.tuner_state_file),
+                }
+            )
     return out or None
 
 

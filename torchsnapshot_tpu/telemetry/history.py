@@ -102,6 +102,13 @@ def summarize_report(
         "visible_s": (
             round(report.visible_s, 6) if report.visible_s is not None else None
         ),
+        # The effective tunable-knob values the take ran under: lets a
+        # trend regression be correlated with the knob change that
+        # caused it (the autotuner's decision log cross-references the
+        # same keys).
+        "tunables": (
+            dict(report.tunables) if report.tunables is not None else None
+        ),
         "error": report.error,
     }
 

@@ -168,6 +168,11 @@ RULE_RETRY_STORM = "retry-storm"
 # caller's thread — the regression the device-snapshot path exists to
 # prevent.
 RULE_ASYNC_VISIBLE_STALL = "async-visible-stall"
+# The write-path autotuner is oscillating: a tunable's decision log
+# shows an A -> B -> A value cycle inside the trend window — the policy
+# keeps applying and reverting the same move instead of converging
+# (evidence cites the .tuner-state.json entries).
+RULE_TUNER_THRASHING = "tuner-thrashing"
 # Bench-trial rules (bench.py's former private heuristics): the take's
 # achieved throughput fell below half of a *stable* bracketing probe
 # pair — the slowdown happened inside the take.

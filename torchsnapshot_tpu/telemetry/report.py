@@ -97,6 +97,12 @@ class SnapshotReport:
     # pipeline's host staging — the context an operator needs to read
     # peak_staged_bytes / budget_wait_s on a pool-bounded drain.
     staging_pool: Optional[Dict[str, int]] = None
+    # The *effective* tunable-knob values the operation ran under
+    # (knobs.tunable_snapshot(), captured at op start): env > tuner
+    # override > default, already resolved. Recorded whether or not the
+    # autotuner is on — a history row / doctor --trend regression can
+    # then always be correlated with the knob change that caused it.
+    tunables: Optional[Dict[str, Any]] = None
     retries: Dict[str, float] = dataclasses.field(default_factory=dict)
     mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
     aggregated: Optional[Dict[str, Dict[str, float]]] = None
@@ -181,6 +187,7 @@ def build_report(
     counter_deltas: Dict[str, float],
     mirror: Optional[Dict[str, Any]] = None,
     error: Optional[str] = None,
+    tunables: Optional[Dict[str, Any]] = None,
 ) -> SnapshotReport:
     pipeline = pipeline or {}
     return SnapshotReport(
@@ -210,6 +217,7 @@ def build_report(
             if pipeline.get("staging_pool")
             else None
         ),
+        tunables=dict(tunables) if tunables is not None else None,
         retries=retries_from_deltas(counter_deltas),
         mirror=dict(mirror or {}),
         error=error,
