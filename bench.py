@@ -476,6 +476,38 @@ def run_subprocess_legs() -> None:
             RESULT["protocol_overhead"] = proto
         _emit_partial("protocol_overhead")
 
+    if _have_budget("fanout_restore", 180):
+        # The read-path distributed story: 2-proc restore with fan-out
+        # (each unique saved shard fetched from storage exactly once,
+        # peers fed over the coordination store) vs the every-rank-reads
+        # fallback — wall time plus the fleet read-amplification ratio
+        # (total fetched / unique checkpoint bytes; fallback ~= world,
+        # fan-out ~= 1.0). docs/restore.md.
+        fr = _subprocess_json(
+            "fanout-restore",
+            ("benchmarks", "fanout_restore.py"),
+            ["--mib", "256", "--json"],
+            timeout=420,
+        )
+        if fr is not None:
+            RESULT["fanout_restore"] = fr
+            RESULT["fanout_restore_s"] = fr.get("fanout_restore_s")
+            RESULT["fallback_restore_s"] = fr.get("fallback_restore_s")
+            RESULT["fanout_read_amplification"] = fr.get(
+                "fanout_read_amplification"
+            )
+            RESULT["fallback_read_amplification"] = fr.get(
+                "fallback_read_amplification"
+            )
+            _log(
+                f"bench: fan-out restore {fr.get('fanout_restore_s')} s at "
+                f"{fr.get('fanout_read_amplification')}x fleet read "
+                f"amplification vs fallback "
+                f"{fr.get('fallback_restore_s')} s at "
+                f"{fr.get('fallback_read_amplification')}x"
+            )
+        _emit_partial("fanout_restore")
+
 
 def cold_start_rows() -> None:
     """Restore-to-step0 (BASELINE.md north star): sync restore wall vs
@@ -964,6 +996,21 @@ def main() -> None:
             RESULT["restore_gbps"] = med
             RESULT["restore_gbps_range"] = rng
             RESULT["restore_times_s"] = [round(t, 2) for t in restore_times]
+            # Read amplification of the last timed restore (reshard-on-
+            # read ranged reads should keep fetched ~= needed; the
+            # doctor's restore-read-amplified rule fires past 1.5x).
+            try:
+                from torchsnapshot_tpu import telemetry as _telemetry
+
+                rep = _telemetry.last_report("restore", path=last_snap)
+                if rep is not None and rep.bytes_needed:
+                    RESULT["restore_bytes_needed"] = rep.bytes_needed
+                    RESULT["restore_bytes_fetched"] = rep.bytes_fetched
+                    RESULT["restore_read_amplification"] = round(
+                        (rep.bytes_fetched or 0) / rep.bytes_needed, 3
+                    )
+            except Exception as e:  # noqa: BLE001 - context metric only
+                _log(f"bench: restore amplification read failed: {e!r}")
             if len(h2d_probes) > len(restore_times):
                 _, _, r_eff, r_unstable = _bracketed_efficiency(
                     restore_times, h2d_probes, gib

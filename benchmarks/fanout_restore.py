@@ -1,0 +1,144 @@
+"""Fan-out vs fallback restore timing on a 2-process CPU-mesh fleet.
+
+The read-path half of the BENCH record's distributed story: a sharded
+snapshot is taken once, then restored by a 2-process group twice —
+fan-out ON (each unique saved shard fetched from storage exactly once,
+peers fed over the coordination store) and OFF (every rank reads every
+byte itself) — recording wall time and the fleet read-amplification
+ratio ``total_bytes_fetched / unique_checkpoint_bytes`` (fallback ~=
+world size, fan-out ~= 1.0). Spawned by bench.py's subprocess-leg
+runner; emits one JSON line on stdout.
+
+    python benchmarks/fanout_restore.py --mib 256 --json
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _restore_worker(pg, path, shape, fanout):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu import telemetry
+
+    os.environ["TORCHSNAPSHOT_TPU_FANOUT_RESTORE"] = "1" if fanout else "0"
+    import jax
+
+    dest = {
+        "state": ts.PyTreeState(
+            {"w": jnp.zeros(shape, jnp.float32)}
+        )
+    }
+    jax.block_until_ready(dest["state"].tree)
+    t0 = time.perf_counter()
+    ts.Snapshot(path, pg=pg).restore(dest)
+    jax.block_until_ready(dest["state"].tree)
+    dt = time.perf_counter() - t0
+    report = telemetry.last_report("restore", path=path)
+    row = {
+        "rank": pg.rank,
+        "restore_s": round(dt, 3),
+        "bytes_fetched": report.bytes_fetched if report else None,
+        "bytes_received": report.bytes_received if report else None,
+        "bytes_needed": report.bytes_needed if report else None,
+    }
+    # Integrity spot check, not a benchmark assert: the zero-initialized
+    # destination must have been overwritten end to end.
+    np_dest = np.asarray(dest["state"].tree["w"])
+    assert np_dest[0].any() and np_dest[-1].any()
+    return row
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mib", type=float, default=64.0)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu.knobs import override_max_shard_size_bytes
+    from torchsnapshot_tpu.manifest import sharded_blob_windows
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    devs = jax.devices()
+    ways = min(8, len(devs))
+    cols = 1024
+    rows = max(ways, int(args.mib * 1024 * 1024 / 4 / cols) // ways * ways)
+    shape = (rows, cols)
+    gib = rows * cols * 4 / 1024**3
+    path = os.path.join(
+        tempfile.mkdtemp(prefix="ts-fanout-bench-"), "snap"
+    )
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, shape, jnp.float32) + 1.0
+    xs = jax.device_put(
+        x, NamedSharding(Mesh(np.array(devs[:ways]), ("x",)), P("x"))
+    )
+    # Several shard blobs per device shard so the owner table spreads.
+    with override_max_shard_size_bytes(
+        max(1 << 20, int(rows * cols * 4 / (ways * 4)))
+    ):
+        ts.Snapshot.take(path, {"state": ts.PyTreeState({"w": xs})})
+    del x, xs
+    unique_bytes = sum(
+        hi - lo
+        for lo, hi in sharded_blob_windows(
+            ts.Snapshot(path).metadata.manifest
+        ).values()
+    )
+    log(
+        f"fanout-restore: {gib:.2f} GiB snapshot, "
+        f"{unique_bytes / 1024**2:.0f} MiB unique shard bytes"
+    )
+
+    out = {"state_gib": round(gib, 3), "unique_shard_mib": round(
+        unique_bytes / 1024**2, 1
+    )}
+    for fanout, key_prefix in ((True, "fanout"), (False, "fallback")):
+        t0 = time.perf_counter()
+        rows_out = run_multiprocess(
+            _restore_worker,
+            nproc=2,
+            args=(path, shape, fanout),
+            timeout=600.0,
+        )
+        wall = time.perf_counter() - t0
+        restore_s = max(r["restore_s"] for r in rows_out)
+        fetched = sum(r["bytes_fetched"] or 0 for r in rows_out)
+        out[f"{key_prefix}_restore_s"] = restore_s
+        out[f"{key_prefix}_wall_s"] = round(wall, 3)
+        out[f"{key_prefix}_read_amplification"] = (
+            round(fetched / unique_bytes, 3) if unique_bytes else None
+        )
+        out[f"{key_prefix}_per_rank"] = rows_out
+        log(
+            f"fanout-restore: {key_prefix} restore {restore_s:.2f} s, "
+            f"fleet amplification "
+            f"{out[f'{key_prefix}_read_amplification']}x"
+        )
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,13 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS", "0")
 os.environ.setdefault("TORCHSNAPSHOT_TPU_PROGRESS_SECONDS", "0")
 os.environ.setdefault("TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS", "0")
 
+# Fan-out restore is pinned off in the suite ("0" = every rank reads
+# its own bytes from storage): tier-1 distributed restore tests assert
+# about the exact pre-fan-out read path (which plugin reads happen
+# where, fail-fast windows). Fan-out tests opt back in via
+# knobs.enable_fanout_restore() / an env override in their workers.
+os.environ.setdefault("TORCHSNAPSHOT_TPU_FANOUT_RESTORE", "0")
+
 # The write-path autotuner is likewise off by default in the suite
 # ("0" = kill switch): tier-1 manager tests must run the exact
 # hand-set/default knob geometry they assert about, with no

@@ -158,6 +158,26 @@ def test_autotune_kill_switch_knob() -> None:
             os.environ["TORCHSNAPSHOT_TPU_AUTOTUNE"] = prev
 
 
+def test_fanout_restore_knob() -> None:
+    """Suite default (conftest) is "0" = every-rank-reads; the packaged
+    default (no env var) is ON — single-reader fan-out is the
+    "millions of users" read-path story, and rank 0's reading is
+    broadcast-agreed at restore start so skew can't strand a
+    rendezvous."""
+    assert not knobs.is_fanout_restore_enabled()  # conftest pin
+    with knobs.enable_fanout_restore():
+        assert knobs.is_fanout_restore_enabled()
+    assert not knobs.is_fanout_restore_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_FANOUT_RESTORE", None)
+    try:
+        assert knobs.is_fanout_restore_enabled()
+        with knobs.disable_fanout_restore():
+            assert not knobs.is_fanout_restore_enabled()
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_FANOUT_RESTORE"] = prev
+
+
 def test_memory_budget_fraction_knob() -> None:
     assert knobs.get_memory_budget_fraction() == 0.6
     with knobs.override_memory_budget_fraction(0.3):

@@ -64,6 +64,15 @@ def test_broadcast_object_nondefault_src() -> None:
     assert run_ranks(3, fn) == ["from-1"] * 3
 
 
+def test_agree_object_rank0_decides() -> None:
+    """agree_object: rank 0's value reaches every rank (the blessed
+    knob-to-job-decision laundering primitive — snaplint treats its
+    result as rank-uniform); world-1 passes through."""
+    out = run_ranks(3, lambda pg: pg.agree_object(f"rank{pg.get_rank()}"))
+    assert out == ["rank0"] * 3
+    assert PGWrapper(None).agree_object("solo") == "solo"
+
+
 def test_scatter_object_list() -> None:
     def fn(pg: PGWrapper) -> Any:
         objs = (

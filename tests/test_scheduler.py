@@ -213,6 +213,48 @@ def test_read_pipeline() -> None:
         loop.close()
 
 
+def test_read_pipeline_fetched_byte_accounting() -> None:
+    """classify_read attributes completed reads for the restore
+    reports' read-amplification fields: without a classifier everything
+    counts as fetched; a classifier returning None (cache-served reads,
+    fan-out restore) keeps those bytes out of bytes_fetched while
+    bytes_moved still carries them."""
+    loop = asyncio.new_event_loop()
+    storage = MemoryStoragePlugin(name="read-classify-test")
+    try:
+        for name in ("a", "b"):
+            loop.run_until_complete(
+                storage.write(WriteIO(path=name, buf=name.encode() * 10))
+            )
+        sink: Dict[str, bytes] = {}
+        reqs = [
+            ReadReq(path="a", buffer_consumer=CollectingConsumer(sink, "a", 10)),
+            ReadReq(path="b", buffer_consumer=CollectingConsumer(sink, "b", 10)),
+        ]
+        out = sync_execute_read_reqs(reqs, storage, 10**6, 0, loop)
+        assert out["bytes_fetched"] == 20
+        assert out["bytes_moved"] == 20
+
+        sink.clear()
+        reqs = [
+            ReadReq(path="a", buffer_consumer=CollectingConsumer(sink, "a", 10)),
+            ReadReq(path="b", buffer_consumer=CollectingConsumer(sink, "b", 10)),
+        ]
+        out = sync_execute_read_reqs(
+            reqs,
+            storage,
+            10**6,
+            0,
+            loop,
+            classify_read=lambda r: "fetched" if r.path == "a" else None,
+        )
+        assert out["bytes_fetched"] == 10
+        assert out["bytes_moved"] == 20
+    finally:
+        MemoryStoragePlugin.drop_store("read-classify-test")
+        loop.close()
+
+
 def test_read_pipeline_budget() -> None:
     loop = asyncio.new_event_loop()
     storage = MemoryStoragePlugin(name="read-budget-test")

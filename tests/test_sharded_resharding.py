@@ -16,7 +16,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import torchsnapshot_tpu as ts
 from torchsnapshot_tpu.knobs import override_max_shard_size_bytes
-from torchsnapshot_tpu.parallel.overlap import Box, box_overlap, subdivide_box
+from torchsnapshot_tpu.resharding import (
+    Box,
+    box_overlap,
+    plan_row_slab_reads,
+    row_slab_byte_window,
+    subdivide_box,
+    target_boxes_for_sharding,
+)
 
 
 def _mesh(shape, names):
@@ -223,6 +230,143 @@ def test_subdivide_box() -> None:
     assert sum(p.sizes[0] for p in pieces) == 10
     # 0-d / tiny boxes stay whole.
     assert subdivide_box(Box((), ()), 10, 4) == [Box((), ())]
+
+
+def test_plan_row_slab_reads_geometry() -> None:
+    """The shared row-band planner: trailing-sliced overlaps still ride
+    a banded ranged read (the amplification fix), buffer limits split
+    the band, and whole-shard bands return None (caller's whole read)."""
+    shard = (32, 24)
+    itemsize = 4
+    row_nbytes = 24 * itemsize
+    # A column-partial overlap of rows [8, 16): the band is those rows.
+    ov = box_overlap(Box((0, 0), shard), Box((8, 12), (8, 12)))
+    plan = plan_row_slab_reads(shard, [ov], row_nbytes)
+    assert plan is not None and len(plan) == 1
+    (read,) = plan
+    assert read.rows == (8, 16)
+    assert read.byte_range == (8 * row_nbytes, 16 * row_nbytes)
+    assert read.buf_shape == (8, 24)
+    (copy,) = read.copies
+    assert copy.dst_rows == slice(0, 8)
+    assert copy.src_slices == (slice(0, 8), slice(12, 24))
+    # The strict-slab window helper refuses a trailing-sliced overlap
+    # (the compat bridge's per-piece loads cannot column-slice)...
+    assert row_slab_byte_window(shard, ov, row_nbytes) is None
+    # ...but accepts a full-trailing one, composing with a base offset.
+    full = box_overlap(Box((0, 0), shard), Box((8, 0), (8, 24)))
+    assert row_slab_byte_window(shard, full, row_nbytes, base=100) == (
+        100 + 8 * row_nbytes,
+        100 + 16 * row_nbytes,
+    )
+    # Whole-shard band with no limit: None (one whole read is optimal).
+    whole = box_overlap(Box((0, 0), shard), Box((0, 0), shard))
+    assert plan_row_slab_reads(shard, [whole], row_nbytes) is None
+    # ...unless a buffer limit forces splitting.
+    split = plan_row_slab_reads(
+        shard, [whole], row_nbytes, buffer_limit_bytes=8 * row_nbytes
+    )
+    assert split is not None
+    assert [r.rows for r in split] == [(0, 8), (8, 16), (16, 24), (24, 32)]
+    # 0-d shards never range.
+    assert plan_row_slab_reads((), [whole], itemsize) is None
+
+
+def test_plan_row_slab_reads_roundtrip_matches_direct_copy() -> None:
+    """Property pin: executing a plan's copies against a fake blob
+    reproduces exactly what direct whole-shard slicing would."""
+    rng = np.random.default_rng(7)
+    for _ in range(24):
+        ndim = int(rng.integers(1, 4))
+        shard = tuple(int(rng.integers(1, 9)) for _ in range(ndim))
+        src = rng.standard_normal(shard).astype(np.float32)
+        overlaps = []
+        views = []
+        for _ in range(int(rng.integers(1, 4))):
+            offs = tuple(int(rng.integers(0, s)) for s in shard)
+            sizes = tuple(
+                int(rng.integers(1, s - o + 1)) for s, o in zip(shard, offs)
+            )
+            ov = box_overlap(Box(tuple(0 for _ in shard), shard), Box(offs, sizes))
+            overlaps.append(ov)
+            views.append(np.zeros(sizes, np.float32))
+        row_nbytes = int(np.prod(shard[1:], dtype=np.int64)) * 4
+        plan = plan_row_slab_reads(
+            shard,
+            overlaps,
+            row_nbytes,
+            buffer_limit_bytes=int(rng.integers(1, 5)) * row_nbytes,
+        )
+        if plan is None:
+            for view, ov in zip(views, overlaps):
+                view[...] = src[ov.src_slices]
+        else:
+            blob = src.tobytes()
+            for read in plan:
+                a, b = read.byte_range
+                buf = np.frombuffer(blob[a:b], np.float32).reshape(
+                    read.buf_shape
+                )
+                for copy in read.copies:
+                    views[copy.overlap_index][copy.dst_rows] = buf[
+                        copy.src_slices
+                    ]
+        for view, ov in zip(views, overlaps):
+            np.testing.assert_array_equal(view, src[ov.src_slices])
+
+
+def test_column_partial_destinations_use_ranged_reads(tmp_path) -> None:
+    """A partial destination that slices a saved shard's rows AND
+    columns (the per-rank view of an elastic multi-process restore)
+    must pay a row-banded ranged read, not the whole shard — the read
+    amplification the fan-out path's needed-window math rides on.
+    Before the shared planner, any trailing-sliced overlap fell back to
+    a whole-shard read."""
+    from torchsnapshot_tpu.manifest import ShardedArrayEntry
+    from torchsnapshot_tpu.sharded_io_preparer import ShardedArrayIOPreparer
+    from torchsnapshot_tpu.serialization import array_size_bytes
+
+    sharding = NamedSharding(_mesh((2,), ("x",)), P(None, "x"))  # 2 col shards
+    x = jnp.arange(32 * 24, dtype=jnp.float32).reshape(32, 24)
+    xs = jax.device_put(x, sharding)
+    snap = ts.Snapshot.take(str(tmp_path), {"m": ts.PyTreeState({"w": xs})})
+    entry = snap.get_manifest()["0/m/w"]
+    assert isinstance(entry, ShardedArrayEntry)
+
+    # One rank's destination box: rows [8, 16) of columns [0, 6) — a
+    # row- and column-partial window of the first 32x12 saved shard.
+    saved = entry.shards[0]
+    saved_box = Box(tuple(saved.offsets), tuple(saved.sizes))
+    dst_box = Box((8, 0), (8, 6))
+    ov = box_overlap(saved_box, dst_box)
+    view = np.zeros((8, 6), np.float32)
+    reqs = ShardedArrayIOPreparer._reqs_for_saved_shard(
+        saved, saved_box, [(view, ov)]
+    )
+    assert reqs and all(r.byte_range is not None for r in reqs)
+    fetched = sum(r.byte_range[1] - r.byte_range[0] for r in reqs)
+    whole = array_size_bytes(saved.sizes, saved.array.dtype)
+    # 8 of 32 rows: a quarter of the shard's bytes, not all of them.
+    assert fetched == whole // 4
+    # And the ranged read reconstructs the exact window.
+    import asyncio
+
+    from torchsnapshot_tpu.scheduler import sync_execute_read_reqs
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    loop = asyncio.new_event_loop()
+    sync_execute_read_reqs(
+        reqs, url_to_storage_plugin(str(tmp_path)), 10**7, 0, loop
+    )
+    loop.close()
+    np.testing.assert_array_equal(view, np.asarray(x)[8:16, 0:6])
+
+
+def test_target_boxes_for_sharding_groups_replicas() -> None:
+    sharding = NamedSharding(_mesh((4, 2), ("a", "b")), P(None, "b"))
+    groups = target_boxes_for_sharding(sharding, (16, 8))
+    assert len(groups) == 2  # 2-way column split, replicated 4x
+    assert all(len(devs) == 4 for devs in groups.values())
 
 
 def test_sharded_read_respects_buffer_limit(tmp_path) -> None:

@@ -86,6 +86,44 @@ def sync(store, rank, world):
     assert len(result.new_findings) == 1
 
 
+# The fan-out restore idiom: a knob gates collective work only through
+# a broadcast-agreed value — rank 0's reading reaches every rank, so
+# the guard cannot skew, even though the broadcast's ARGUMENT is a knob
+# read. Agreement results launder both knob and rank taint.
+_COLLECTIVE_AGREED = """
+from torchsnapshot_tpu import knobs
+
+def restore(pg, store, rank, world):
+    if pg.agree_object(knobs.is_fanout_restore_enabled()):
+        store.exchange("fanout/needs", rank, world, {})
+    enabled = pg.broadcast_object(knobs.is_fanout_restore_enabled())
+    if enabled:
+        store.exchange("fanout/blobs", rank, world, None)
+    leader = pg.broadcast_object(rank)
+    if leader:
+        store.barrier("cleanup", rank, world)
+"""
+
+
+def test_collective_rule_launders_broadcast_agreed_guards(tmp_path):
+    agreed = _run(tmp_path, _COLLECTIVE_AGREED, "collective-under-conditional")
+    assert agreed.new_findings == []
+    # ...but a knob guarding the agreement collective itself (or raw
+    # knob taint beside an agreement call) still flags.
+    bad = """
+from torchsnapshot_tpu import knobs
+
+def restore(pg, store, rank, world):
+    if knobs.is_fanout_restore_enabled():
+        pg.broadcast_object({"owners": {}})
+    flag = pg.agree_object(knobs.is_fanout_restore_enabled())
+    if flag and knobs.is_batching_enabled():
+        store.exchange("x", rank, world, None)
+"""
+    result = _run(tmp_path, bad, "collective-under-conditional")
+    assert len(result.new_findings) == 2
+
+
 def test_collective_rule_ignores_uniform_and_unrelated_guards(tmp_path):
     source = """
 def sync(store, rank, world, barrier):

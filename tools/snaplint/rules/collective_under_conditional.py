@@ -16,6 +16,14 @@ above an unconditional collective (same bug, needs a CFG), and guards
 whose skew is provably uniform (``world_size > 1`` is fine and is not
 flagged — world size is not rank/knob taint).
 
+Laundered taint: the result of an agreement collective
+(``broadcast_object`` / ``agree_object`` / ``broadcast``) is
+rank-uniform by construction — every rank gets rank 0's (or src's)
+value — so guards over it cannot skew, even when the broadcast's
+argument was a knob read. ``if pg.agree_object(knobs.is_x()): ...`` is
+the blessed idiom for knob-gating collective work (the fan-out restore
+path's owner-election/broadcast code rides it) and is not flagged.
+
 The modules that *implement* the collectives (``dist_store.py``,
 ``pg_wrapper.py``) are exempt: rank-conditional key traffic inside a
 collective's own implementation is its protocol, not a bug.

@@ -25,6 +25,29 @@ FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 # Call targets whose result depends on this process's rank.
 _RANK_CALLS = {"get_rank", "process_index"}
 
+# Call targets whose RESULT is rank-uniform by construction: a
+# broadcast/agreement collective returns rank 0's (or src's) value on
+# every rank, so a guard over it can never skew a later rendezvous —
+# even when the broadcast's *argument* was a knob read or rank-local.
+# This is the blessed idiom for gating collective work on a knob
+# (``if pg.agree_object(knobs.is_x()): ...``); taint never escapes the
+# agreement call's subtree.
+_AGREEMENT_CALLS = {"broadcast_object", "agree_object", "broadcast"}
+
+
+def _walk_unlaundered(expr: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that skips subtrees rooted at agreement collectives
+    (their results — and therefore their arguments — are laundered)."""
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            chain = call_chain(node)
+            if chain and chain[-1] in _AGREEMENT_CALLS:
+                continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
 
 def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
     parents: Dict[ast.AST, ast.AST] = {}
@@ -106,7 +129,7 @@ def knob_import_names(tree: ast.Module) -> Set[str]:
 
 
 def _expr_has_env_read(expr: ast.AST) -> bool:
-    for node in ast.walk(expr):
+    for node in _walk_unlaundered(expr):
         chain = []
         if isinstance(node, ast.Attribute):
             chain = attr_chain(node)
@@ -129,7 +152,7 @@ def expr_knob_tainted(
     knob_names = knob_names or set()
     if _expr_has_env_read(expr):
         return True
-    for node in ast.walk(expr):
+    for node in _walk_unlaundered(expr):
         if isinstance(node, ast.Call):
             chain = call_chain(node)
             if "knobs" in chain:
@@ -149,7 +172,7 @@ def expr_rank_tainted(
     ``local_rank``) and rank-returning calls (``get_rank()``,
     ``jax.process_index()``)."""
     tainted = tainted or set()
-    for node in ast.walk(expr):
+    for node in _walk_unlaundered(expr):
         terminal = None
         if isinstance(node, ast.Call):
             chain = call_chain(node)

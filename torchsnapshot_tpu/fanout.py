@@ -1,0 +1,362 @@
+"""Elastic fan-out restore: one storage read per unique saved shard.
+
+Without fan-out, every restoring process pulls its bytes from durable
+storage independently — a fleet of N processes pays N storage reads per
+shard (and an object store bills/throttles N GETs). The fix, per
+Orbax's single-reader restore and the cross-replica distribution idea
+of arXiv:2004.13336: read each unique byte window exactly once and
+distribute over the interconnect.
+
+Topology: a deterministic **owner table**
+(``resharding.assign_shard_owners`` over the manifest's eligible shard
+blobs — a pure content hash of the committed manifest, so every rank
+derives the identical table from the identical metadata file; whether
+fan-out runs at all is rank 0's knob reading, broadcast-agreed at
+restore start) maps each unique saved-shard blob to exactly one owner
+rank. Per restore round (one per stateful key in the sync path, one
+covering every plan in the async path), the ranks **exchange** their
+needed byte windows, each owner issues ONE contiguous ranged read of
+the union window per owned-and-needed blob, and the bytes ride
+nonce-keyed coordination-store entries to the needy peers. The read
+pipeline then runs unmodified against a :class:`StoragePlugin` wrapper
+that serves those blobs from the exchanged cache and delegates
+everything else (metadata, checksum tables, dense/object blobs) to the
+real plugin.
+
+The data plane deliberately does NOT use the shared-op-seq ``PGWrapper``
+collectives: every store key is scoped to the restore round's nonce
+prefix, so a rank that dies mid-restore can never leave the op-seq
+counter half-advanced and poison a retry. Every wait polls the round's
+**error key** — the same ``{prefix}/error`` the round's
+:class:`~torchsnapshot_tpu.dist_store.LinearBarrier` poisons via
+``report_error`` — so a peer that fails in planning, fetching, or setup
+aborts the exchange within seconds instead of stranding it for the
+store timeout (the caller's ``_reporting_to`` discipline writes that
+key on any failure).
+
+Kill switch: ``TORCHSNAPSHOT_TPU_FANOUT_RESTORE=0`` (knobs.py;
+broadcast-agreed) restores every-rank-reads behavior exactly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import knobs
+from .dist_store import Store, StoreTimeoutError
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO
+from .manifest import Manifest, sharded_blob_windows
+from .resharding import assign_shard_owners
+
+logger: logging.Logger = logging.getLogger(__name__)
+
+_DEFAULT_TIMEOUT_S = 300.0
+_POLL_INTERVAL_S = 0.005
+
+
+class FanoutError(RuntimeError):
+    """A fan-out round failed on some rank; every participant raises."""
+
+
+class FanoutRestoreContext:
+    """One restore's fan-out state: the owner table, the per-round byte
+    cache, and the fetched/received byte accounting that feeds the
+    restore report's ``bytes_fetched``/``bytes_received``."""
+
+    def __init__(
+        self,
+        owners: Dict[str, int],
+        windows: Dict[str, Tuple[int, int]],
+        store: Optional[Store],
+        rank: int,
+        world_size: int,
+    ) -> None:
+        self.owners = owners
+        self.windows = windows
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        # location -> ((lo, hi) cached window, bytes) for the current
+        # round(s); served through wrap()'s plugin.
+        self.cache: Dict[str, Tuple[Tuple[int, int], bytes]] = {}
+        # This rank's bytes pulled from the storage plugin as an owner /
+        # received from peer owners for its own needs.
+        self.bytes_fetched = 0
+        self.bytes_received = 0
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def build(cls, manifest: Manifest, pg_wrapper: Any) -> "FanoutRestoreContext":
+        """Derive the owner table from the committed global manifest.
+        Pure content-deterministic computation — every rank reads the
+        same metadata file, so every rank derives the same table without
+        a world-sized broadcast; the *enablement* decision is what gets
+        broadcast (rank 0's knob, agreed at restore start)."""
+        windows = sharded_blob_windows(manifest)
+        owners = assign_shard_owners(windows, pg_wrapper.get_world_size())
+        return cls(
+            owners,
+            windows,
+            pg_wrapper.store,
+            pg_wrapper.get_rank(),
+            pg_wrapper.get_world_size(),
+        )
+
+    # ------------------------------------------------------------------
+    # the exchange round
+    # ------------------------------------------------------------------
+
+    def _needs_for(self, read_reqs: List[ReadReq]) -> Dict[str, Tuple[int, int]]:
+        """Union byte window per fan-out-eligible blob this rank's reads
+        touch (the preparer plans one contiguous row band per saved
+        shard, so the union window is what the owner fetches)."""
+        needs: Dict[str, Tuple[int, int]] = {}
+        for req in read_reqs:
+            full = self.windows.get(req.path)
+            if full is None:
+                continue
+            rng = req.byte_range if req.byte_range is not None else full
+            lo, hi = needs.get(req.path, rng)
+            needs[req.path] = (min(lo, int(rng[0])), max(hi, int(rng[1])))
+        return needs
+
+    def _poll(self, key: str, error_key: str, timeout: float) -> bytes:
+        """Wait for ``key``, aborting fast if any peer poisons the
+        round's error key (the ``LinearBarrier.report_error`` channel
+        the enclosing ``_reporting_to`` writes on failure)."""
+        assert self.store is not None
+        deadline = time.monotonic() + timeout
+        while True:
+            err = self.store.try_get(error_key)
+            if err is not None:
+                exc = pickle.loads(err)
+                raise FanoutError(
+                    f"rank {self.rank}: a peer reported an error into the "
+                    f"fan-out round ({error_key!r})"
+                ) from exc
+            val = self.store.try_get(key)
+            if val is not None:
+                return val
+            if time.monotonic() > deadline:
+                raise StoreTimeoutError(
+                    f"rank {self.rank} timed out in fan-out exchange "
+                    f"waiting for {key!r}"
+                )
+            time.sleep(_POLL_INTERVAL_S)
+
+    def exchange(
+        self,
+        read_reqs: List[ReadReq],
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        rendezvous_prefix: str,
+        timeout: float = _DEFAULT_TIMEOUT_S,
+    ) -> List[str]:
+        """One fan-out round under ``rendezvous_prefix`` (the round's
+        error-aware barrier prefix — data keys nest beneath it, and
+        every wait polls its error key). MUST run on every rank, in the
+        same round order, on the thread that owns collective ordering —
+        pass an empty ``read_reqs`` when this rank loads nothing this
+        round. Returns the locations cached for this rank (for
+        :meth:`drop`)."""
+        assert self.store is not None
+        p = f"{rendezvous_prefix}/fanout"
+        error_key = f"{rendezvous_prefix}/error"
+        needs = self._needs_for(read_reqs)
+
+        # Needs gather, rank 0 aggregating (the Store.exchange shape,
+        # re-built here so every wait is error-aware and every key is
+        # round-scoped): each rank publishes its needs; rank 0 combines
+        # and republishes; everyone reads the combined doc.
+        if self.rank == 0:
+            gathered: List[Dict[str, Tuple[int, int]]] = [needs]
+            for peer in range(1, self.world_size):
+                key = f"{p}/needs/{peer}"
+                gathered.append(pickle.loads(self._poll(key, error_key, timeout)))
+                self.store.delete(key)
+            self.store.set(f"{p}/needs/__all", pickle.dumps(gathered))
+        else:
+            self.store.set(f"{p}/needs/{self.rank}", pickle.dumps(needs))
+            gathered = pickle.loads(
+                self._poll(f"{p}/needs/__all", error_key, timeout)
+            )
+        if self.store.add(f"{p}/needs/__all_done", 1) == self.world_size:
+            self.store.delete(f"{p}/needs/__all")
+            self.store.delete(f"{p}/needs/__all_done")
+
+        union: Dict[str, Tuple[int, int]] = {}
+        needy: Dict[str, List[int]] = {}
+        for peer, peer_needs in enumerate(gathered):
+            for loc, (lo, hi) in peer_needs.items():
+                cur = union.get(loc, (lo, hi))
+                union[loc] = (min(cur[0], lo), max(cur[1], hi))
+                needy.setdefault(loc, []).append(peer)
+
+        locs = sorted(union)
+        cached: List[str] = []
+
+        # Phase A — owners fetch every owned-and-needed blob
+        # CONCURRENTLY (one contiguous union-window ranged read each,
+        # I/O-concurrency bounded) and publish each needy peer's OWN
+        # sub-window the moment its read lands. Serializing these
+        # fetches would convoy the whole fleet behind one owner's
+        # serial storage latency; shipping the full union to every
+        # consumer would scale coordinator traffic and per-rank cache
+        # with the union instead of each rank's need.
+        owned = [
+            (idx, loc)
+            for idx, loc in enumerate(locs)
+            if self.owners[loc] == self.rank
+        ]
+        if owned:
+            io_slots = asyncio.Semaphore(
+                max(1, knobs.get_per_rank_io_concurrency())
+            )
+
+            async def _fetch_one(idx: int, loc: str) -> None:
+                lo, hi = union[loc]
+                consumers = [r for r in needy[loc] if r != self.rank]
+                try:
+                    async with io_slots:
+                        read_io = ReadIO(path=loc, byte_range=(lo, hi))
+                        await storage.read(read_io)
+                    if read_io.buf is None:
+                        raise RuntimeError(
+                            f"storage plugin {type(storage).__name__} "
+                            f"completed read() without populating the "
+                            f"buffer for {loc!r}"
+                        )
+                    data = bytes(read_io.buf)
+                except BaseException as e:  # noqa: BLE001 - ship to peers
+                    # The error rides the data channel itself (on top
+                    # of the barrier error key the caller will poison),
+                    # so consumers already polling this blob abort now.
+                    for peer in consumers:
+                        self.store.set(
+                            f"{p}/blob/{idx}/{peer}",
+                            pickle.dumps(("error", None, repr(e))),
+                        )
+                    raise
+                self.bytes_fetched += len(data)
+                for peer in consumers:
+                    plo, phi = gathered[peer][loc]
+                    self.store.set(
+                        f"{p}/blob/{idx}/{peer}",
+                        pickle.dumps(
+                            ("ok", (plo, phi), data[plo - lo : phi - lo])
+                        ),
+                    )
+                if loc in needs:
+                    self.cache[loc] = ((lo, hi), data)
+
+            async def _fetch_owned() -> None:
+                results = await asyncio.gather(
+                    *(_fetch_one(idx, loc) for idx, loc in owned),
+                    return_exceptions=True,
+                )
+                errors = [r for r in results if isinstance(r, BaseException)]
+                if errors:
+                    # Every owned blob settled (data or error marker on
+                    # the wire) before the first failure surfaces.
+                    raise errors[0]
+
+            event_loop.run_until_complete(_fetch_owned())
+            cached.extend(loc for _, loc in owned if loc in needs)
+
+        # Phase B — consume what peers own for us. Strictly this rank's
+        # sub-windows: one key per (blob, consumer), deleted by its
+        # single reader, so nothing lingers in the store and received
+        # bytes equal this rank's actual needs.
+        for idx, loc in enumerate(locs):
+            if self.owners[loc] == self.rank or loc not in needs:
+                continue
+            key = f"{p}/blob/{idx}/{self.rank}"
+            status, window, data = pickle.loads(
+                self._poll(key, error_key, timeout)
+            )
+            self.store.delete(key)
+            if status == "error":
+                raise FanoutError(
+                    f"fan-out restore owner rank {self.owners[loc]} failed "
+                    f"to fetch {loc!r}: {data}"
+                )
+            self.bytes_received += len(data)
+            self.cache[loc] = (tuple(window), data)
+            cached.append(loc)
+        return cached
+
+    def drop(self, locations: List[str]) -> None:
+        """Release a round's cached bytes once its pipeline consumed
+        them (sync restores drop per stateful key; async restores hold
+        the whole plan's cache until the background reads finish)."""
+        for loc in locations:
+            self.cache.pop(loc, None)
+
+    def clear(self) -> None:
+        self.cache.clear()
+
+    # ------------------------------------------------------------------
+    # read-pipeline integration
+    # ------------------------------------------------------------------
+
+    def classify_read(self, req: ReadReq) -> Optional[str]:
+        """Scheduler byte-accounting hook (``execute_read_reqs``): reads
+        served from the exchanged cache are local copies — neither
+        fetched nor received *by the pipeline* (the exchange already
+        accounted them); everything else hit the real plugin."""
+        return None if req.path in self.cache else "fetched"
+
+    def wrap(self, storage: StoragePlugin) -> StoragePlugin:
+        """A plugin view serving cached fan-out blobs and delegating the
+        rest; hand this to the read pipeline in place of ``storage``."""
+        return _FanoutStoragePlugin(storage, self)
+
+
+class _FanoutStoragePlugin(StoragePlugin):
+    """Serves reads of exchanged shard blobs from the fan-out cache;
+    every other operation delegates to the wrapped plugin. Close is NOT
+    delegated — the restore owns the real plugin's lifecycle."""
+
+    def __init__(self, inner: StoragePlugin, ctx: FanoutRestoreContext) -> None:
+        self.inner = inner
+        self.ctx = ctx
+
+    async def read(self, read_io: ReadIO) -> None:
+        entry = self.ctx.cache.get(read_io.path)
+        if entry is None:
+            await self.inner.read(read_io)
+            return
+        (lo, hi), data = entry
+        rng = read_io.byte_range
+        if rng is None:
+            rng = self.ctx.windows[read_io.path]
+        a, b = int(rng[0]), int(rng[1])
+        if a < lo or b > hi:
+            raise FanoutError(
+                f"fan-out cache for {read_io.path!r} holds [{lo}, {hi}) "
+                f"but the read wants [{a}, {b}) — the exchanged union "
+                f"window missed a request (planning bug)"
+            )
+        chunk = data[a - lo : b - lo]
+        if read_io.dest is not None and len(read_io.dest) == len(chunk):
+            read_io.dest[:] = chunk
+            read_io.buf = read_io.dest
+        else:
+            read_io.buf = memoryview(chunk)
+
+    async def write(self, write_io: WriteIO) -> None:  # pragma: no cover
+        await self.inner.write(write_io)
+
+    async def delete(self, path: str) -> None:  # pragma: no cover
+        await self.inner.delete(path)
+
+    async def close(self) -> None:
+        # The wrapped plugin outlives this view; nothing to release.
+        return None

@@ -60,6 +60,7 @@ _STAGING_POOL_SLABS_ENV = "TORCHSNAPSHOT_TPU_STAGING_POOL_SLABS"
 _ASYNC_VISIBLE_BUDGET_ENV = "TORCHSNAPSHOT_TPU_ASYNC_VISIBLE_BUDGET_SECONDS"
 _AUTOTUNE_ENV = "TORCHSNAPSHOT_TPU_AUTOTUNE"
 _MEMORY_BUDGET_FRACTION_ENV = "TORCHSNAPSHOT_TPU_MEMORY_BUDGET_FRACTION"
+_FANOUT_RESTORE_ENV = "TORCHSNAPSHOT_TPU_FANOUT_RESTORE"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -418,6 +419,21 @@ def is_autotune_enabled() -> bool:
     return os.environ.get(_AUTOTUNE_ENV, "1") != "0"
 
 
+def is_fanout_restore_enabled() -> bool:
+    """Single-reader fan-out restore (docs/restore.md): in a multi-rank
+    restore, each unique saved shard blob is fetched from the storage
+    plugin by exactly one owner rank and distributed to the peers that
+    need it over the coordination store's object collectives — a fleet
+    of N restoring processes pays ~1x storage reads instead of Nx. Set
+    to ``"0"`` to fall back to every-rank-reads (each process pulls its
+    own bytes straight from storage — the pre-fan-out behavior, and the
+    right choice when storage bandwidth dwarfs the coordinator link).
+    Rank 0's value decides for the whole job (broadcast-agreed at
+    restore start), so env skew across ranks can never diverge the
+    collective schedule. Single-process restores never fan out."""
+    return os.environ.get(_FANOUT_RESTORE_ENV, "1") != "0"
+
+
 def get_memory_budget_fraction() -> float:
     """Fraction of *available* host memory the per-process staging
     budget may claim (scheduler.get_process_memory_budget_bytes; the
@@ -659,6 +675,21 @@ def enable_autotune() -> Generator[None, None, None]:
 @contextlib.contextmanager
 def disable_autotune() -> Generator[None, None, None]:
     with _override_env(_AUTOTUNE_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def enable_fanout_restore() -> Generator[None, None, None]:
+    """Force fan-out restore ON for the block (the test suite's conftest
+    pins it off so tier-1 restores exercise the exact pre-fan-out read
+    path they assert about; fan-out tests opt back in here)."""
+    with _override_env(_FANOUT_RESTORE_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def disable_fanout_restore() -> Generator[None, None, None]:
+    with _override_env(_FANOUT_RESTORE_ENV, "0"):
         yield
 
 

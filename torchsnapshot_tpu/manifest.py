@@ -414,6 +414,36 @@ class SnapshotMetadata:
         )
 
 
+def sharded_blob_windows(manifest: Manifest) -> Dict[str, Tuple[int, int]]:
+    """Unique storage blobs holding ShardedArray shard payloads eligible
+    for single-reader fan-out: ``location -> [start, end)`` absolute byte
+    window of the shard's bytes within its blob.
+
+    Restricted to dedicated shard blobs (``sharded/...`` path segment,
+    incremental base refs included) holding raw buffer-protocol payloads
+    with no ``byte_range``: a batched-slab member shares its
+    ``batched/{uuid}`` file with arbitrary other entries, so fanning it
+    out would ship unrelated bytes — those reads stay every-rank-local.
+    The window for an eligible blob is always ``(0, nbytes)`` (one shard
+    per file by construction of ``_shard_location``)."""
+    from .serialization import Serializer, array_size_bytes
+
+    out: Dict[str, Tuple[int, int]] = {}
+    for entry in manifest.values():
+        if not isinstance(entry, ShardedArrayEntry):
+            continue
+        for shard in entry.shards:
+            arr = shard.array
+            if (
+                "sharded/" not in arr.location
+                or arr.serializer != Serializer.BUFFER_PROTOCOL.value
+                or arr.byte_range is not None
+            ):
+                continue
+            out[arr.location] = (0, array_size_bytes(arr.shape, arr.dtype))
+    return out
+
+
 def is_replicated(entry: Entry) -> bool:
     return bool(getattr(entry, "replicated", False))
 

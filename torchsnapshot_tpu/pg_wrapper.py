@@ -115,6 +115,17 @@ class PGWrapper:
             self._next_prefix("bc"), self.rank, self.world_size, obj, src
         )
 
+    def agree_object(self, obj: Any) -> Any:
+        """Rank 0 decides, everyone follows: broadcast rank 0's ``obj``
+        and return it on every rank (other ranks' inputs are ignored;
+        world-size-1 returns ``obj`` untouched). The blessed way to turn
+        a knob/env reading into a job-wide decision *before* gating any
+        collective work on it — the result is rank-uniform by
+        construction, so a guard over it can never skew a rendezvous
+        (snaplint's collective-under-conditional rule treats agreement
+        results as laundered taint for exactly this reason)."""
+        return self.broadcast_object(obj)
+
     def scatter_object_list(self, objs: Optional[Sequence[Any]], src: int = 0) -> Any:
         """Rank ``src`` provides one object per rank; each rank receives its
         own. (The reference emulates this over broadcast for NCCL,

@@ -1,0 +1,185 @@
+"""Shared N-d resharding geometry: one home for the shard-overlap algebra.
+
+Every reader of sharded state — the native restore path
+(``sharded_io_preparer.py``), the reference-format compat bridge
+(``tricks/torchsnapshot_reader.py``), and the fan-out restore
+distributor (``fanout.py``) — reasons about the same two geometric
+questions:
+
+- which persisted shard *boxes* overlap which destination boxes
+  (``Box`` / ``box_overlap``, re-exported from ``parallel/overlap.py``),
+- and which contiguous **byte windows** of a persisted blob a set of
+  overlaps actually needs (row-slab planning), so a ranged read can
+  fetch only those bytes instead of the whole shard.
+
+Keeping the byte-window math here (and nowhere else) is what lets the
+bridge and the native path share one definition of "row slab": a fix to
+slab detection applies to both. The planners are pure geometry — no
+I/O types, no dtype strings — so both data models (manifest entries vs
+reference YAML dicts) map onto them.
+
+Row-major invariant: rows ``[row_lo, row_hi)`` of an N-d shard stored
+with the buffer-protocol serializer are one contiguous byte range
+(``row_nbytes`` = itemsize x product of trailing dims). Overlaps that
+slice *trailing* dims still ride a row-banded read — the band's bytes
+contain the needed columns, and the consumer slices them out — which is
+what keeps read amplification near 1.0 for partial destinations instead
+of falling back to whole-shard reads.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .parallel.overlap import Box, Overlap, box_overlap, subdivide_box
+
+__all__ = [
+    "Box",
+    "Overlap",
+    "box_overlap",
+    "subdivide_box",
+    "RowSlabCopy",
+    "RowSlabRead",
+    "plan_row_slab_reads",
+    "row_slab_byte_window",
+    "target_boxes_for_sharding",
+    "assign_shard_owners",
+]
+
+
+def target_boxes_for_sharding(
+    sharding: Any, shape: Sequence[int]
+) -> Dict[Box, List[Any]]:
+    """Destination boxes of an arbitrary jax ``Sharding`` over ``shape``:
+    each locally-addressable device's index window as a :class:`Box`,
+    grouped so replicated / partially-replicated layouts assemble each
+    distinct box once and place it on every device sharing it."""
+    groups: Dict[Box, List[Any]] = {}
+    for device, index in sharding.addressable_devices_indices_map(
+        tuple(int(d) for d in shape)
+    ).items():
+        groups.setdefault(Box.from_index(index, shape), []).append(device)
+    return groups
+
+
+@dataclass(frozen=True)
+class RowSlabCopy:
+    """One copy out of a row-slab read buffer into a destination view.
+
+    ``overlap_index`` names which input overlap this copy feeds;
+    ``dst_rows`` slices dim 0 of that overlap's destination view (the
+    rows of the overlap this slab covers); ``src_slices`` index the read
+    buffer (shape ``(rows,) + shard_trailing_dims``)."""
+
+    overlap_index: int
+    dst_rows: slice
+    src_slices: Tuple[slice, ...]
+
+
+@dataclass(frozen=True)
+class RowSlabRead:
+    """One ranged read of rows ``[rows[0], rows[1])`` of a saved shard:
+    the absolute ``byte_range`` within its blob, the buffer shape the
+    bytes deserialize into, and every overlap copy it feeds."""
+
+    rows: Tuple[int, int]
+    byte_range: Tuple[int, int]
+    buf_shape: Tuple[int, ...]
+    copies: Tuple[RowSlabCopy, ...]
+
+
+def plan_row_slab_reads(
+    shard_sizes: Sequence[int],
+    overlaps: Sequence[Overlap],
+    row_nbytes: int,
+    base: int = 0,
+    buffer_limit_bytes: Optional[int] = None,
+) -> Optional[List[RowSlabRead]]:
+    """Plan ranged row-band reads of one saved shard feeding ``overlaps``.
+
+    The band is the smallest row range ``[row_lo, row_hi)`` covering
+    every overlap; under ``buffer_limit_bytes`` it splits into multiple
+    reads so host memory stays bounded. Returns ``None`` when a single
+    whole-shard read is already optimal (the band spans every row and
+    fits the limit) or when the shard is 0-d — the caller then issues
+    its ordinary whole-blob read.
+
+    Only valid for raw row-major (buffer-protocol) payloads; callers
+    must check the serializer before ranging."""
+    sizes = tuple(int(s) for s in shard_sizes)
+    if not sizes or not overlaps:
+        return None
+    row_lo = min(ov.src_slices[0].start for ov in overlaps)
+    row_hi = max(ov.src_slices[0].stop for ov in overlaps)
+    total = (row_hi - row_lo) * row_nbytes
+    rows_per_read = row_hi - row_lo
+    if buffer_limit_bytes is not None and total > buffer_limit_bytes:
+        rows_per_read = max(1, buffer_limit_bytes // max(1, row_nbytes))
+    if row_lo == 0 and row_hi == sizes[0] and rows_per_read >= row_hi - row_lo:
+        return None
+    reads: List[RowSlabRead] = []
+    for p0 in range(row_lo, row_hi, rows_per_read):
+        p1 = min(p0 + rows_per_read, row_hi)
+        copies: List[RowSlabCopy] = []
+        for idx, ov in enumerate(overlaps):
+            a, b = ov.src_slices[0].start, ov.src_slices[0].stop
+            m0, m1 = max(a, p0), min(b, p1)
+            if m1 <= m0:
+                continue
+            copies.append(
+                RowSlabCopy(
+                    overlap_index=idx,
+                    dst_rows=slice(m0 - a, m1 - a),
+                    src_slices=(slice(m0 - p0, m1 - p0),) + ov.src_slices[1:],
+                )
+            )
+        reads.append(
+            RowSlabRead(
+                rows=(p0, p1),
+                byte_range=(base + p0 * row_nbytes, base + p1 * row_nbytes),
+                buf_shape=(p1 - p0,) + sizes[1:],
+                copies=tuple(copies),
+            )
+        )
+    return reads
+
+
+def row_slab_byte_window(
+    shard_sizes: Sequence[int],
+    overlap: Overlap,
+    row_nbytes: int,
+    base: int = 0,
+) -> Optional[Tuple[int, int]]:
+    """The absolute byte window of ONE overlap's rows, when (and only
+    when) the overlap spans the full extent of every trailing dim — the
+    strict "row slab" the compat bridge ranges on (its per-piece loads
+    cannot column-slice a partial band the way the native consumer
+    does). ``None`` for 0-d shards or trailing-sliced overlaps."""
+    sizes = tuple(int(s) for s in shard_sizes)
+    if not sizes:
+        return None
+    for d in range(1, len(sizes)):
+        s = overlap.src_slices[d]
+        if s.start != 0 or s.stop != sizes[d]:
+            return None
+    r = overlap.src_slices[0]
+    return (base + r.start * row_nbytes, base + r.stop * row_nbytes)
+
+
+def assign_shard_owners(
+    locations: Iterable[str], world_size: int
+) -> Dict[str, int]:
+    """Deterministic owner rank per unique saved-shard blob: stable
+    content hash (CRC32 of the location — ``hash()`` is randomized per
+    process) round-robined over sorted locations so the load balances
+    even for tiny shard sets. Every rank computing this over the same
+    manifest gets the same table; the fan-out path still has rank 0
+    decide and broadcast so a manifest-read race can never skew it."""
+    world = max(1, int(world_size))
+    locs = sorted(set(locations))
+    if not locs:
+        return {}
+    start = zlib.crc32("\n".join(locs).encode("utf-8")) % world
+    return {loc: (start + i) % world for i, loc in enumerate(locs)}

@@ -228,6 +228,12 @@ class _PipelineStats:
         self.done = 0
         self.bytes_moved = 0
         self.bytes_staged = 0
+        # Read pipelines only: how many of the moved bytes were pulled
+        # from the storage plugin itself ("fetched") versus served from
+        # a peer-exchanged cache (fan-out restore; those bytes were
+        # accounted as fetched/received by the exchange that shipped
+        # them, not here). bytes_moved - bytes_fetched = locally-served.
+        self.bytes_fetched = 0
 
 
 # report_phase_done -> the phase the op is IN once that one completed,
@@ -698,6 +704,7 @@ async def execute_read_reqs(
     checksum_table: Optional[ChecksumTable] = None,
     on_req_complete: Optional[Callable[[ReadReq], None]] = None,
     progress: Optional["ProgressTracker"] = None,
+    classify_read: Optional[Callable[[ReadReq], Optional[str]]] = None,
 ) -> dict:
     """Read pipeline: storage read -> deserialize/copy, budgeted by each
     request's consuming cost (reference scheduler.py:357-444). Returns
@@ -707,7 +714,14 @@ async def execute_read_reqs(
     ``on_req_complete`` fires on the event loop after a request's bytes
     are verified and consumed — the hook streaming restore placement
     hangs device_put flushes on while other reads are still in flight.
-    """
+
+    ``classify_read`` attributes each completed request's bytes for the
+    fetched-vs-received accounting restore reports carry: return
+    ``"fetched"`` (the default for every request when no classifier is
+    given) to count the bytes as pulled from the storage plugin, or
+    ``None`` for bytes served from a local cache (fan-out restore's
+    exchanged shards — the exchange already accounted those). The
+    telemetry dict reports the sum as ``bytes_fetched``."""
     budget = MemoryBudget(memory_budget_bytes)
     stats = _PipelineStats()
     stats.pending = len(read_reqs)
@@ -842,6 +856,11 @@ async def execute_read_reqs(
                     stats.staging -= 1
             stats.done += 1
             stats.bytes_moved += buf.nbytes
+            kind = (
+                classify_read(req) if classify_read is not None else "fetched"
+            )
+            if kind == "fetched":
+                stats.bytes_fetched += buf.nbytes
             del buf, read_io
             if on_req_complete is not None:
                 on_req_complete(req)
@@ -868,7 +887,13 @@ async def execute_read_reqs(
             len(read_reqs),
         )
     reporter.report_phase_done("loading")
-    return reporter.pipeline_telemetry()
+    out = reporter.pipeline_telemetry()
+    # Read pipelines always report their plugin-fetched bytes: the
+    # fallback (no classifier) counts every request, so a plain restore's
+    # bytes_fetched equals bytes_moved and the read-amplification math
+    # works whether or not fan-out ran.
+    out["bytes_fetched"] = stats.bytes_fetched
+    return out
 
 
 def sync_execute_read_reqs(
@@ -880,6 +905,7 @@ def sync_execute_read_reqs(
     checksum_table: Optional[ChecksumTable] = None,
     on_req_complete: Optional[Callable[[ReadReq], None]] = None,
     progress: Optional["ProgressTracker"] = None,
+    classify_read: Optional[Callable[[ReadReq], Optional[str]]] = None,
 ) -> dict:
     return event_loop.run_until_complete(
         execute_read_reqs(
@@ -890,5 +916,6 @@ def sync_execute_read_reqs(
             checksum_table=checksum_table,
             on_req_complete=on_req_complete,
             progress=progress,
+            classify_read=classify_read,
         )
     )

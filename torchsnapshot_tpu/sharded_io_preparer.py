@@ -42,7 +42,14 @@ import numpy as np
 
 from .io_types import BufferConsumer, BufferType, ReadReq, WriteReq
 from .manifest import ArrayEntry, Shard, ShardedArrayEntry
-from .parallel.overlap import Box, Overlap, box_overlap, subdivide_box
+from .resharding import (
+    Box,
+    Overlap,
+    box_overlap,
+    plan_row_slab_reads,
+    subdivide_box,
+    target_boxes_for_sharding,
+)
 from .serialization import (
     Serializer,
     array_from_memoryview,
@@ -96,6 +103,15 @@ class _OverlapConsumer(BufferConsumer):
 
     def get_consuming_cost_bytes(self) -> int:
         return array_size_bytes(self.buf_shape, self.dtype)
+
+    def destination_nbytes(self) -> int:
+        """Bytes of destination this consumer actually fills — the
+        read-amplification denominator (``bytes_needed``). Distinct
+        from the consuming cost: a whole-shard read serving a partial
+        destination has a buffer larger than the bytes it delivers,
+        and that gap is exactly what the doctor's
+        ``restore-read-amplified`` rule exists to see."""
+        return sum(int(v.nbytes) for v, _ in self.copies)
 
     def direct_destination(self) -> Optional[memoryview]:
         # Direct read only when this is a straight whole-buffer copy into
@@ -216,8 +232,62 @@ class ShardedArrayIOPreparer:
     # ------------------------------------------------------------------
 
     @staticmethod
+    def _sharding_destination(
+        sharding: Any, shape: Tuple[int, ...], np_dtype: Any
+    ) -> Tuple[
+        Dict[Box, np.ndarray],
+        Callable[..., Any],
+        bool,
+    ]:
+        """Destination boxes + assembler for an arbitrary target
+        ``Sharding`` over ``shape`` — the elastic core: the sharding
+        need not match the one the array was saved under, nor the saved
+        world size (each process only allocates/assembles the boxes its
+        addressable devices cover)."""
+        import jax
+
+        groups = target_boxes_for_sharding(sharding, shape)
+        boxes: Dict[Box, np.ndarray] = {
+            box: np.empty(box.sizes, dtype=np_dtype) for box in groups
+        }
+        device_to_box: Dict[Any, Box] = {
+            device: box for box, devices in groups.items() for device in devices
+        }
+
+        def assemble(
+            filled: Dict[Box, np.ndarray], batch=None, on_done=None
+        ) -> Any:
+            # One batched H2D dispatch for all shards (a per-device
+            # device_put loop pays per-call dispatch latency 8x over);
+            # with a shared ``batch`` the shards ride the restore-wide
+            # dispatch instead, and assembly defers until it runs.
+            devices = list(device_to_box)
+            if batch is not None and on_done is not None:
+                slots = [
+                    batch.put(filled[device_to_box[d]], d) for d in devices
+                ]
+                batch.defer(
+                    lambda: on_done(
+                        jax.make_array_from_single_device_arrays(
+                            shape, sharding, [s.value for s in slots]
+                        )
+                    )
+                )
+                return _DEFERRED
+            arrays = jax.device_put(
+                [filled[device_to_box[d]] for d in devices], devices
+            )
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays
+            )
+
+        return boxes, assemble, True
+
+    @staticmethod
     def _destination_boxes(
-        entry: ShardedArrayEntry, current_leaf: Any
+        entry: ShardedArrayEntry,
+        current_leaf: Any,
+        target_sharding: Optional[Any] = None,
     ) -> Tuple[
         Dict[Box, np.ndarray],
         Optional[Callable[[Dict[Box, np.ndarray]], Any]],
@@ -227,7 +297,9 @@ class ShardedArrayIOPreparer:
         assembler back to the application's leaf flavor, plus whether the
         buffers are framework-allocated (owned) — only owned buffers may be
         direct-read targets; a user's in-place array must keep
-        copy-on-success semantics so a failed restore never tears it."""
+        copy-on-success semantics so a failed restore never tears it.
+        An explicit ``target_sharding`` wins over the current leaf's
+        layout (restore-into-a-new-topology without a template leaf)."""
         from .serialization import string_to_dtype
 
         np_dtype = string_to_dtype(entry.dtype)
@@ -235,9 +307,12 @@ class ShardedArrayIOPreparer:
 
         from .io_preparer import is_jax_array
 
-        if is_jax_array(current_leaf):
-            import jax
+        if target_sharding is not None:
+            return ShardedArrayIOPreparer._sharding_destination(
+                target_sharding, shape, np_dtype
+            )
 
+        if is_jax_array(current_leaf):
             sharding = current_leaf.sharding
             target_shape = tuple(current_leaf.shape)
             if target_shape != shape:
@@ -245,14 +320,6 @@ class ShardedArrayIOPreparer:
                     f"Cannot reshard a saved array of shape {list(shape)} "
                     f"into a leaf of shape {list(target_shape)}"
                 )
-            indices = sharding.addressable_devices_indices_map(shape)
-            boxes: Dict[Box, np.ndarray] = {}
-            device_to_box: Dict[Any, Box] = {}
-            for device, index in indices.items():
-                box = Box.from_index(index, shape)
-                if box not in boxes:
-                    boxes[box] = np.empty(box.sizes, dtype=np_dtype)
-                device_to_box[device] = box
 
             # Uncommitted destination leaves (e.g. optax step counters
             # created by plain jnp ops) must stay uncommitted — the same
@@ -260,45 +327,26 @@ class ShardedArrayIOPreparer:
             # concrete device makes the restored state unusable in a jit
             # alongside differently-placed arrays. An uncommitted array is
             # single-device by construction, so it has exactly one box.
-            if not getattr(current_leaf, "_committed", True) and len(boxes) == 1:
+            if not getattr(current_leaf, "_committed", True):
+                groups = target_boxes_for_sharding(sharding, shape)
+                if len(groups) == 1:
+                    boxes = {
+                        box: np.empty(box.sizes, dtype=np_dtype)
+                        for box in groups
+                    }
 
-                def assemble_uncommitted(
-                    filled: Dict[Box, np.ndarray], batch=None, on_done=None
-                ) -> Any:
-                    import jax.numpy as jnp
+                    def assemble_uncommitted(
+                        filled: Dict[Box, np.ndarray], batch=None, on_done=None
+                    ) -> Any:
+                        import jax.numpy as jnp
 
-                    return jnp.asarray(next(iter(filled.values())))
+                        return jnp.asarray(next(iter(filled.values())))
 
-                return boxes, assemble_uncommitted, True
+                    return boxes, assemble_uncommitted, True
 
-            def assemble(
-                filled: Dict[Box, np.ndarray], batch=None, on_done=None
-            ) -> Any:
-                # One batched H2D dispatch for all shards (a per-device
-                # device_put loop pays per-call dispatch latency 8x over);
-                # with a shared ``batch`` the shards ride the restore-wide
-                # dispatch instead, and assembly defers until it runs.
-                devices = list(device_to_box)
-                if batch is not None and on_done is not None:
-                    slots = [
-                        batch.put(filled[device_to_box[d]], d) for d in devices
-                    ]
-                    batch.defer(
-                        lambda: on_done(
-                            jax.make_array_from_single_device_arrays(
-                                shape, sharding, [s.value for s in slots]
-                            )
-                        )
-                    )
-                    return _DEFERRED
-                arrays = jax.device_put(
-                    [filled[device_to_box[d]] for d in devices], devices
-                )
-                return jax.make_array_from_single_device_arrays(
-                    shape, sharding, arrays
-                )
-
-            return boxes, assemble, True
+            return ShardedArrayIOPreparer._sharding_destination(
+                sharding, shape, np_dtype
+            )
 
         # Host destination (np.ndarray in-place, or fresh allocation).
         if isinstance(current_leaf, np.ndarray):
@@ -328,14 +376,17 @@ class ShardedArrayIOPreparer:
         path: str,
         buffer_size_limit_bytes: Optional[int] = None,
         dest_owned: Optional[bool] = None,
+        target_sharding: Optional[Any] = None,
     ) -> Tuple[List[ReadReq], Optional[Callable[[], None]]]:
         """Build resharding reads into ``restored[path]``; the returned
         finalize callback must run after the reads complete. ``dest_owned``
         overrides the derived ownership (a caller reading into a buffer it
         allocated itself may declare it framework-owned to keep direct
-        reads)."""
+        reads). ``target_sharding`` restores under an arbitrary jax
+        ``Sharding`` — any layout, any world size — regardless of what
+        ``current_leaf`` is (the template-free elastic entry point)."""
         boxes, assemble, derived_owned = ShardedArrayIOPreparer._destination_boxes(
-            entry, current_leaf
+            entry, current_leaf, target_sharding=target_sharding
         )
         if dest_owned is None:
             dest_owned = derived_owned
@@ -377,63 +428,47 @@ class ShardedArrayIOPreparer:
     ) -> List[ReadReq]:
         """Reads for one saved shard feeding all its overlap regions.
 
-        When every overlap spans full trailing dims (the dominant
-        row-sharded resharding pattern), the read shrinks to the covered
-        row range and — under a buffer size limit — splits into multiple
-        ranged reads so host memory stays bounded. Overlaps that slice
-        trailing dims fall back to one whole-shard read (a partial-column
-        read is not a contiguous byte range)."""
+        The read shrinks to the smallest row band covering every overlap
+        (``resharding.plan_row_slab_reads`` — the shared geometry the
+        compat bridge ranges with too) and — under a buffer size limit —
+        splits into multiple ranged reads so host memory stays bounded.
+        Overlaps that slice *trailing* dims still ride the row band: the
+        band's bytes contain the needed columns and the consumer slices
+        them out, so a partial destination never pays a whole-shard read
+        just because it is column-partial (read amplification stays near
+        1.0 for the dominant dim-0 resharding pattern, and at one row
+        band otherwise). A band spanning the whole shard degenerates to
+        the single whole-blob read it always was."""
         entry = saved.array
         shard_shape = tuple(saved_box.sizes)
 
-        full_trailing = shard_shape and all(
-            ov.src_slices[1:] == tuple(slice(0, s) for s in shard_shape[1:])
-            for _, ov in overlaps
-        )
-
-        if full_trailing:
-            row_lo = min(ov.src_slices[0].start for _, ov in overlaps)
-            row_hi = max(ov.src_slices[0].stop for _, ov in overlaps)
-            row_bytes = array_size_bytes(shard_shape[1:], entry.dtype)
-            total = (row_hi - row_lo) * row_bytes
-            rows_per_read = row_hi - row_lo
-            if buffer_size_limit_bytes is not None and total > buffer_size_limit_bytes:
-                rows_per_read = max(1, buffer_size_limit_bytes // max(1, row_bytes))
-            if row_lo > 0 or row_hi < shard_shape[0] or rows_per_read < (
-                row_hi - row_lo
-            ):
-                base = entry.byte_range_tuple[0] if entry.byte_range_tuple else 0
-                reqs = []
-                for p0 in range(row_lo, row_hi, rows_per_read):
-                    p1 = min(p0 + rows_per_read, row_hi)
-                    copies = []
-                    for dst_view, ov in overlaps:
-                        a, b = ov.src_slices[0].start, ov.src_slices[0].stop
-                        m0, m1 = max(a, p0), min(b, p1)
-                        if m1 <= m0:
-                            continue
-                        copies.append(
-                            (
-                                dst_view[m0 - a : m1 - a],
-                                (slice(m0 - p0, m1 - p0),) + ov.src_slices[1:],
-                            )
-                        )
-                    reqs.append(
-                        ReadReq(
-                            path=entry.location,
-                            buffer_consumer=_OverlapConsumer(
-                                entry.dtype,
-                                (p1 - p0,) + shard_shape[1:],
-                                copies,
-                                dest_owned=dest_owned,
-                            ),
-                            byte_range=(
-                                base + p0 * row_bytes,
-                                base + p1 * row_bytes,
-                            ),
-                        )
-                    )
-                return reqs
+        plan = None
+        if shard_shape and entry.serializer == Serializer.BUFFER_PROTOCOL.value:
+            plan = plan_row_slab_reads(
+                shard_shape,
+                [ov for _, ov in overlaps],
+                row_nbytes=array_size_bytes(shard_shape[1:], entry.dtype),
+                base=entry.byte_range_tuple[0] if entry.byte_range_tuple else 0,
+                buffer_limit_bytes=buffer_size_limit_bytes,
+            )
+        if plan is not None:
+            views = [dst_view for dst_view, _ in overlaps]
+            return [
+                ReadReq(
+                    path=entry.location,
+                    buffer_consumer=_OverlapConsumer(
+                        entry.dtype,
+                        read.buf_shape,
+                        [
+                            (views[c.overlap_index][c.dst_rows], c.src_slices)
+                            for c in read.copies
+                        ],
+                        dest_owned=dest_owned,
+                    ),
+                    byte_range=read.byte_range,
+                )
+                for read in plan
+            ]
 
         copies = [(dst_view, ov.src_slices) for dst_view, ov in overlaps]
         return [

@@ -122,6 +122,34 @@ def _reporting_to(barrier: Optional["LinearBarrier"], what: str):
         raise
 
 
+def _req_needed_bytes(req: Any) -> int:
+    """One read request's contribution to ``bytes_needed`` — the bytes
+    of destination it fills. Consumers that may read more than they
+    deliver (a whole-shard read feeding a partial destination) expose
+    ``destination_nbytes``; for everything else the consuming cost IS
+    the destination size."""
+    consumer = req.buffer_consumer
+    fn = getattr(consumer, "destination_nbytes", None)
+    return int(fn()) if fn is not None else int(
+        consumer.get_consuming_cost_bytes()
+    )
+
+
+def _merge_fanout_telemetry(pipeline: Optional[dict], fanout_ctx) -> None:
+    """Fold a fan-out context's byte accounting into a restore's merged
+    pipeline telemetry: the owner-side union-window fetches (which ran
+    in the exchange, outside any pipeline) add to ``bytes_fetched``, and
+    peer-shipped bytes become ``bytes_received``."""
+    if fanout_ctx is None or pipeline is None:
+        return
+    pipeline["bytes_fetched"] = (
+        int(pipeline.get("bytes_fetched", 0)) + fanout_ctx.bytes_fetched
+    )
+    pipeline["bytes_received"] = (
+        int(pipeline.get("bytes_received", 0)) + fanout_ctx.bytes_received
+    )
+
+
 def _mirror_state_for(path: str) -> Dict[str, Any]:
     """The process mirror's queue/lag state, for reports about tiered
     paths ({} otherwise): at take-report time the step's upload job was
@@ -732,8 +760,16 @@ class Snapshot:
         # current key's barrier abandon instead of blocking out the full
         # store timeout.
         restore_nonce = None
+        fanout_agreed = False
         if pg_wrapper.get_world_size() > 1:
-            restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+            # The fan-out enablement rides the nonce broadcast: ONE
+            # agreement collective, before any failure point, so rank
+            # 0's knob reading decides for the whole job (env skew can
+            # never diverge the schedule) and a later setup failure can
+            # never leave the shared op-seq counter half-advanced.
+            restore_nonce, fanout_agreed = pg_wrapper.broadcast_object(
+                (uuid.uuid4().hex, knobs.is_fanout_restore_enabled())
+            )
         counter_baseline = telemetry.metrics().counters_snapshot()
         tunables_at_start = knobs.tunable_snapshot()
         recorder = _trace_recorder()
@@ -769,28 +805,67 @@ class Snapshot:
             keys = _gather_keys(app_state, pg_wrapper)
             memory_budget_bytes = get_process_memory_budget_bytes(pg_wrapper)
             setup_barrier = key_barrier(0) if keys else None
+            fanout_ctx = None
             with _reporting_to(setup_barrier, "restore setup"):
                 available = get_manifest_for_rank(self.metadata, rank)
                 checksum_table = self._get_checksum_table(storage, event_loop)
+                # Single-reader fan-out (docs/restore.md): enablement was
+                # broadcast-agreed above; the owner table is derived
+                # deterministically from the committed manifest (same
+                # bytes on every rank), inside the error-aware setup
+                # window like every other failure-prone setup read.
+                if fanout_agreed:
+                    from .fanout import FanoutRestoreContext
+
+                    fanout_ctx = FanoutRestoreContext.build(
+                        self.metadata.manifest, pg_wrapper
+                    )
+                    if not fanout_ctx.owners:
+                        fanout_ctx = None  # nothing shard-shaped to fan out
             for i, key in enumerate(keys):
                 stateful = app_state.get(key)
                 if key == rng_key:
                     stateful = None  # restored last, below
                 barrier = key_barrier(i)
                 with _reporting_to(barrier, "restore"):
+                    # Plan first so the fan-out exchange (a round every
+                    # rank runs in the same order, plan or no plan)
+                    # knows this rank's needed byte windows. The
+                    # exchange's waits poll THIS round's barrier error
+                    # key, so a peer failing anywhere in this block
+                    # aborts the round in seconds (_reporting_to writes
+                    # that key on the way out).
+                    plan = None
                     if stateful is not None:
-                        self._load_stateful(
-                            key=key,
-                            stateful=stateful,
-                            available=available,
-                            storage=storage,
-                            memory_budget_bytes=memory_budget_bytes,
-                            event_loop=event_loop,
-                            rank=rank,
-                            checksum_table=checksum_table,
-                            pipeline_sink=pipeline_sink,
-                            progress_tracker=tracker,
+                        plan = self._plan_stateful_load(
+                            key, stateful, available, memory_budget_bytes
                         )
+                    round_locs: List[str] = []
+                    if fanout_ctx is not None:
+                        round_locs = fanout_ctx.exchange(
+                            plan.read_reqs if plan is not None else [],
+                            storage,
+                            event_loop,
+                            rendezvous_prefix=(
+                                f"__restore/{restore_nonce}/{i}"
+                            ),
+                        )
+                    try:
+                        if plan is not None:
+                            self._execute_load_plan(
+                                plan,
+                                storage=storage,
+                                memory_budget_bytes=memory_budget_bytes,
+                                event_loop=event_loop,
+                                rank=rank,
+                                checksum_table=checksum_table,
+                                pipeline_sink=pipeline_sink,
+                                progress_tracker=tracker,
+                                fanout_ctx=fanout_ctx,
+                            )
+                    finally:
+                        if fanout_ctx is not None:
+                            fanout_ctx.drop(round_locs)
                 if barrier is not None:
                     barrier.arrive()
                     barrier.depart()
@@ -813,11 +888,13 @@ class Snapshot:
                 )
             event_loop.run_until_complete(storage.close())
             recorder.end(restore_span)
+            pipeline = telemetry.merge_pipeline_telemetry(pipeline_sink)
+            _merge_fanout_telemetry(pipeline, fanout_ctx)
             _emit_snapshot_report(
                 kind="restore",
                 path=self.path,
                 pg_wrapper=pg_wrapper,
-                pipeline=telemetry.merge_pipeline_telemetry(pipeline_sink),
+                pipeline=pipeline,
                 counter_baseline=counter_baseline,
                 nonce=restore_nonce,
                 trace_mark=trace_mark,
@@ -875,10 +952,16 @@ class Snapshot:
         # of stranding inside a plain op-seq barrier (where a reported
         # error is invisible) for the full store timeout.
         restore_nonce = None
+        fanout_agreed = False
         if pg_wrapper.get_world_size() > 1:
             import uuid
 
-            restore_nonce = pg_wrapper.broadcast_object(uuid.uuid4().hex)
+            # Fan-out enablement rides the nonce broadcast (one
+            # agreement collective before any failure point; rank 0's
+            # knob decides for the job) — same shape as the sync path.
+            restore_nonce, fanout_agreed = pg_wrapper.broadcast_object(
+                (uuid.uuid4().hex, knobs.is_fanout_restore_enabled())
+            )
 
         def plan_barrier(i: int) -> Optional[LinearBarrier]:
             if restore_nonce is None:
@@ -911,6 +994,47 @@ class Snapshot:
                 barrier.arrive()
                 barrier.depart()
 
+        # Single-reader fan-out, async flavor: the exchange is a
+        # cross-rank rendezvous, so it runs HERE — on the calling
+        # thread, after every plan exists — covering all plans in one
+        # round; the owner-side unique-shard fetches land in this
+        # (visible) span and the background pipeline then reads them
+        # from the cache (no rendezvous off the main thread). The
+        # round's error-aware barrier keeps a failing rank from
+        # stranding its peers in the exchange.
+        fanout_ctx = None
+        if fanout_agreed:
+            exchange_prefix = f"__restore/{restore_nonce}/fanout"
+            exchange_barrier = _nonce_barrier(exchange_prefix, pg_wrapper)
+            with _reporting_to(exchange_barrier, "fan-out exchange"):
+                from .fanout import FanoutRestoreContext
+
+                fanout_ctx = FanoutRestoreContext.build(
+                    self.metadata.manifest, pg_wrapper
+                )
+                if fanout_ctx.owners:
+                    reqs = [
+                        r for plan in plans.values() for r in plan.read_reqs
+                    ]
+                    exchange_loop = asyncio.new_event_loop()
+                    try:
+                        exchange_storage = url_to_storage_plugin(self.path)
+                        try:
+                            fanout_ctx.exchange(
+                                reqs,
+                                exchange_storage,
+                                exchange_loop,
+                                rendezvous_prefix=exchange_prefix,
+                            )
+                        finally:
+                            exchange_loop.run_until_complete(
+                                exchange_storage.close()
+                            )
+                    finally:
+                        exchange_loop.close()
+                else:
+                    fanout_ctx = None  # nothing shard-shaped to fan out
+
         return PendingRestore(
             path=self.path,
             keys=keys,
@@ -924,6 +1048,7 @@ class Snapshot:
             counter_baseline=telemetry.metrics().counters_snapshot(),
             trace_mark=trace_mark,
             tunables=knobs.tunable_snapshot(),
+            fanout_ctx=fanout_ctx,
         )
 
     def _load_stateful(
@@ -943,13 +1068,45 @@ class Snapshot:
         allocated in its current state dict as read destinations so peak
         footprint stays ~1x (reference snapshot.py:668-766).
         ``pipeline_sink`` collects the read pipeline's telemetry for the
-        caller's SnapshotReport."""
+        caller's SnapshotReport. Plan + execute in one call, with no
+        fan-out — the entry point for loads outside the shared barrier
+        schedule (the RNG stateful, restored rank-locally last)."""
         plan = self._plan_stateful_load(
             key, stateful, available, memory_budget_bytes
         )
         if plan is None:
             return
+        self._execute_load_plan(
+            plan,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes,
+            event_loop=event_loop,
+            rank=rank,
+            checksum_table=checksum_table,
+            pipeline_sink=pipeline_sink,
+            progress_tracker=progress_tracker,
+        )
+
+    def _execute_load_plan(
+        self,
+        plan: "_StatefulLoadPlan",
+        storage: StoragePlugin,
+        memory_budget_bytes: int,
+        event_loop: asyncio.AbstractEventLoop,
+        rank: int,
+        checksum_table=None,
+        pipeline_sink: Optional[List[dict]] = None,
+        progress_tracker: Optional[_progress.ProgressTracker] = None,
+        fanout_ctx=None,
+    ) -> None:
+        """Run one planned stateful load's read pipeline and apply it.
+        With ``fanout_ctx`` (an exchange for this plan already ran), the
+        pipeline reads exchanged shard blobs from the fan-out cache and
+        only the rest from the real plugin."""
         read_reqs = plan.read_reqs
+        # The rank's pre-batching destination bytes — the denominator of
+        # the read-amplification metric restore reports carry.
+        bytes_needed = sum(_req_needed_bytes(r) for r in read_reqs)
         if knobs.is_batching_enabled():
             from .batcher import batch_read_requests
 
@@ -960,14 +1117,20 @@ class Snapshot:
         placer.register_plan(plan)
         pipeline_telemetry = sync_execute_read_reqs(
             read_reqs=read_reqs,
-            storage=storage,
+            storage=(
+                fanout_ctx.wrap(storage) if fanout_ctx is not None else storage
+            ),
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
             event_loop=event_loop,
             checksum_table=checksum_table,
             on_req_complete=placer.on_req_complete,
             progress=progress_tracker,
+            classify_read=(
+                fanout_ctx.classify_read if fanout_ctx is not None else None
+            ),
         )
+        pipeline_telemetry["bytes_needed"] = bytes_needed
         if pipeline_sink is not None:
             pipeline_sink.append(pipeline_telemetry)
         placer.flush()
@@ -1082,9 +1245,24 @@ class Snapshot:
         path: str,
         obj_out: Optional[Any] = None,
         memory_budget_bytes: Optional[int] = None,
+        sharding: Optional[Any] = None,
     ) -> Any:
         """Random access to a single object by manifest path
-        ``"RANK/STATEFUL/KEY..."`` (reference snapshot.py:507-612)."""
+        ``"RANK/STATEFUL/KEY..."`` (reference snapshot.py:507-612).
+
+        ``sharding`` places a ShardedArray entry directly under an
+        arbitrary jax ``Sharding`` — any layout, any world size,
+        no template leaf needed (reshard-on-read, docs/restore.md);
+        only the byte windows overlapping this process's addressable
+        devices are read. Mutually exclusive with ``obj_out`` — an
+        in-place destination defines its own layout, and silently
+        preferring one would leave the other untouched."""
+        if sharding is not None and obj_out is not None:
+            raise ValueError(
+                "read_object: pass either obj_out (in-place restore into "
+                "your array) or sharding (fresh placement under a target "
+                "Sharding), not both"
+            )
         rank_str, _, logical_path = path.partition("/")
         try:
             rank = int(rank_str)
@@ -1126,10 +1304,21 @@ class Snapshot:
                     restored,
                     result_path,
                     buffer_size_limit_bytes=memory_budget_bytes,
+                    target_sharding=sharding,
                 )
             else:
                 assert isinstance(entry, (ArrayEntry, ChunkedArrayEntry))
                 dst, convert, owned = _restore_destination(entry, obj_out)
+                if sharding is not None and obj_out is None:
+                    import jax
+
+                    target = sharding
+
+                    def convert(
+                        host: np.ndarray, batch=None, _t=target
+                    ) -> Any:
+                        return jax.device_put(host, _t)
+
                 read_reqs = prepare_read(
                     entry,
                     obj_out=dst,
@@ -1576,6 +1765,7 @@ class PendingRestore:
         counter_baseline: Optional[Dict[str, float]] = None,
         trace_mark: Optional[TraceMark] = None,
         tunables: Optional[Dict[str, Any]] = None,
+        fanout_ctx=None,
     ) -> None:
         import threading
 
@@ -1591,6 +1781,10 @@ class PendingRestore:
         self._counter_baseline = counter_baseline or {}
         self._trace_mark = trace_mark
         self._tunables = tunables
+        # Fan-out cache populated by the calling-thread exchange; the
+        # background pipeline serves exchanged shard blobs from it (no
+        # collectives off the main thread — the bytes already moved).
+        self._fanout_ctx = fanout_ctx
         # Created on the initiating thread; fed and settled by the
         # background read thread.
         self._progress_tracker = _progress.track(
@@ -1617,6 +1811,7 @@ class PendingRestore:
             read_reqs = [
                 r for plan in self._plans.values() for r in plan.read_reqs
             ]
+            bytes_needed = sum(_req_needed_bytes(r) for r in read_reqs)
             if knobs.is_batching_enabled():
                 from .batcher import batch_read_requests
 
@@ -1630,16 +1825,28 @@ class PendingRestore:
             placer = _StreamingPlacer()
             for plan in self._plans.values():
                 placer.register_plan(plan)
+            fanout_ctx = self._fanout_ctx
             self._pipeline_telemetry = sync_execute_read_reqs(
                 read_reqs=read_reqs,
-                storage=storage,
+                storage=(
+                    fanout_ctx.wrap(storage)
+                    if fanout_ctx is not None
+                    else storage
+                ),
                 memory_budget_bytes=self._memory_budget_bytes,
                 rank=self._rank,
                 event_loop=event_loop,
                 checksum_table=checksum_table,
                 on_req_complete=placer.on_req_complete,
                 progress=self._progress_tracker,
+                classify_read=(
+                    fanout_ctx.classify_read
+                    if fanout_ctx is not None
+                    else None
+                ),
             )
+            self._pipeline_telemetry["bytes_needed"] = bytes_needed
+            _merge_fanout_telemetry(self._pipeline_telemetry, fanout_ctx)
             placer.flush()
             # Whatever didn't stream (flush disabled, zero-read leaves)
             # places in one final batched device_put spanning all plans
@@ -1654,6 +1861,10 @@ class PendingRestore:
             self._exc_info = e
             logger.error("Async restore failed: %r", e)
         finally:
+            # Release the exchanged shard bytes whether or not the reads
+            # succeeded; the handle may outlive the restore.
+            if self._fanout_ctx is not None:
+                self._fanout_ctx.clear()
             self._progress_tracker.finish(self._exc_info)
             _trace_recorder().end(reads_span)
             event_loop.close()

@@ -72,6 +72,9 @@ RETRY_STORM_ATTEMPTS = 3
 # take never raises a false critical.
 INTERRUPTED_STALE_INTERVALS = 10.0
 INTERRUPTED_STALE_MIN_S = 30.0
+# restore-read-amplified: the restore's per-plugin/storage read bytes
+# exceed the manifest-needed bytes by this factor.
+READ_AMPLIFIED_FACTOR = 1.5
 # tuner-thrashing: an A -> B -> A value cycle for one tunable within
 # this many trailing decision-log entries (aligned with the trend
 # window: oscillation slower than the regression baseline can see is
@@ -468,6 +471,56 @@ def _async_visible_stall(report: Dict[str, Any]):
             "wall_s": max((float(v) for v in phases.values()), default=0.0),
         },
         "severity": "warning",
+    }
+
+
+@doctor_rule(names.RULE_RESTORE_READ_AMPLIFIED)
+def _restore_read_amplified(report: Dict[str, Any]):
+    """The restore pulled far more bytes from storage than its read plan
+    needed (``bytes_fetched`` vs ``bytes_needed`` report fields; older
+    reports fall back to the per-plugin read-byte counters): whole-shard
+    reads serving partial destinations, fan-out disabled in a wide
+    fleet (every rank fetching every shard), or retry-driven re-reads.
+    docs/restore.md documents the metric and the fan-out fix."""
+    if report.get("kind") not in ("restore", "async_restore"):
+        return None
+    needed = report.get("bytes_needed")
+    if not needed:
+        return None
+    if report.get("bytes_received"):
+        # A fan-out restore ran: an owner rank legitimately fetches its
+        # peers' windows on top of its own needs, so the per-rank
+        # fetched/needed ratio pages on healthy skew. Fan-out restores
+        # are judged at fleet level (total fetched / unique checkpoint
+        # bytes — bench.py's fanout_restore leg records it).
+        return None
+    fetched = report.get("bytes_fetched")
+    source = "report"
+    if fetched is None:
+        fetched = sum(
+            float(p.get("read_bytes", 0.0))
+            for p in (report.get("plugins") or {}).values()
+        )
+        source = "plugin-counters"
+    fetched = float(fetched)
+    needed = float(needed)
+    if fetched <= READ_AMPLIFIED_FACTOR * needed:
+        return None
+    return {
+        "summary": (
+            "the restore read more bytes from storage than its plan "
+            "needed: partial destinations are paying whole-shard (or "
+            "every-rank) reads — fan-out restore / ranged reads would "
+            "cut this to ~1x"
+        ),
+        "evidence": {
+            "bytes_fetched": int(fetched),
+            "bytes_needed": int(needed),
+            "bytes_received": report.get("bytes_received"),
+            "amplification": round(fetched / needed, 3),
+            "threshold_factor": READ_AMPLIFIED_FACTOR,
+            "fetched_from": source,
+        },
     }
 
 

@@ -173,6 +173,12 @@ RULE_ASYNC_VISIBLE_STALL = "async-visible-stall"
 # keeps applying and reverting the same move instead of converging
 # (evidence cites the .tuner-state.json entries).
 RULE_TUNER_THRASHING = "tuner-thrashing"
+# A restore's storage reads exceeded what the manifest said it needed
+# by the amplification threshold: whole-shard reads serving partial
+# destinations, a dead fan-out (every rank fetching every shard), or
+# re-reads — the report's bytes_fetched/bytes_needed fields carry the
+# ratio.
+RULE_RESTORE_READ_AMPLIFIED = "restore-read-amplified"
 # Bench-trial rules (bench.py's former private heuristics): the take's
 # achieved throughput fell below half of a *stable* bracketing probe
 # pair — the slowdown happened inside the take.

@@ -97,6 +97,18 @@ class SnapshotReport:
     # pipeline's host staging — the context an operator needs to read
     # peak_staged_bytes / budget_wait_s on a pool-bounded drain.
     staging_pool: Optional[Dict[str, int]] = None
+    # Restore pipelines only (None elsewhere): the read-amplification
+    # triple. ``bytes_needed`` is what this rank's read plan had to fill
+    # (pre-batching consuming costs); ``bytes_fetched`` is what it
+    # actually pulled from the storage plugin (fan-out owners fetch each
+    # unique saved shard once); ``bytes_received`` is what arrived from
+    # peer owners over the coordination store instead. Fan-out restores
+    # record bytes_fetched < bytes_needed on non-owner ranks; a fallback
+    # restore reads its own bytes, so fetched ~= needed. The doctor's
+    # ``restore-read-amplified`` rule keys off these fields.
+    bytes_fetched: Optional[int] = None
+    bytes_received: Optional[int] = None
+    bytes_needed: Optional[int] = None
     # The *effective* tunable-knob values the operation ran under
     # (knobs.tunable_snapshot(), captured at op start): env > tuner
     # override > default, already resolved. Recorded whether or not the
@@ -147,6 +159,11 @@ def merge_pipeline_telemetry(
         out["peak_staged_bytes"] = max(
             out["peak_staged_bytes"], p.get("peak_staged_bytes", 0)
         )
+        # Read-amplification accounting (read pipelines only): present
+        # in the fold exactly when some pipeline carried it.
+        for key in ("bytes_fetched", "bytes_received", "bytes_needed"):
+            if key in p:
+                out[key] = out.get(key, 0) + int(p[key])
     out["budget_wait_s"] = round(out["budget_wait_s"], 6)
     return out
 
@@ -215,6 +232,21 @@ def build_report(
         staging_pool=(
             dict(pipeline["staging_pool"])
             if pipeline.get("staging_pool")
+            else None
+        ),
+        bytes_fetched=(
+            int(pipeline["bytes_fetched"])
+            if pipeline.get("bytes_fetched") is not None
+            else None
+        ),
+        bytes_received=(
+            int(pipeline["bytes_received"])
+            if pipeline.get("bytes_received") is not None
+            else None
+        ),
+        bytes_needed=(
+            int(pipeline["bytes_needed"])
+            if pipeline.get("bytes_needed") is not None
             else None
         ),
         tunables=dict(tunables) if tunables is not None else None,

@@ -335,7 +335,7 @@ class ReferenceSnapshotReader:
         """
         import jax
 
-        from ..parallel.overlap import Box, box_overlap
+        from ..resharding import Box, box_overlap
 
         if rank is None:
             rank_str, _, logical = path.partition("/")
@@ -382,25 +382,23 @@ class ReferenceSnapshotReader:
             source blob, composing with any byte_range the entry already
             has (batched slabs). The common FSDP dim-0 resharding case
             then moves only the overlapping rows from storage instead of
-            whole source shards. Same invariant as the native restore's
-            per-shard ranged reads (sharded_io_preparer.py, reqs-for-
-            saved-shard) — a fix to slab detection there likely applies
-            here too (the data models differ: reference entry dicts vs
-            native read reqs)."""
+            whole source shards. The window math itself is the shared
+            slab geometry (``resharding.row_slab_byte_window``) the
+            native restore ranges with — one definition, so slab
+            detection cannot diverge between the bridge and the core
+            path; only the reference-dict plumbing (serializer tag,
+            torch dtype strings) lives here."""
+            from ..resharding import row_slab_byte_window
+
             sbox, tentry = boxes[i]
-            if tentry.get("serializer") != "buffer_protocol" or not sbox.sizes:
+            if tentry.get("serializer") != "buffer_protocol":
                 return None
-            for d in range(1, sbox.ndim):
-                s = ov.src_slices[d]
-                if s.start != 0 or s.stop != sbox.sizes[d]:
-                    return None
             row_bytes = _np_dtype(tentry["dtype"]).itemsize
             for d in range(1, sbox.ndim):
                 row_bytes *= sbox.sizes[d]
             base = tentry.get("byte_range")
             base = int(base[0]) if base else 0
-            r = ov.src_slices[0]
-            return (base + r.start * row_bytes, base + r.stop * row_bytes)
+            return row_slab_byte_window(sbox.sizes, ov, row_bytes, base)
 
         # Plan overlaps up front. Row-slab overlaps become ranged reads
         # (no full source piece is ever loaded for them); the rest load
