@@ -57,7 +57,12 @@ Leg order and what each contributes:
    ``cold_restore_gbps``/``cold_restore_efficiency`` is the
    hardware-limit figure.
 5. Incremental unchanged-state save and the on-TPU async-take stall
-   split, budget-gated context fields.
+   split, budget-gated context fields. The steady-state autotune leg
+   and the preemption-recovery leg additionally run with the goodput
+   ledger on and record ``RESULT.goodput`` (run-level overhead
+   fraction, recovery cost, storage bytes/step from
+   ``telemetry/goodput.py``) — BENCH_r06+ carries run-level numbers,
+   not just per-op medians.
 
 After a full default run the result is written into BENCH.md's
 BENCH_SIGNAL_OF_RECORD block (single source of truth —
@@ -551,6 +556,95 @@ def cold_start_rows() -> None:
         shutil.rmtree(snap_dir, ignore_errors=True)
 
 
+def _ledger_goodput(root: str) -> dict:
+    """Run-level goodput fields for a RESULT leg, read from the leg's
+    run ledger (telemetry/goodput.py): the overhead fraction, recovery
+    cost, and storage bytes/step the per-op medians cannot show. {}
+    when the ledger is disabled or empty (fail-soft context data)."""
+    try:
+        from torchsnapshot_tpu.telemetry import goodput as ts_goodput
+
+        analysis = ts_goodput.analyze_root(root)
+        run = ts_goodput.latest_run(analysis) if analysis else None
+        if run is None:
+            return {}
+        storage = analysis["storage"]
+        return {
+            "overhead_fraction": run["overhead_fraction"],
+            "wall_s": round(run["wall_s"], 3),
+            "train_s": round(run["train_s"], 3),
+            "visible_stall_s": round(run["visible_stall_s"], 3),
+            "restore_s": round(run["restore_s"], 3),
+            "lost_work_s": round(run["lost_work_s"], 3),
+            "lost_steps": run["lost_steps"],
+            "recovery_cost_s": round(
+                sum(i["recovery_cost_s"] for i in run["interruptions"]), 3
+            ),
+            "interruptions": len(run["interruptions"]),
+            "steps_committed": run["steps_committed"],
+            "storage_bytes_per_step": storage["bytes_per_retained_step"],
+            "incremental_reuse_ratio": storage["incremental_reuse_ratio"],
+        }
+    except Exception as e:  # noqa: BLE001 - context data, fail-soft
+        _log(f"bench: goodput summary failed: {e!r}")
+        return {}
+
+
+def preemption_leg(workdir: str, total_bytes: int, est_take_s: float) -> None:
+    """Leg 8: preemption recovery cost, ledger-accounted.
+
+    A manager runs a short save-every-other-step loop with the run
+    ledger on; a preemption notice lands AFTER the last save and the
+    grace window is 'missed' (no coordinated save commits), so the
+    trailing work is genuinely lost; a fresh manager then restores.
+    ``RESULT.preemption.goodput`` carries what the fleet actually pays
+    for that interruption — lost work + restore time — from the same
+    ledger records the doctor's ``recovery-cost-high`` rule cites.
+    Quarter-size state: this leg measures recovery accounting, not
+    link bandwidth (the headline legs own that)."""
+    nb = max(total_bytes // 4, 32 * 1024 * 1024)
+    est = est_take_s / 2 + 5
+    if not _have_budget("preemption", est * 3):
+        return
+    from torchsnapshot_tpu.preemption import PreemptionSaver
+
+    root = os.path.join(workdir, "preempt")
+    try:
+        mgr = ts.CheckpointManager(root, keep_last_n=2)
+        saver = PreemptionSaver(signals=(), ledger_root=root)
+        state = make_state(nb, seed=97)
+        try:
+            for step in range(4):
+                if step % 2 == 0:
+                    mgr.save(step, {"state": ts.PyTreeState(state)})
+                if step == 3:
+                    # Eviction notice after the step-2 save; the agreed
+                    # save misses the grace window (we never call
+                    # mgr.save for it), so step 3's work is lost.
+                    saver.request_save()
+                    saver.should_save(step)
+        finally:
+            saver.uninstall()
+        dest = make_state(nb, seed=97)
+        t0 = time.perf_counter()
+        mgr2 = ts.CheckpointManager(root, keep_last_n=2)
+        restored = mgr2.restore_latest({"state": ts.PyTreeState(dest)})
+        restore_s = time.perf_counter() - t0
+        del state, dest
+        RESULT["preemption"] = {
+            "restored_step": restored,
+            "restore_s": round(restore_s, 3),
+            "goodput": _ledger_goodput(root),
+        }
+        _log(
+            f"bench: preemption leg restored step {restored} in "
+            f"{restore_s:.2f}s; goodput {RESULT['preemption']['goodput']}"
+        )
+    except Exception as e:  # noqa: BLE001 - context leg, fail-soft
+        _log(f"bench: preemption leg failed: {e!r}")
+    _emit_partial("preemption")
+
+
 def steady_state_leg(
     workdir: str,
     total_bytes: int,
@@ -628,6 +722,11 @@ def steady_state_leg(
             "final_efficiency": round(effs[-1], 3) if effs else None,
             "knob_trajectory": knob_traj,
             "decisions": decisions,
+            # Run-level accounting from the leg's ledger: the fraction
+            # of THIS multi-take run's wall time that checkpointing
+            # ate, and the storage spend per retained step — BENCH_r06+
+            # carries run-level numbers, not just per-op medians.
+            "goodput": _ledger_goodput(root),
         }
         if effs:
             RESULT["steady_state_final_efficiency"] = round(effs[-1], 3)
@@ -1143,6 +1242,17 @@ def main() -> None:
         steady_state_leg(
             workdir, total_bytes, gib, probe_streams, link_est, est_take_s
         )
+
+        # ---- Leg 8: preemption recovery cost (ledger-accounted) ----
+        preemption_leg(workdir, total_bytes, est_take_s)
+        RESULT["goodput"] = {
+            "steady_state": (RESULT.get("steady_state") or {}).get(
+                "goodput", {}
+            ),
+            "preemption": (RESULT.get("preemption") or {}).get(
+                "goodput", {}
+            ),
+        }
 
     finally:
         shutil.rmtree(workdir, ignore_errors=True)

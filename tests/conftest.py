@@ -31,6 +31,12 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_WATCHDOG_SECONDS", "0")
 os.environ.setdefault("TORCHSNAPSHOT_TPU_PROGRESS_SECONDS", "0")
 os.environ.setdefault("TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS", "0")
 
+# The run-level goodput ledger is pinned off for the same reason
+# ("0" = no .ledger.jsonl reads/writes anywhere): tier-1 manager tests
+# assert about exactly the files their saves produce. Ledger/goodput
+# tests opt back in via knobs.enable_ledger().
+os.environ.setdefault("TORCHSNAPSHOT_TPU_LEDGER", "0")
+
 # Fan-out restore is pinned off in the suite ("0" = every rank reads
 # its own bytes from storage): tier-1 distributed restore tests assert
 # about the exact pre-fan-out read path (which plugin reads happen

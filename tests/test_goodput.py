@@ -1,0 +1,448 @@
+"""Goodput engine: run-level wall-time attribution, lost-work
+accounting, storage-cost curves, the CLI, Prometheus gauges, and the
+ledger-driven doctor rules.
+
+Acceptance pins (ISSUE 9): ``telemetry goodput <root>`` over a
+multi-step manager run with one injected interruption + restore emits
+an attribution whose buckets sum to measured wall time within 5%,
+reports nonzero lost work for the interrupted segment, and the
+``recovery-cost-high`` doctor rule fires in an injection test citing
+ledger evidence."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.telemetry import doctor, goodput, ledger, names
+from torchsnapshot_tpu.telemetry.stats import main as stats_main
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_metrics()
+    yield
+    telemetry.reset_metrics()
+
+
+def _state(n=2, size=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": rng.standard_normal(size).astype(np.float32)
+        for i in range(n)
+    }
+
+
+def _interrupted_run(root: str):
+    """A real manager run with one injected interruption + restore.
+
+    Segment 1: saves at steps 0 and 2, preemption notice at step 3
+    whose coordinated save never lands (the grace window is 'missed'),
+    so step 3's work — the time since step 2's commit — is lost.
+    Segment 2: a fresh manager restores and saves one more step.
+    Returns (measured_wall_s, lost_window_s): the test's own clocks
+    around exactly what the ledger should measure."""
+    from torchsnapshot_tpu.preemption import PreemptionSaver
+
+    t0 = time.time()
+    mgr = ts.CheckpointManager(root, keep_last_n=4)
+    saver = PreemptionSaver(signals=(), ledger_root=root)
+    try:
+        for step in range(4):
+            if step % 2 == 0:
+                mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+                lost_t0 = time.time()
+            time.sleep(0.15)  # "training"
+            if step == 3:
+                saver.request_save()
+                assert saver.should_save(step)
+                # The save misses the grace window: nothing commits.
+    finally:
+        saver.uninstall()
+    lost_window = time.time() - lost_t0
+    seg1_wall = time.time() - t0
+
+    t1 = time.time()
+    mgr2 = ts.CheckpointManager(root, keep_last_n=4)
+    dest = {"s": ts.PyTreeState(_state(seed=2))}
+    assert mgr2.restore_latest(dest) == 2
+    time.sleep(0.15)
+    mgr2.save(3, {"s": ts.PyTreeState(_state(seed=3))})
+    seg2_wall = time.time() - t1
+    return seg1_wall + seg2_wall, lost_window
+
+
+def test_attribution_sums_to_measured_wall_within_tolerance(tmp_path):
+    """The headline acceptance: buckets (train + visible stall +
+    restore + lost work) sum to the ledger-measured wall, which matches
+    the test's own wall clock within 5%; the interrupted segment
+    reports nonzero lost work (in seconds AND steps)."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        measured_wall, lost_window = _interrupted_run(root)
+        analysis = goodput.analyze_root(root)
+    run = goodput.latest_run(analysis)
+    assert run is not None and len(run["segments"]) == 2
+
+    buckets = (
+        run["train_s"]
+        + run["visible_stall_s"]
+        + run["restore_s"]
+        + run["lost_work_s"]
+    )
+    # Buckets sum to the ledger wall by construction...
+    assert buckets == pytest.approx(run["wall_s"], rel=1e-6, abs=1e-3)
+    # ...and the ledger wall tracks the real wall within the 5%
+    # acceptance tolerance (event timestamps trail the test's clocks by
+    # microseconds, not fractions).
+    assert run["wall_s"] == pytest.approx(measured_wall, rel=0.05)
+
+    seg1 = run["segments"][0]
+    assert seg1["interrupted"]
+    assert seg1["lost_work_s"] > 0
+    # The lost window is everything after step 2's commit, give or take
+    # the instants between the test's clock reads and the event stamps.
+    assert seg1["lost_work_s"] == pytest.approx(lost_window, rel=0.25)
+    assert seg1["lost_steps"] == 1  # preempted at 3, last committed 2
+    assert seg1["preemption_step"] == 3
+    assert run["interruptions"][0]["recovery_cost_s"] > 0
+    assert run["restore_s"] > 0
+
+
+def test_goodput_cli_table_and_json(tmp_path, capsys):
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        _interrupted_run(root)
+        rc = stats_main(["goodput", root])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "lost work" in out and "visible stall" in out
+        assert "preempted at step 3" in out
+        assert "storage:" in out
+
+        rc = stats_main(["goodput", root, "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["runs"][-1]["segments"][0]["interrupted"]
+        assert doc["storage"]["retained_steps"] > 0
+
+
+def test_goodput_cli_without_ledger(tmp_path, capsys):
+    rc = stats_main(["goodput", str(tmp_path)])
+    assert rc == 1
+    assert "no run ledger" in capsys.readouterr().out
+
+
+def test_manager_commits_refresh_goodput_gauges(tmp_path):
+    """Every committed step refreshes the goodput_* gauges from the
+    ledger — scrapes track the run, not just the last op."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root)
+        for step in range(2):
+            mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+    gauges = telemetry.metrics().collect()["gauges"]
+    assert names.GOODPUT_OVERHEAD_FRACTION in gauges
+    assert gauges[names.GOODPUT_STORAGE_BYTES_PER_STEP] > 0
+    assert 0.0 <= gauges[names.GOODPUT_OVERHEAD_FRACTION] <= 1.0
+
+
+def test_storage_curve_tracks_retention(tmp_path):
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root, keep_last_n=2)
+        for step in range(4):
+            mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+        storage = goodput.analyze_root(root)["storage"]
+    assert storage["retained_steps"] == 2
+    assert [row["step"] for row in storage["per_step"]] == [2, 3]
+    assert storage["bytes_per_retained_step"] > 0
+    assert storage["reclaimed_steps"] == 2
+    assert storage["reclaimed_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Ledger-driven doctor rules
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_interrupted_ledger(root: str, lost_s: float, restore_s: float):
+    """A ledger written through the real API with injected timestamps:
+    a 10-minute segment committing through t+300, dying at t+300+lost_s,
+    then a resumed segment whose recovery restore took restore_s."""
+    t0 = 1_700_000_000.0
+    rid = ledger.open_run(root)
+    assert rid is not None
+    path = ledger.ledger_path_for(root)
+    # Rewrite the auto-stamped run-start with a controlled timeline.
+    from torchsnapshot_tpu.telemetry.sink import atomic_write_text
+
+    atomic_write_text(path, "")
+    ledger.post_event(
+        root, names.EVENT_RUN_START, create=True,
+        run_id=rid, segment=1, world_size=1, unix_ts=t0,
+    )
+    for i, ts_off in enumerate((60.0, 180.0, 300.0)):
+        ledger.post_event(
+            root, names.EVENT_VISIBLE_STALL, step=i, kind="take",
+            visible_s=2.0, wall_s=2.0, nbytes=1 << 20, unix_ts=t0 + ts_off,
+        )
+        ledger.post_event(
+            root, names.EVENT_STEP_COMMITTED, step=i, bytes_new=1 << 20,
+            bytes_reused=0, bytes_total=1 << 20, blobs=2,
+            unix_ts=t0 + ts_off + 0.5,
+        )
+    ledger.post_event(
+        root, names.EVENT_PREEMPTION, step=5, target_step=6,
+        unix_ts=t0 + 300.0 + lost_s,
+    )
+    t1 = t0 + 300.0 + lost_s + 30.0  # restart gap
+    ledger.post_event(
+        root, names.EVENT_RUN_START, run_id=rid, segment=2,
+        world_size=1, unix_ts=t1,
+    )
+    ledger.post_event(
+        root, names.EVENT_RESTORE_SERVED, step=2, kind="restore",
+        restore_s=restore_s, nbytes=1 << 20, unix_ts=t1 + restore_s,
+    )
+    ledger.post_event(
+        root, names.EVENT_STEP_COMMITTED, step=3, bytes_new=1 << 20,
+        bytes_reused=0, bytes_total=1 << 20, blobs=2,
+        unix_ts=t1 + restore_s + 60.0,
+    )
+
+
+def test_recovery_cost_high_fires_with_ledger_evidence(tmp_path):
+    """The acceptance injection test: an interruption whose replayed
+    work + restore crosses the recovery budget raises
+    ``recovery-cost-high`` citing the ledger records (lost work, lost
+    steps, the preemption step, the restore that recovered it)."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        _synthetic_interrupted_ledger(root, lost_s=90.0, restore_s=45.0)
+        verdicts = doctor.diagnose_ledger(root)
+    by_rule = {v.rule: v for v in verdicts}
+    assert names.RULE_RECOVERY_COST_HIGH in by_rule
+    v = by_rule[names.RULE_RECOVERY_COST_HIGH]
+    assert v.evidence["recovery_cost_s"] == pytest.approx(135.0, abs=2.0)
+    assert v.evidence["lost_work_s"] == pytest.approx(89.5, abs=2.0)
+    assert v.evidence["lost_steps"] == 3  # preempted at 5, committed 2
+    assert v.evidence["preemption_step"] == 5
+    assert v.evidence["last_committed_step"] == 2
+    assert v.evidence["restore_s"] == pytest.approx(45.0, abs=1.0)
+    assert v.source == ledger.LEDGER_BASENAME
+    assert v.evidence["threshold_s"] == doctor.RECOVERY_COST_S
+
+
+def test_recovery_cost_excludes_deliberate_restores(tmp_path):
+    """Only the RECOVERY restores (before the resumed segment's first
+    commit) price an interruption — a later eval rollback restore stays
+    in the restore bucket but never inflates the recovery cost."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        _synthetic_interrupted_ledger(root, lost_s=10.0, restore_s=5.0)
+        # A big deliberate restore AFTER segment 2's commit.
+        ledger.post_event(
+            root, names.EVENT_RESTORE_SERVED, step=1, kind="restore",
+            restore_s=300.0, nbytes=1, unix_ts=1_700_000_900.0,
+        )
+        run = goodput.latest_run(goodput.analyze_root(root))
+        verdicts = doctor.diagnose_ledger(root)
+    itr = run["interruptions"][0]
+    assert itr["restore_s"] == pytest.approx(5.0)
+    assert itr["recovery_cost_s"] == pytest.approx(15.0, abs=1.0)
+    # The deliberate restore still counts as restore-bucket wall time...
+    assert run["restore_s"] == pytest.approx(305.0)
+    # ...but recovery-cost-high stays quiet (15s < 60s budget).
+    assert names.RULE_RECOVERY_COST_HIGH not in {v.rule for v in verdicts}
+
+
+def test_by_tier_durable_tracks_retention(tmp_path):
+    """GC'd steps' mirror bytes leave the durable tier total exactly as
+    pruning removes them from the primary one — the per-tier comparison
+    stays apples-to-apples after retention."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        rid = ledger.open_run(root)
+        assert rid is not None
+        for step in range(3):
+            ledger.post_event(
+                root, names.EVENT_STEP_COMMITTED, step=step,
+                bytes_new=100, bytes_reused=0, bytes_total=100, blobs=1,
+            )
+            ledger.post_event(
+                root, names.EVENT_MIRROR_SETTLED, step=step,
+                lag_s=1.0, nbytes=100, blobs=1, error=None,
+            )
+        # Retention drops step 0: its storage record prunes, its
+        # mirror-settled record survives (time attribution).
+        ledger.post_event(
+            root, names.EVENT_GC_RECLAIMED, step=0,
+            bytes_reclaimed=100, blobs=1,
+        )
+        ledger.prune_steps(root, {0})
+        storage = goodput.analyze_root(root)["storage"]
+    assert storage["retained_steps"] == 2
+    assert storage["by_tier"] == {"primary": 200, "durable": 200}
+
+
+def test_recovery_cost_quiet_below_threshold(tmp_path):
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        _synthetic_interrupted_ledger(root, lost_s=10.0, restore_s=5.0)
+        verdicts = doctor.diagnose_ledger(root)
+    assert names.RULE_RECOVERY_COST_HIGH not in {v.rule for v in verdicts}
+
+
+def test_goodput_degraded_fires_on_overhead_heavy_run(tmp_path):
+    """A run whose stalls + recovery eat >15% of wall raises
+    ``goodput-degraded`` with the attribution as evidence."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        # 90s lost + 45s restore + 6s stalls over ~8 min of wall ≈ 26%.
+        _synthetic_interrupted_ledger(root, lost_s=90.0, restore_s=45.0)
+        verdicts = doctor.diagnose_ledger(root)
+    by_rule = {v.rule: v for v in verdicts}
+    assert names.RULE_GOODPUT_DEGRADED in by_rule
+    ev = by_rule[names.RULE_GOODPUT_DEGRADED].evidence
+    assert ev["overhead_fraction"] >= doctor.GOODPUT_DEGRADED_FRAC
+    assert ev["lost_work_s"] > 0 and ev["visible_stall_s"] > 0
+
+
+def test_goodput_quiet_on_healthy_run(tmp_path):
+    """A clean run (no interruption, tiny stalls against minutes of
+    wall) raises neither ledger rule — and the snapshot-level doctor
+    sees the same ledger through gather_evidence."""
+    root = str(tmp_path / "ckpts")
+    t0 = 1_700_000_000.0
+    with knobs.enable_ledger():
+        rid = ledger.open_run(root)
+        from torchsnapshot_tpu.telemetry.sink import atomic_write_text
+
+        atomic_write_text(ledger.ledger_path_for(root), "")
+        ledger.post_event(
+            root, names.EVENT_RUN_START, create=True, run_id=rid,
+            segment=1, world_size=1, unix_ts=t0,
+        )
+        for i in range(3):
+            ledger.post_event(
+                root, names.EVENT_VISIBLE_STALL, step=i, kind="take",
+                visible_s=1.0, wall_s=1.0, nbytes=1,
+                unix_ts=t0 + 100.0 * (i + 1),
+            )
+            ledger.post_event(
+                root, names.EVENT_STEP_COMMITTED, step=i, bytes_new=1,
+                bytes_reused=0, bytes_total=1, blobs=1,
+                unix_ts=t0 + 100.0 * (i + 1) + 0.5,
+            )
+        verdicts = doctor.diagnose_ledger(root)
+        assert verdicts == []
+        # The evidence bundle for a step dir resolves the root ledger.
+        ev = doctor.gather_evidence(f"{root}/step_0000000001")
+        assert len(ev.ledger_records) == 7
+        assert ev.ledger_file.endswith(ledger.LEDGER_BASENAME)
+
+
+def test_doctor_trend_appends_run_level_verdicts(tmp_path, capsys):
+    """``doctor --trend`` on a root with an expensive interruption
+    speaks run-level cost alongside the per-step rows."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger(), knobs.override_history_max_records(16):
+        mgr = ts.CheckpointManager(root)
+        for step in range(3):
+            mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+        _synthetic_interrupted_ledger_append(root)
+        rc = doctor.main(["--trend", root])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert names.RULE_RECOVERY_COST_HIGH in out
+
+
+def _synthetic_interrupted_ledger_append(root: str):
+    """Append an expensive historical interruption to an existing
+    ledger (timestamps in the past so live segments stay untouched)."""
+    t0 = 1_600_000_000.0
+    rid = "history00run"
+    ledger.post_event(
+        root, names.EVENT_RUN_START, run_id=rid, segment=1,
+        world_size=1, unix_ts=t0,
+    )
+    ledger.post_event(
+        root, names.EVENT_STEP_COMMITTED, step=0, bytes_new=1,
+        bytes_reused=0, bytes_total=1, blobs=1, unix_ts=t0 + 10.0,
+    )
+    ledger.post_event(
+        root, names.EVENT_PREEMPTION, step=4, target_step=5,
+        unix_ts=t0 + 200.0,
+    )
+    ledger.post_event(
+        root, names.EVENT_RUN_START, run_id=rid, segment=2,
+        world_size=1, unix_ts=t0 + 230.0,
+    )
+    ledger.post_event(
+        root, names.EVENT_RESTORE_SERVED, step=0, kind="restore",
+        restore_s=40.0, nbytes=1, unix_ts=t0 + 270.0,
+    )
+
+
+def test_fsck_stats_summarizes_ledger(tmp_path, capsys):
+    """``fsck --stats`` lists the ledger as a first-class artifact:
+    event counts, run span, and the interrupted segment."""
+    from torchsnapshot_tpu.fsck import main as fsck_main
+
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        _interrupted_run(root)
+        rc = fsck_main([f"{root}/step_0000000002", "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "run ledger" in out
+    assert "run-start=2" in out
+    assert "preempted at step 3" in out
+    assert "interrupted" in out
+
+
+def test_restore_rows_land_in_history_with_kind_isolation(tmp_path):
+    """Satellite: manager restores append history rows, and trend
+    detection baselines per kind — a 40x-slower restore population must
+    neither flag against the take baseline nor hide a real take
+    regression."""
+    from torchsnapshot_tpu.telemetry import history
+
+    root = str(tmp_path / "ckpts")
+    with knobs.override_history_max_records(32):
+        mgr = ts.CheckpointManager(root)
+        for step in range(3):
+            mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+        dest = {"s": ts.PyTreeState(_state(seed=0))}
+        mgr.restore(2, dest)
+        pending = mgr.async_restore(2, dest)
+        pending.wait()
+        records = history.load_history(history.history_path_for(root))
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["take", "take", "take", "restore", "async_restore"]
+    # Kind isolation: synthetic mixed history where restores are 40x
+    # slower than takes but internally flat — no cross-kind flagging.
+    mixed = []
+    for i in range(6):
+        mixed.append(
+            {"step": i, "kind": "take", "take_s": 1.0, "mb_s": 100.0,
+             "budget_wait_s": 0.0, "phases": {"writing": 1.0}}
+        )
+        mixed.append(
+            {"step": i, "kind": "restore", "take_s": 40.0, "mb_s": 10.0,
+             "budget_wait_s": 0.0, "phases": {"loading": 40.0}}
+        )
+    assert history.detect_trend_regressions(mixed) == []
+    # A genuine take regression still flags, and carries its kind.
+    mixed.append(
+        {"step": 6, "kind": "take", "take_s": 5.0, "mb_s": 100.0,
+         "budget_wait_s": 0.0, "phases": {"writing": 5.0}}
+    )
+    rows = history.detect_trend_regressions(mixed)
+    assert rows and all(r["kind"] == "take" for r in rows)
+    assert {r["step"] for r in rows} == {6}

@@ -217,6 +217,29 @@ def test_tunable_snapshot_reports_effective_values() -> None:
     assert knobs.tunable_snapshot()["io_concurrency"] == 16
 
 
+def test_ledger_knobs() -> None:
+    """Suite default (conftest) is "0" = off; the packaged default (no
+    env var) is ON — the run ledger is the always-on goodput substrate.
+    A non-positive max-records bound also disables recording."""
+    assert not knobs.is_ledger_enabled()  # conftest pin
+    with knobs.enable_ledger():
+        assert knobs.is_ledger_enabled()
+        with knobs.override_ledger_max_records(0):
+            assert not knobs.is_ledger_enabled()
+        with knobs.override_ledger_max_records(7):
+            assert knobs.get_ledger_max_records() == 7
+    assert not knobs.is_ledger_enabled()
+    assert knobs.get_ledger_max_records() == 4096
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_LEDGER", None)
+    try:
+        assert knobs.is_ledger_enabled()
+        with knobs.disable_ledger():
+            assert not knobs.is_ledger_enabled()
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_LEDGER"] = prev
+
+
 def test_history_max_records_knob() -> None:
     assert knobs.get_history_max_records() == 0  # conftest zeroes it
     with knobs.override_history_max_records(7):

@@ -1,0 +1,286 @@
+"""Run ledger: crash-safe appends, run-id resume, bounds, GC pruning,
+and rank-0-only append consistency across processes.
+
+Acceptance pins (ISSUE 9): a kill mid-append leaves a parseable ledger;
+a restarted manager resumes the run id; a 2-process manager run writes
+exactly one record stream (rank 0's)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.telemetry import ledger, names
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_metrics()
+    yield
+    telemetry.reset_metrics()
+
+
+def _state(n=2, size=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": rng.standard_normal(size).astype(np.float32)
+        for i in range(n)
+    }
+
+
+# ---------------------------------------------------------------------------
+# Core mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_ledger_writes_nothing(tmp_path):
+    root = str(tmp_path / "off")
+    mgr = ts.CheckpointManager(root)
+    mgr.save(0, {"s": ts.PyTreeState(_state())})
+    # The conftest pins TORCHSNAPSHOT_TPU_LEDGER=0: no file appears and
+    # the read side returns None.
+    assert not os.path.exists(os.path.join(root, ledger.LEDGER_BASENAME))
+    assert ledger.find_ledger_for(root) is None
+
+
+def test_post_event_without_open_run_creates_no_orphan(tmp_path):
+    """Events only land where a manager opened a run — a bare post to a
+    random directory must not scatter ledger files."""
+    with knobs.enable_ledger():
+        assert (
+            ledger.post_event(str(tmp_path), names.EVENT_STEP_COMMITTED)
+            is None
+        )
+        assert list(tmp_path.iterdir()) == []
+
+
+def test_torn_final_line_is_skipped_and_run_id_resumes(tmp_path):
+    """Kill mid-append: the ledger stays parseable (the torn tail is
+    skipped) and a restarted manager resumes the same run id with an
+    incremented segment."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        rid = ledger.open_run(root, world_size=1)
+        assert rid is not None
+        ledger.post_event(
+            root, names.EVENT_STEP_COMMITTED, step=0, bytes_new=10,
+            bytes_reused=0, bytes_total=10, blobs=1,
+        )
+        path = ledger.ledger_path_for(root)
+        # Simulate the kill: a torn, non-JSON final line.
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"event": "step-com')
+        records = ledger.load_ledger(path)
+        assert [r["event"] for r in records] == [
+            names.EVENT_RUN_START,
+            names.EVENT_STEP_COMMITTED,
+        ]
+        rid2 = ledger.open_run(root, world_size=1)
+        assert rid2 == rid
+        starts = [
+            r
+            for r in ledger.load_ledger(path)
+            if r["event"] == names.EVENT_RUN_START
+        ]
+        assert [s["segment"] for s in starts] == [1, 2]
+        assert {s["run_id"] for s in starts} == {rid}
+
+
+def test_manager_restart_resumes_run_id(tmp_path):
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root)
+        mgr.save(0, {"s": ts.PyTreeState(_state())})
+        first = mgr._ledger_run_id
+        assert first is not None
+        mgr2 = ts.CheckpointManager(root)
+        assert mgr2._ledger_run_id == first
+        records = ledger.load_ledger(ledger.ledger_path_for(root))
+        segments = [
+            r["segment"]
+            for r in records
+            if r["event"] == names.EVENT_RUN_START
+        ]
+        assert segments == [1, 2]
+
+
+def test_bound_trims_oldest_but_keeps_newest_run_start(tmp_path):
+    """The rolling bound trims oldest-first, but the newest run-start
+    survives any trim — the active segment's attribution anchor."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger(), knobs.override_ledger_max_records(10):
+        ledger.open_run(root)
+        path = ledger.ledger_path_for(root)
+        # Enough posts to cross several trim checks.
+        for i in range(ledger.TRIM_CHECK_EVERY * 2 + 5):
+            ledger.post_event(
+                root, names.EVENT_VISIBLE_STALL, step=i, visible_s=0.01,
+                wall_s=0.01, nbytes=1,
+            )
+        records = ledger.load_ledger(path)
+        assert len(records) <= 10 + ledger.TRIM_CHECK_EVERY
+        assert any(
+            r["event"] == names.EVENT_RUN_START for r in records
+        )
+        # Newest events survived.
+        steps = [
+            r["step"]
+            for r in records
+            if r["event"] == names.EVENT_VISIBLE_STALL
+        ]
+        assert steps == sorted(steps)
+        assert steps[-1] == ledger.TRIM_CHECK_EVERY * 2 + 4
+
+
+def test_gc_prunes_step_committed_records(tmp_path):
+    """Retention GC drops deleted steps' step-committed storage records
+    and posts gc-reclaimed with the bytes freed; time-attribution
+    events survive."""
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root, keep_last_n=2)
+        for step in range(4):
+            mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+        records = ledger.load_ledger(ledger.ledger_path_for(root))
+        committed = [
+            r["step"]
+            for r in records
+            if r["event"] == names.EVENT_STEP_COMMITTED
+        ]
+        assert committed == [2, 3]  # steps 0-1 GC'd and pruned
+        reclaimed = [
+            r
+            for r in records
+            if r["event"] == names.EVENT_GC_RECLAIMED
+        ]
+        assert [r["step"] for r in reclaimed] == [0, 1]
+        assert all(r["bytes_reclaimed"] > 0 for r in reclaimed)
+        # The GC'd steps' visible stalls still count toward overhead.
+        stalls = [
+            r["step"]
+            for r in records
+            if r["event"] == names.EVENT_VISIBLE_STALL
+        ]
+        assert stalls == [0, 1, 2, 3]
+
+
+def test_incremental_saves_record_reuse_bytes(tmp_path):
+    """Incremental steps' step-committed records split new vs.
+    base-referenced bytes — the reuse ratio the storage curve reports."""
+    root = str(tmp_path / "ckpts")
+    state = _state(n=4, size=4096)
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root, incremental=True)
+        mgr.save(0, {"s": ts.PyTreeState(state)})
+        mgr.save(1, {"s": ts.PyTreeState(state)})  # unchanged: all reuse
+        records = ledger.load_ledger(ledger.ledger_path_for(root))
+        by_step = {
+            r["step"]: r
+            for r in records
+            if r["event"] == names.EVENT_STEP_COMMITTED
+        }
+        assert by_step[0]["bytes_reused"] == 0
+        assert by_step[0]["bytes_new"] > 0
+        assert by_step[1]["bytes_reused"] > 0
+        from torchsnapshot_tpu.telemetry import goodput
+
+        storage = goodput.analyze(records)["storage"]
+        assert storage["incremental_reuse_ratio"] > 0.3
+
+
+def test_tiered_save_posts_mirror_settled(tmp_path):
+    """A tiered take's background mirror posts its settle event (lag +
+    bytes) to the manager root's ledger."""
+    fast = tmp_path / "fast"
+    durable = tmp_path / "durable"
+    root = f"tiered://{fast}|{durable}"
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root)
+        mgr.save(0, {"s": ts.PyTreeState(_state())})
+        mgr.wait_durable(0, timeout=60.0)
+        records = ledger.load_ledger(ledger.ledger_path_for(root))
+        settled = [
+            r for r in records if r["event"] == names.EVENT_MIRROR_SETTLED
+        ]
+        assert settled and settled[0]["step"] == 0
+        assert settled[0]["nbytes"] > 0
+        assert settled[0]["error"] is None
+
+
+def test_preemption_saver_posts_agreement(tmp_path):
+    """A single-process preemption notice records the step and target —
+    the lost-work anchor."""
+    from torchsnapshot_tpu.preemption import PreemptionSaver
+
+    root = str(tmp_path / "ckpts")
+    with knobs.enable_ledger():
+        mgr = ts.CheckpointManager(root)
+        mgr.save(0, {"s": ts.PyTreeState(_state())})
+        saver = PreemptionSaver(signals=(), ledger_root=root)
+        try:
+            saver.request_save()
+            assert saver.should_save(3)
+        finally:
+            saver.uninstall()
+        records = ledger.load_ledger(ledger.ledger_path_for(root))
+        preempts = [
+            r for r in records if r["event"] == names.EVENT_PREEMPTION
+        ]
+        assert len(preempts) == 1
+        assert preempts[0]["step"] == 3
+        assert preempts[0]["target_step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# 2-process rank-0-only consistency
+# ---------------------------------------------------------------------------
+
+
+def _two_rank_ledger_worker(pg, root: str):
+    os.environ["TORCHSNAPSHOT_TPU_LEDGER"] = "1"
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    PGWrapper(pg).barrier()
+    mgr = ts.CheckpointManager(root, pg=pg)
+    for step in range(2):
+        mgr.save(
+            step,
+            {
+                "s": ts.PyTreeState(_state(seed=step)),
+                "r": ts.StateDict(rank=pg.rank),
+            },
+        )
+    PGWrapper(pg).barrier()
+    path = os.path.join(root, ledger.LEDGER_BASENAME)
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_two_proc_rank0_only_appends(tmp_path):
+    """Both ranks save through the manager; only rank 0's process ever
+    appends — one run-start, one step-committed per step, one
+    visible-stall per take, every line parseable, and both ranks read
+    the identical stream."""
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    root = str(tmp_path / "ckpts")
+    contents = run_multiprocess(
+        _two_rank_ledger_worker, nproc=2, args=(root,)
+    )
+    assert contents[0] == contents[1]
+    records = [
+        json.loads(line)
+        for line in contents[0].splitlines()
+        if line.strip()
+    ]
+    events = [r["event"] for r in records]
+    assert events.count(names.EVENT_RUN_START) == 1
+    assert events.count(names.EVENT_STEP_COMMITTED) == 2
+    assert events.count(names.EVENT_VISIBLE_STALL) == 2
+    start = next(
+        r for r in records if r["event"] == names.EVENT_RUN_START
+    )
+    assert start["world_size"] == 2

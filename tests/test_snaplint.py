@@ -561,6 +561,82 @@ def test_doctor_rule_ids_requires_declarations(tmp_path):
     )
 
 
+# ---------------------------------------------------------------------------
+# ledger-event-ids
+# ---------------------------------------------------------------------------
+
+_LEDGER_NAMES_BAD = """
+EVENT_FOO = "Not_Kebab"
+EVENT_FOO_AGAIN = "Not_Kebab"
+"""
+
+_LEDGER_NAMES_FIXED = """
+EVENT_FOO = "foo-happened"
+"""
+
+_LEDGER_EMIT_BAD = """
+from torchsnapshot_tpu.telemetry.ledger import (
+    post_event,
+    post_event_for_snapshot,
+)
+
+def emit(root, path):
+    post_event(root, "literal-event", step=1)
+    post_event_for_snapshot(path, event="another-literal")
+"""
+
+_LEDGER_EMIT_FIXED = """
+from torchsnapshot_tpu.telemetry import names
+from torchsnapshot_tpu.telemetry.ledger import (
+    post_event,
+    post_event_for_snapshot,
+)
+
+def emit(root, path):
+    post_event(root, names.EVENT_FOO, step=1)
+    post_event_for_snapshot(path, event=names.EVENT_FOO)
+"""
+
+
+def test_ledger_event_ids_detects_and_accepts_fix(tmp_path):
+    emitter = _doctor_layout(tmp_path, _LEDGER_NAMES_BAD, _LEDGER_EMIT_BAD)
+    analyzer = Analyzer(root=tmp_path, select=["ledger-event-ids"])
+    bad = analyzer.run([emitter], baseline=None)
+    msgs = _messages(bad)
+    assert any("not kebab-case" in m for m in msgs)
+    assert any("registered twice" in m for m in msgs)
+    assert any("'literal-event'" in m and "post_event" in m for m in msgs)
+    assert any(
+        "'another-literal'" in m and "post_event_for_snapshot" in m
+        for m in msgs
+    )
+    # The ROOT argument (first positional) is never mistaken for an
+    # event id — only the second positional / event= keyword lints.
+    assert not any("'/some/root'" in m for m in msgs)
+
+    emitter = _doctor_layout(
+        tmp_path, _LEDGER_NAMES_FIXED, _LEDGER_EMIT_FIXED
+    )
+    analyzer = Analyzer(root=tmp_path, select=["ledger-event-ids"])
+    fixed = analyzer.run([emitter], baseline=None)
+    assert fixed.new_findings == []
+
+
+def test_ledger_event_ids_requires_declarations(tmp_path):
+    emitter = _doctor_layout(tmp_path, "X = 1\n", "def noop():\n    pass\n")
+    analyzer = Analyzer(root=tmp_path, select=["ledger-event-ids"])
+    result = analyzer.run([emitter], baseline=None)
+    assert any(
+        "no ledger event ids declared" in m for m in _messages(result)
+    )
+
+
+def test_ledger_event_ids_repo_clean_on_head():
+    analyzer = Analyzer(root=REPO, select=["ledger-event-ids"])
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == []
+
+
 def test_inline_suppression_silences_one_rule(tmp_path):
     source = """
 import time
@@ -734,6 +810,7 @@ def test_cli_json_output_and_rule_listing():
         "metric-name-literal",
         "span-name-literal",
         "doctor-rule-ids",
+        "ledger-event-ids",
         "tiered-test-markers",
     ):
         assert rule in listing.stdout

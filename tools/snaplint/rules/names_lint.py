@@ -35,12 +35,17 @@ _COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
 _KEBAB_CASE = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)*$")
 _SPAN_PREFIXES = ("SPAN_", "INSTANT_")
 _RULE_PREFIX = "RULE_"
+_EVENT_PREFIX = "EVENT_"
 _REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
 _TRACE_CALLABLES = {"trace_annotation", "span", "instant", "begin"}
 # Doctor emit surfaces: the rule-registration decorator and the verdict
 # constructor (telemetry/doctor.py). A literal id at either means the
 # verdict namespace can drift from the names.py registry.
 _DOCTOR_CALLABLES = {"doctor_rule", "Verdict"}
+# Run-ledger post surfaces (telemetry/ledger.py): both take the event
+# id as their SECOND positional argument (the root/snapshot path comes
+# first) or as the ``event=`` keyword.
+_LEDGER_CALLABLES = {"post_event", "post_event_for_snapshot"}
 
 NAMES_RELPATH = "torchsnapshot_tpu/telemetry/names.py"
 TRACE_EXEMPT_RELPATH = "torchsnapshot_tpu/telemetry/trace.py"
@@ -57,14 +62,17 @@ def check_metric_names_file(
     path: Path,
     include_span_decls: bool = True,
     include_rule_decls: bool = True,
+    include_event_decls: bool = True,
 ) -> List[str]:
     """Errors in the declaration file: malformed values (snake_case for
     metrics, colon-case for SPAN_/INSTANT_ trace names, kebab-case for
-    RULE_ doctor-verdict ids), duplicate constants, duplicate values.
-    ``include_span_decls=False`` / ``include_rule_decls=False`` leave
-    the SPAN_/INSTANT_ and RULE_ checks to the span / doctor rules (the
-    unified registry runs all three; each defect should report once —
-    with the flag off, those constants are skipped here entirely)."""
+    RULE_ doctor-verdict ids and EVENT_ ledger events), duplicate
+    constants, duplicate values. ``include_span_decls=False`` /
+    ``include_rule_decls=False`` / ``include_event_decls=False`` leave
+    the SPAN_/INSTANT_, RULE_ and EVENT_ checks to the span / doctor /
+    ledger rules (the unified registry runs all four; each defect
+    should report once — with the flag off, those constants are
+    skipped here entirely)."""
     errors = []
     if not path.exists():
         return [f"{path.name}: missing (metric names must be declared here)"]
@@ -78,6 +86,10 @@ def check_metric_names_file(
             if not isinstance(target, ast.Name):
                 continue
             if not include_rule_decls and target.id.startswith(_RULE_PREFIX):
+                continue
+            if not include_event_decls and target.id.startswith(
+                _EVENT_PREFIX
+            ):
                 continue
             if not include_span_decls and target.id.startswith(
                 _SPAN_PREFIXES
@@ -105,6 +117,13 @@ def check_metric_names_file(
                         f"{path.name}:{node.lineno}: {value!r} is not "
                         f"kebab-case (doctor verdict ids look like "
                         f"'what-is-wrong')"
+                    )
+            elif target.id.startswith(_EVENT_PREFIX):
+                if not _KEBAB_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"kebab-case (ledger event ids look like "
+                        f"'what-happened')"
                     )
             elif not _SNAKE_CASE.match(value):
                 errors.append(
@@ -218,6 +237,21 @@ def check_doctor_rule_ids_file(path: Path) -> List[str]:
     )
 
 
+def check_ledger_event_ids_file(path: Path) -> List[str]:
+    """Errors in the declaration file's run-ledger event registry: no
+    EVENT_ constants at all, non-kebab-case values, duplicate
+    constants/values."""
+    return _scan_prefixed_decls(
+        path,
+        (_EVENT_PREFIX,),
+        _KEBAB_CASE,
+        "kebab-case ('what-happened')",
+        "event id",
+        "ledger event ids",
+        "no ledger event ids declared",
+    )
+
+
 # ---------------------------------------------------------------------------
 # call-site checks: ONE tree-level implementation
 # ---------------------------------------------------------------------------
@@ -281,6 +315,32 @@ def _iter_rule_literal_sites(
             candidates.append(node.args[0])
         for kw in node.keywords:
             if kw.arg in ("rule", "rule_id"):
+                candidates.append(kw.value)
+        for cand in candidates:
+            if isinstance(cand, ast.Constant) and isinstance(
+                cand.value, str
+            ):
+                yield node.lineno, called, cand.value
+
+
+def _iter_ledger_event_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, callable, literal) for string-literal event ids at
+    ledger post sites: the SECOND positional arg of ``post_event(root,
+    event, ...)`` / ``post_event_for_snapshot(path, event, ...)`` or
+    their ``event=`` keyword (the first positional is the root)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        called = _called_name(node.func)
+        if called not in _LEDGER_CALLABLES:
+            continue
+        candidates = []
+        if len(node.args) >= 2:
+            candidates.append(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "event":
                 candidates.append(kw.value)
         for cand in candidates:
             if isinstance(cand, ast.Constant) and isinstance(
@@ -415,6 +475,7 @@ class MetricNameLiteral(Rule):
                 names_file,
                 include_span_decls=False,
                 include_rule_decls=False,
+                include_event_decls=False,
             ),
             project,
         )
@@ -460,6 +521,39 @@ class DoctorRuleIds(Rule):
                     message=(
                         f"literal verdict id {literal!r} in {called}() — "
                         f"use a telemetry/names.py RULE_ constant"
+                    ),
+                )
+
+
+@register
+class LedgerEventIds(Rule):
+    name = "ledger-event-ids"
+    description = (
+        "run-ledger event ids: kebab-case, declared exactly once in "
+        "telemetry/names.py (EVENT_ constants), no literal event "
+        "strings at post_event/post_event_for_snapshot call sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return
+        yield from _decl_findings(
+            self.name, check_ledger_event_ids_file(names_file), project
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath == NAMES_RELPATH:
+                continue
+            for lineno, called, literal in _iter_ledger_event_literal_sites(
+                tree
+            ):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal event id {literal!r} in {called}() — "
+                        f"use a telemetry/names.py EVENT_ constant"
                     ),
                 )
 

@@ -75,10 +75,11 @@ class FsckReport:
         return not self.problems
 
 
-def _blob_requirements(manifest: Dict[str, Entry]) -> Dict[str, int]:
+def blob_requirements(manifest: Dict[str, Entry]) -> Dict[str, int]:
     """location -> minimum byte length the manifest implies. Batched slab
     members share a location; the requirement is the max end offset any
-    member claims."""
+    member claims. Shared by the audit below and the manager's ledger
+    accounting (per-step new vs. base-referenced bytes)."""
     need: Dict[str, int] = {}
 
     def add_array(ae: ArrayEntry) -> None:
@@ -350,7 +351,7 @@ def verify_snapshot(
                     metadata.world_size, storage, event_loop
                 )
 
-            need = _blob_requirements(metadata.manifest)
+            need = blob_requirements(metadata.manifest)
             slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
 
             async def _run() -> List[Tuple[int, bool]]:
@@ -477,6 +478,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"{doc.get('items_done', 0)}/"
                     f"{doc.get('planned_items', 0)} items [{status}]"
                 )
+        # Run-ledger summary: the goodput substrate is a first-class
+        # artifact, not an unknown dotfile — event counts, run/segment
+        # spans, and interrupted (unclosed) segments, with a pointer at
+        # the full attribution CLI.
+        if evidence.ledger_records:
+            from .telemetry.ledger import describe as describe_ledger
+
+            print()
+            print(f"run ledger ({evidence.ledger_file}):")
+            for line in describe_ledger(evidence.ledger_records):
+                print(f"  {line}")
+            print(
+                "  full attribution: "
+                "python -m torchsnapshot_tpu.telemetry goodput <root>"
+            )
         verdicts = diagnose_evidence(evidence)
         if verdicts:
             print()

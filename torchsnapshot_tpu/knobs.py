@@ -61,12 +61,15 @@ _ASYNC_VISIBLE_BUDGET_ENV = "TORCHSNAPSHOT_TPU_ASYNC_VISIBLE_BUDGET_SECONDS"
 _AUTOTUNE_ENV = "TORCHSNAPSHOT_TPU_AUTOTUNE"
 _MEMORY_BUDGET_FRACTION_ENV = "TORCHSNAPSHOT_TPU_MEMORY_BUDGET_FRACTION"
 _FANOUT_RESTORE_ENV = "TORCHSNAPSHOT_TPU_FANOUT_RESTORE"
+_LEDGER_ENV = "TORCHSNAPSHOT_TPU_LEDGER"
+_LEDGER_MAX_RECORDS_ENV = "TORCHSNAPSHOT_TPU_LEDGER_MAX_RECORDS"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
 _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS: float = 1800.0
 _DEFAULT_PROGRESS_SECONDS: float = 1.0
 _DEFAULT_HISTORY_MAX_RECORDS: int = 512
+_DEFAULT_LEDGER_MAX_RECORDS: int = 4096
 
 _DEFAULT_STAGING_POOL_SLAB_BYTES: int = 128 * 1024 * 1024
 _DEFAULT_STAGING_POOL_SLABS: int = 2
@@ -364,6 +367,32 @@ def get_history_max_records() -> int:
     return _DEFAULT_HISTORY_MAX_RECORDS
 
 
+def is_ledger_enabled() -> bool:
+    """The run-level goodput ledger (``<root>/.ledger.jsonl``,
+    telemetry/ledger.py): on by default — the manager, snapshot
+    envelopes, tiered mirror, preemption saver, and GC post typed
+    events rank-0-only, and the goodput engine attributes the run's
+    wall time from them (docs/goodput.md). Set to ``"0"`` to disable
+    every ledger read/write (no file appears in the root; the test
+    conftest pins 0 so tier-1 manager dirs stay deterministic). A
+    non-positive max-records bound (below) also disables recording."""
+    return (
+        os.environ.get(_LEDGER_ENV, "1") != "0"
+        and get_ledger_max_records() > 0
+    )
+
+
+def get_ledger_max_records() -> int:
+    """Bound on the run ledger: the newest N records are kept, older
+    ones trimmed away (the newest run-start is always retained so the
+    active run's attribution never loses its anchor). <= 0 disables
+    ledger recording entirely."""
+    val = os.environ.get(_LEDGER_MAX_RECORDS_ENV)
+    if val is not None:
+        return int(val)
+    return _DEFAULT_LEDGER_MAX_RECORDS
+
+
 def is_async_device_snapshot_enabled() -> bool:
     """Default-on device-snapshot async takes: ``async_take`` pins a
     consistent snapshot before returning (on-device clones for jax
@@ -630,6 +659,27 @@ def override_progress_dir(path: str) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_history_max_records(n: int) -> Generator[None, None, None]:
     with _override_env(_HISTORY_MAX_RECORDS_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_ledger() -> Generator[None, None, None]:
+    """Force the run ledger ON for the block (the suite's conftest pins
+    it off so tier-1 manager dirs hold exactly the files the code under
+    test wrote; ledger/goodput tests opt back in here)."""
+    with _override_env(_LEDGER_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def disable_ledger() -> Generator[None, None, None]:
+    with _override_env(_LEDGER_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_ledger_max_records(n: int) -> Generator[None, None, None]:
+    with _override_env(_LEDGER_MAX_RECORDS_ENV, str(n)):
         yield
 
 

@@ -143,6 +143,7 @@ class _PendingManagedSnapshot:
             )
             telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
             self._manager._record_step_history(self._step)
+            self._manager._post_step_ledger(self._step, snapshot)
             self._manager._autotune_step(self._step)
             self._committed = True
         return snapshot
@@ -152,6 +153,33 @@ class _PendingManagedSnapshot:
 
     def staged(self) -> bool:
         return self._pending.staged()
+
+
+class _ManagedPendingRestore:
+    """Wraps a PendingRestore so the restore's telemetry summary lands
+    in the manager's step history once the apply succeeds — the
+    async-restore report is only emitted at ``wait()`` time (the apply
+    runs on the calling thread), so the recording must ride the same
+    call. Delegates everything else to the wrapped handle."""
+
+    def __init__(self, manager: "CheckpointManager", step: int, pending: Any):
+        self._manager = manager
+        self._step = step
+        self._pending = pending
+        self._recorded = False
+
+    def wait(self) -> None:
+        out = self._pending.wait()
+        if not self._recorded:
+            self._recorded = True
+            self._manager._record_restore_history(self._step)
+        return out
+
+    def done(self) -> bool:
+        return self._pending.done()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._pending, name)
 
 
 class CheckpointManager:
@@ -211,6 +239,21 @@ class CheckpointManager:
         # stays None while TORCHSNAPSHOT_TPU_AUTOTUNE=0 — the kill
         # switch means no tuner object, no state file, no broadcast.
         self._autotuner: Optional[Any] = None
+        # Run-level goodput ledger (telemetry/ledger.py): rank 0 opens
+        # (or, after a restart/preemption, resumes) the run — the
+        # run-start event anchors every segment's wall-time attribution
+        # and registers this process as the root's only ledger writer.
+        # None while TORCHSNAPSHOT_TPU_LEDGER=0 (no file appears).
+        self._ledger_run_id: Optional[str] = None
+        if knobs.is_ledger_enabled() and self._pg.get_rank() == 0:
+            try:
+                from .telemetry import ledger as run_ledger
+
+                self._ledger_run_id = run_ledger.open_run(
+                    self.root, world_size=self._pg.get_world_size()
+                )
+            except Exception as e:  # noqa: BLE001 - ledger is best-effort
+                logger.warning("could not open the run ledger: %r", e)
 
     # ------------------------------------------------------------------
     # saving
@@ -267,6 +310,7 @@ class CheckpointManager:
         )
         telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         self._record_step_history(step)
+        self._post_step_ledger(step, snapshot)
         self._autotune_step(step)
         return snapshot
 
@@ -326,6 +370,53 @@ class CheckpointManager:
         except Exception as e:  # noqa: BLE001 - history is best-effort
             logger.warning(
                 "could not record step %d telemetry history: %r", step, e
+            )
+
+    def _post_step_ledger(self, step: int, snapshot: Snapshot) -> None:
+        """Post the just-committed step to the run ledger: the
+        retention-visible moment, with the step's storage accounting —
+        bytes newly written vs. referenced from an incremental base
+        (the reuse ratio the goodput engine's storage-cost curve
+        reports) — then refresh the run-so-far ``goodput_*`` gauges.
+        Rank 0 only; best-effort (the ledger must never fail a save)."""
+        if self._pg.get_rank() != 0 or not knobs.is_ledger_enabled():
+            return
+        try:
+            from .fsck import blob_requirements
+            from .telemetry import last_report
+            from .telemetry import ledger as run_ledger
+            from .telemetry import names as event_names
+            from .telemetry.goodput import publish_gauges
+
+            need = blob_requirements(snapshot.metadata.manifest)
+            bytes_new = sum(
+                n for loc, n in need.items() if not loc.startswith("../")
+            )
+            bytes_reused = sum(
+                n for loc, n in need.items() if loc.startswith("../")
+            )
+            fields: Dict[str, Any] = {
+                "step": step,
+                "bytes_new": int(bytes_new),
+                "bytes_reused": int(bytes_reused),
+                "bytes_total": int(bytes_new + bytes_reused),
+                "blobs": len(need),
+            }
+            report = last_report(
+                "take", "async_take", path=self.step_path(step)
+            )
+            if report is not None:
+                fields["kind"] = report.kind
+                fields["take_s"] = round(
+                    max(report.phases.values(), default=0.0), 6
+                )
+            run_ledger.post_event(
+                self.root, event_names.EVENT_STEP_COMMITTED, **fields
+            )
+            publish_gauges(self.root)
+        except Exception as e:  # noqa: BLE001 - ledger is best-effort
+            logger.warning(
+                "could not post step %d to the run ledger: %r", step, e
             )
 
     def _autotune_step(self, step: int) -> None:
@@ -393,6 +484,30 @@ class CheckpointManager:
     def restore(self, step: int, app_state: AppState) -> None:
         Snapshot(self.step_path(step), pg=self._pg_arg).restore(app_state)
         telemetry.metrics().counter_inc(metric_names.MANAGER_RESTORES_TOTAL)
+        self._record_restore_history(step)
+
+    def _record_restore_history(self, step: int) -> None:
+        """Append the just-served restore's telemetry summary to the
+        same rolling history takes feed — recovery time is a trend
+        metric too (``doctor --trend`` baselines per kind, so restore
+        rows never pollute take baselines). Rank 0 only; best-effort."""
+        if self._pg.get_rank() != 0:
+            return
+        try:
+            from .telemetry import history, last_report
+
+            report = last_report(
+                "restore", "async_restore", path=self.step_path(step)
+            )
+            if report is None:
+                return
+            history.append_summary(
+                self.root, history.summarize_report(report, step=step)
+            )
+        except Exception as e:  # noqa: BLE001 - history is best-effort
+            logger.warning(
+                "could not record step %d restore history: %r", step, e
+            )
 
     def restore_latest(self, app_state: AppState) -> Optional[int]:
         """Restore the newest committed step into ``app_state``; returns
@@ -415,7 +530,7 @@ class CheckpointManager:
         # Counted at initiation (the wait handle is Snapshot-level):
         # async resumes must move the same counter sync ones do.
         telemetry.metrics().counter_inc(metric_names.MANAGER_RESTORES_TOTAL)
-        return pending
+        return _ManagedPendingRestore(self, step, pending)
 
     def async_restore_latest(self, app_state: AppState):
         """Kick off a pipelined restore of the newest committed step;
@@ -956,4 +1071,39 @@ class CheckpointManager:
                     raise r
         finally:
             await storage.close()
+        self._post_gc_ledger(step, metadata.manifest)
         logger.info("Retention dropped step %d", step)
+
+    def _post_gc_ledger(self, step: int, manifest: Manifest) -> None:
+        """Record the GC'd step in the run ledger (bytes reclaimed —
+        base-referenced locations belong to other steps and are not
+        counted) and prune its ``step-committed`` storage records so
+        the goodput storage curve tracks what retention actually
+        keeps. Runs on rank 0 only (GC is rank-0 work); best-effort."""
+        if not knobs.is_ledger_enabled():
+            return
+        try:
+            from .fsck import blob_requirements
+            from .telemetry import ledger as run_ledger
+            from .telemetry import names as event_names
+
+            need = blob_requirements(manifest)
+            own = {
+                loc: n
+                for loc, n in need.items()
+                if not loc.startswith("../")
+            }
+            run_ledger.post_event(
+                self.root,
+                event_names.EVENT_GC_RECLAIMED,
+                step=step,
+                bytes_reclaimed=int(sum(own.values())),
+                blobs=len(own),
+            )
+            run_ledger.prune_steps(self.root, {step})
+        except Exception as e:  # noqa: BLE001 - GC must not fail a save
+            logger.warning(
+                "could not record GC of step %d in the run ledger: %r",
+                step,
+                e,
+            )

@@ -85,6 +85,13 @@ class PreemptionSaver:
             marker land. Defaults to ``max(1, 2 * poll_interval)``;
             deployments with slow coordination stores should widen it
             (the marker publish must fit inside it).
+        ledger_root: the CheckpointManager root whose run ledger
+            (telemetry/ledger.py) should record this saver's
+            preemption events — the step the world was at when the
+            notice landed, and the agreed save target (or the
+            give-up). The goodput engine's lost-work accounting
+            anchors on these records. Rank 0 posts only; None (the
+            default) records nothing.
     """
 
     def __init__(
@@ -97,8 +104,10 @@ class PreemptionSaver:
         session: str = "",
         poll_interval: float = 1.0,
         peer_grace: Optional[float] = None,
+        ledger_root: Optional[str] = None,
     ) -> None:
         self._pg = PGWrapper(pg)
+        self._ledger_root = ledger_root
         # Store keys are namespaced per session: saver lifetimes sharing
         # one persistent store (restarted loops, tests over one
         # coordinator) must not observe each other's stale flag/step
@@ -137,6 +146,22 @@ class PreemptionSaver:
 
     def _key(self, suffix: str) -> str:
         return f"{_PREFIX}/{self._session}/{suffix}"
+
+    def _post_ledger(self, **fields: Any) -> None:
+        """Record a preemption event in the run ledger (rank 0 only;
+        no-op without a ``ledger_root``). Best-effort — ledger posting
+        must never perturb the agreement protocol."""
+        if self._ledger_root is None or self._pg.get_rank() != 0:
+            return
+        try:
+            from .telemetry import ledger as run_ledger
+            from .telemetry import names as event_names
+
+            run_ledger.post_event(
+                self._ledger_root, event_names.EVENT_PREEMPTION, **fields
+            )
+        except Exception as e:  # noqa: BLE001 - ledger is best-effort
+            logger.warning("preemption ledger post failed: %r", e)
 
     def _ensure_poller(self, store) -> None:
         """Background flag watcher: the training loop's should_save does
@@ -255,6 +280,7 @@ class PreemptionSaver:
         if store is None or self._pg.get_world_size() <= 1:
             if self._flagged.is_set():
                 self._saved = True
+                self._post_ledger(step=step, target_step=step)
                 return True
             return False
 
@@ -278,6 +304,12 @@ class PreemptionSaver:
             if self._target_step is None:
                 self._give_up(store)
                 return False
+            # The lost-work anchor: where this rank was when the world
+            # agreed, and the step the save will capture. A crash
+            # before that save commits loses target - last_committed
+            # steps; a clean save zeroes the loss (the goodput engine
+            # compares against the segment's last step-committed).
+            self._post_ledger(step=step, target_step=self._target_step)
             logger.warning(
                 "preemption agreed: world saves at step %d",
                 self._target_step,
@@ -369,6 +401,7 @@ class PreemptionSaver:
         rendezvous would otherwise complete against this rank's stale
         step key cannot save alone (the asymmetric-deadlock case)."""
         self._gave_up = True
+        self._post_ledger(gave_up=True)
         try:
             store.set(self._key("abandoned"), b"1")
         except Exception:  # noqa: BLE001 - already giving up

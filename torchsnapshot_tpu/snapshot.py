@@ -251,6 +251,16 @@ def _emit_snapshot_report(
                         spread["straggler"],
                     )
         telemetry.emit_report(report, registry)
+        # Run-ledger events (rank 0 only; the owned-root gate inside
+        # post_op_event additionally restricts posting to the process
+        # whose manager opened the run — ad-hoc snapshots never post):
+        # takes record their training-visible stall + overlapped drain,
+        # restores the recovery time served. Failed ops post nothing —
+        # their cost lands in the segment's lost-work bucket instead.
+        if error is None and pg_wrapper.get_rank() == 0:
+            from .telemetry import ledger as run_ledger
+
+            run_ledger.post_op_event(kind, path, report)
         if trace_mark is not None:
             export_op_trace(kind, path, pg_wrapper.get_rank(), trace_mark)
     except Exception as e:  # noqa: BLE001 - telemetry must not fail the op

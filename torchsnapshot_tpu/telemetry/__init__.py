@@ -18,6 +18,15 @@ layers — exported per operation as Chrome trace JSON (knob-gated, like
 the sinks), merged cross-rank by ``python -m torchsnapshot_tpu.telemetry
 trace``, and patrolled by the stall watchdog (watchdog.py).
 
+Above the per-op layers sits the **run ledger** (ledger.py —
+crash-safe ``<root>/.ledger.jsonl`` of typed run events, rank-0-only,
+resumable across restarts) and the **goodput engine** (goodput.py)
+that attributes a whole run's wall time into train vs.
+checkpoint-overhead buckets and storage-cost curves — ``python -m
+torchsnapshot_tpu.telemetry goodput <root>``, ``goodput_*`` gauges,
+and the doctor's ``goodput-degraded`` / ``recovery-cost-high`` rules.
+See docs/goodput.md.
+
 Three further layers make the telemetry *operable*: live per-rank
 progress heartbeats for operations in flight (progress.py —
 ``current_progress()`` in-process, atomically-rewritten
@@ -34,7 +43,7 @@ report schema, sink knobs, and CLI.
 
 from __future__ import annotations
 
-from . import doctor, history, names, progress, trace, watchdog
+from . import doctor, goodput, history, ledger, names, progress, trace, watchdog
 from .registry import (
     DEFAULT_SECONDS_BUCKETS,
     MetricsRegistry,
@@ -69,7 +78,9 @@ __all__ = [
     "doctor",
     "emit_report",
     "events_path_for",
+    "goodput",
     "history",
+    "ledger",
     "last_report",
     "load_events",
     "merge_pipeline_telemetry",

@@ -80,6 +80,16 @@ READ_AMPLIFIED_FACTOR = 1.5
 # window: oscillation slower than the regression baseline can see is
 # indistinguishable from adaptation).
 TUNER_THRASH_WINDOW = 8
+# goodput-degraded: the run spent at least this fraction of its
+# ledger-measured wall time on checkpoint overhead (visible stalls +
+# restores + lost work), over at least this much wall (short runs'
+# fixed costs — a cold restore, one take — are not a trend).
+GOODPUT_DEGRADED_FRAC = 0.15
+GOODPUT_MIN_WALL_S = 30.0
+# recovery-cost-high: one interruption's checkpoint-attributable price
+# (work replayed since the last committed step + the restore that
+# recovered it) reached this many seconds.
+RECOVERY_COST_S = 60.0
 # Bench-trial epistemics (formerly private to bench.py):
 # adjacent probes disagreeing beyond this factor = unstable link;
 # achieved/bracket below this ratio on a stable bracket = in-take stall.
@@ -212,6 +222,12 @@ class Evidence:
     # snapshot dir or its manager root), when one exists.
     tuner_state: Optional[Dict[str, Any]] = None
     tuner_state_file: str = ""
+    # The run ledger (.ledger.jsonl at the manager root that owns this
+    # snapshot), when one exists: the goodput rules' evidence.
+    ledger_records: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    ledger_file: str = ""
 
 
 def gather_evidence(snapshot_path: str) -> Evidence:
@@ -289,6 +305,15 @@ def gather_evidence(snapshot_path: str) -> Evidence:
                     break
     except Exception as e:  # noqa: BLE001
         logger.warning("doctor: could not load tuner state: %r", e)
+    try:
+        from .ledger import find_ledger_for, load_ledger
+
+        lf = find_ledger_for(snapshot_path)
+        if lf is not None:
+            ev.ledger_records = load_ledger(lf)
+            ev.ledger_file = lf
+    except Exception as e:  # noqa: BLE001
+        logger.warning("doctor: could not load run ledger: %r", e)
     return ev
 
 
@@ -734,6 +759,100 @@ def _tuner_thrashing(ev: Evidence):
     return out or None
 
 
+@doctor_rule(names.RULE_GOODPUT_DEGRADED, scope="evidence")
+def _goodput_degraded(ev: Evidence):
+    """The run ledger shows checkpointing eating more than the overhead
+    budget of this run's wall time. Per-op telemetry cannot see this —
+    every individual take can be within its own thresholds while the
+    cadence/latency product still swallows the run; the run-level
+    fraction is what decides checkpoint interval and tiering policy
+    (docs/goodput.md)."""
+    if not ev.ledger_records:
+        return None
+    from .goodput import analyze, latest_run
+
+    run = latest_run(analyze(ev.ledger_records))
+    if run is None or run["wall_s"] < GOODPUT_MIN_WALL_S:
+        return None
+    if run["overhead_fraction"] < GOODPUT_DEGRADED_FRAC:
+        return None
+    return {
+        "summary": (
+            "checkpointing consumed a large fraction of this run's wall "
+            "time (visible stalls + restores + lost work against the "
+            "run ledger); raise the save interval, move to async/tiered "
+            "takes, or cut recovery cost"
+        ),
+        "evidence": {
+            "run_id": run["run_id"],
+            "overhead_fraction": run["overhead_fraction"],
+            "wall_s": run["wall_s"],
+            "visible_stall_s": run["visible_stall_s"],
+            "restore_s": run["restore_s"],
+            "lost_work_s": run["lost_work_s"],
+            "steps_committed": run["steps_committed"],
+            "ledger_events": len(ev.ledger_records),
+            "threshold_frac": GOODPUT_DEGRADED_FRAC,
+        },
+        "source": os.path.basename(ev.ledger_file),
+    }
+
+
+@doctor_rule(names.RULE_RECOVERY_COST_HIGH, scope="evidence")
+def _recovery_cost_high(ev: Evidence):
+    """An interruption recorded in the run ledger cost more than the
+    recovery budget: the work replayed since the last committed step
+    plus the restore that recovered it. Evidence cites the ledger's
+    preemption/step-committed/restore-served records — the fix is a
+    tighter checkpoint interval (or peer-redundant hot checkpoints),
+    not a faster individual save."""
+    if not ev.ledger_records:
+        return None
+    from .goodput import analyze
+
+    out = []
+    for run in analyze(ev.ledger_records)["runs"]:
+        for itr in run["interruptions"]:
+            if itr["recovery_cost_s"] < RECOVERY_COST_S:
+                continue
+            where = (
+                f"preemption at step {itr['preemption_step']}"
+                if itr["preemption_step"] is not None
+                else f"segment {itr['segment']}'s interruption"
+            )
+            lost_steps = (
+                f" ({itr['lost_steps']} step(s) replayed)"
+                if itr["lost_steps"] is not None
+                else ""
+            )
+            out.append(
+                {
+                    "summary": (
+                        f"{where} cost "
+                        f"{itr['recovery_cost_s']:.1f}s to recover: "
+                        f"{itr['lost_work_s']:.1f}s of lost work"
+                        f"{lost_steps} + {itr['restore_s']:.1f}s of "
+                        f"restore"
+                    ),
+                    "evidence": {
+                        "run_id": run["run_id"],
+                        "segment": itr["segment"],
+                        "recovery_cost_s": itr["recovery_cost_s"],
+                        "lost_work_s": itr["lost_work_s"],
+                        "lost_steps": itr["lost_steps"],
+                        "restore_s": itr["restore_s"],
+                        "restart_gap_s": itr["restart_gap_s"],
+                        "preemption_step": itr["preemption_step"],
+                        "last_committed_step": itr["last_committed_step"],
+                        "threshold_s": RECOVERY_COST_S,
+                    },
+                    "source": os.path.basename(ev.ledger_file),
+                    "severity": "warning",
+                }
+            )
+    return out or None
+
+
 @doctor_rule(names.RULE_MIRROR_LAGGING, scope="evidence")
 def _mirror_lagging_live(ev: Evidence):
     m = ev.mirror_state
@@ -803,6 +922,30 @@ def diagnose_snapshot(snapshot_path: str) -> List[Verdict]:
     """The library entry point ``fsck``/operators use: gather the
     snapshot's artifacts, run every rule, return ranked verdicts."""
     return diagnose_evidence(gather_evidence(snapshot_path))
+
+
+def diagnose_ledger(root: str) -> List[Verdict]:
+    """Run-level diagnosis from the ledger alone (the goodput rules):
+    what ``doctor --trend`` appends so trend regressions speak in run
+    cost, not just per-op latency. [] when no ledger exists."""
+    from .ledger import find_ledger_for, load_ledger
+
+    lf = find_ledger_for(root)
+    if lf is None:
+        return []
+    ev = Evidence(path=root, ledger_records=load_ledger(lf), ledger_file=lf)
+    verdicts: List[Verdict] = []
+    for rule in _EVIDENCE_RULES:
+        if rule.rule_id not in (
+            names.RULE_GOODPUT_DEGRADED,
+            names.RULE_RECOVERY_COST_HIGH,
+        ):
+            continue
+        try:
+            verdicts.extend(_as_verdicts(rule.rule_id, rule.fn(ev)))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("doctor: rule %s failed: %r", rule.rule_id, e)
+    return rank_verdicts(verdicts)
 
 
 # ---------------------------------------------------------------------------
@@ -984,6 +1127,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 1
         records = load_history(path)
         verdicts = diagnose_trend(records, window=args.window)
+        # Run-level context rides along: a manager root with a ledger
+        # gets the goodput verdicts appended, so a per-step regression
+        # and its run-level cost appear in one report.
+        verdicts = rank_verdicts(
+            [*verdicts, *diagnose_ledger(args.target)]
+        )
         if args.json:
             print(_json.dumps([v.to_dict() for v in verdicts], indent=1))
         else:

@@ -2,7 +2,8 @@
 
 BENCH_r05's 120s -> 71s take-time swing was only diagnosable because a
 human happened to be comparing two BENCH records by hand. This module
-makes the comparison structural: every committed manager step appends a
+makes the comparison structural: every committed manager step — and
+every manager-served restore, so recovery time trends too — appends a
 compact summary of its SnapshotReport to
 ``<root>/.telemetry-history.jsonl`` (rank 0, local roots; a tiered root
 uses its fast tier), bounded to the newest
@@ -217,38 +218,49 @@ def detect_trend_regressions(
     min_rel: float = TREND_MIN_REL,
 ) -> List[Dict[str, Any]]:
     """Regression evidence rows over a history (oldest first): each row
-    names the record (step/path), the metric, its value, and the rolling
-    baseline (median, MAD over the preceding ``window`` records) it
-    breached. Throughput regresses downward; times upward."""
+    names the record (step/path/kind), the metric, its value, and the
+    rolling baseline (median, MAD over the preceding ``window`` records
+    *of the same kind*) it breached. Throughput regresses downward;
+    times upward. Kinds are separate populations: now that restores
+    append history rows too (recovery-time trends), a restore's wall
+    must neither pollute the take baseline nor be judged against it."""
     out: List[Dict[str, Any]] = []
-    if len(records) <= TREND_MIN_BASELINE:
-        return out
-    series = _metric_series(records)
-    for metric, values in series.items():
-        sign = _direction(metric)
-        for i in range(TREND_MIN_BASELINE, len(values)):
-            baseline = values[max(0, i - window) : i]
-            if len(baseline) < TREND_MIN_BASELINE:
-                continue
-            med = statistics.median(baseline)
-            mad = statistics.median(abs(v - med) for v in baseline)
-            threshold = max(
-                mad_k * mad, min_rel * abs(med), _abs_floor(metric)
-            )
-            deviation = sign * (values[i] - med)
-            if deviation > threshold:
-                rec = records[i]
-                out.append(
-                    {
-                        "index": i,
-                        "step": rec.get("step"),
-                        "path": rec.get("path"),
-                        "metric": metric,
-                        "value": round(values[i], 3),
-                        "baseline_median": round(med, 3),
-                        "baseline_mad": round(mad, 3),
-                        "threshold": round(threshold, 3),
-                        "window": len(baseline),
-                    }
+    by_kind: Dict[str, List[int]] = {}
+    for i, rec in enumerate(records):
+        by_kind.setdefault(str(rec.get("kind") or "take"), []).append(i)
+    for kind in sorted(by_kind):
+        indices = by_kind[kind]
+        if len(indices) <= TREND_MIN_BASELINE:
+            continue
+        group = [records[i] for i in indices]
+        series = _metric_series(group)
+        for metric, values in series.items():
+            sign = _direction(metric)
+            for i in range(TREND_MIN_BASELINE, len(values)):
+                baseline = values[max(0, i - window) : i]
+                if len(baseline) < TREND_MIN_BASELINE:
+                    continue
+                med = statistics.median(baseline)
+                mad = statistics.median(abs(v - med) for v in baseline)
+                threshold = max(
+                    mad_k * mad, min_rel * abs(med), _abs_floor(metric)
                 )
+                deviation = sign * (values[i] - med)
+                if deviation > threshold:
+                    rec = group[i]
+                    out.append(
+                        {
+                            "index": indices[i],
+                            "step": rec.get("step"),
+                            "kind": kind,
+                            "path": rec.get("path"),
+                            "metric": metric,
+                            "value": round(values[i], 3),
+                            "baseline_median": round(med, 3),
+                            "baseline_mad": round(mad, 3),
+                            "threshold": round(threshold, 3),
+                            "window": len(baseline),
+                        }
+                    )
+    out.sort(key=lambda row: (row["index"], row["metric"]))
     return out

@@ -69,6 +69,22 @@ RSS_PEAK_DELTA_BYTES = "rss_peak_delta_bytes"
 
 WATCHDOG_STALLS_TOTAL = "watchdog_stalls_total"
 
+# -- run-level goodput (telemetry/goodput.py) --------------------------------
+#
+# Gauges refreshed from the run ledger after every committed manager
+# step (and by the ``goodput`` CLI): the run-so-far attribution of wall
+# time into train vs. checkpoint-overhead buckets, plus the storage
+# spend per retained step. See docs/goodput.md.
+
+GOODPUT_OVERHEAD_FRACTION = "goodput_overhead_fraction"
+GOODPUT_TRAIN_SECONDS = "goodput_train_seconds"
+GOODPUT_VISIBLE_STALL_SECONDS = "goodput_visible_stall_seconds"
+GOODPUT_RECOVERY_SECONDS = "goodput_recovery_seconds"
+GOODPUT_LOST_WORK_SECONDS = "goodput_lost_work_seconds"
+GOODPUT_LOST_STEPS = "goodput_lost_steps"
+GOODPUT_STORAGE_BYTES_PER_STEP = "goodput_storage_bytes_per_step"
+GOODPUT_INCREMENTAL_REUSE_RATIO = "goodput_incremental_reuse_ratio"
+
 # ---------------------------------------------------------------------------
 # Flight-recorder span/instant names (telemetry/trace.py).
 #
@@ -189,3 +205,49 @@ RULE_LINK_UNSTABLE = "link-unstable"
 # Trend analysis: a step's metric sits beyond median + k*MAD of its
 # rolling baseline.
 RULE_TREND_REGRESSION = "trend-regression"
+# Run-level goodput (ledger-driven): checkpointing ate more than the
+# overhead-fraction threshold of this run's wall time (visible stalls +
+# restores + lost work against the run ledger's measured span).
+RULE_GOODPUT_DEGRADED = "goodput-degraded"
+# An interruption's recovery cost (work lost since the last committed
+# step plus the restore that followed) exceeded the recovery budget —
+# the checkpoint interval, not the per-save latency, is what needs
+# attention (evidence cites the ledger records).
+RULE_RECOVERY_COST_HIGH = "recovery-cost-high"
+
+# ---------------------------------------------------------------------------
+# Run-ledger event ids (telemetry/ledger.py).
+#
+# Same single-registration rule as the families above, with the doctor
+# rules' kebab-case convention. ``EVENT_``-prefixed constants name the
+# typed records the manager, snapshot envelopes, tiered mirror,
+# preemption saver, and GC post to ``<root>/.ledger.jsonl``; snaplint's
+# ``ledger-event-ids`` rule lints both halves: declared exactly once
+# here, kebab-case values, no literal event strings at
+# ``post_event``/``post_event_for_snapshot`` call sites.
+# ---------------------------------------------------------------------------
+
+# A manager opened (or resumed) a run at a root: carries the stable
+# run id and the 1-based segment number (one segment per process
+# lifetime; a restart resumes the run id and increments the segment).
+EVENT_RUN_START = "run-start"
+# A step committed through the manager: the retention-visible moment,
+# with the step's storage accounting (new vs. base-referenced bytes).
+EVENT_STEP_COMMITTED = "step-committed"
+# A take/async_take blocked training for its visible span (the whole
+# wall for sync takes; return-to-caller for async ones).
+EVENT_VISIBLE_STALL = "visible-stall"
+# An async take's background D2H + serialize drain finished — overhead
+# that OVERLAPPED training rather than stalling it.
+EVENT_STAGED_DRAIN = "staged-drain"
+# A tiered mirror job settled: how long the step's bytes existed only
+# on the fast tier, and what replication moved.
+EVENT_MIRROR_SETTLED = "mirror-settled"
+# A restore/async_restore completed: recovery (or resume) time paid.
+EVENT_RESTORE_SERVED = "restore-served"
+# The preemption saver agreed a coordinated save target (or gave up):
+# the interruption point the lost-work accounting anchors on.
+EVENT_PREEMPTION = "preemption"
+# Retention GC deleted a step's blobs; its step-committed storage
+# records are pruned from the ledger in the same pass.
+EVENT_GC_RECLAIMED = "gc-reclaimed"

@@ -14,6 +14,9 @@ CLI (also ``python -m torchsnapshot_tpu.telemetry`` and
                                           # (telemetry/doctor.py)
     snapshot-stats trend <manager-root>   # per-step regression check
                                           # (doctor --trend shorthand)
+    snapshot-stats goodput <manager-root> # run-level wall-time
+                                          # attribution + storage spend
+                                          # (telemetry/goodput.py)
 
 Output: one row per (path, kind, rank) record — phase durations,
 bytes, throughput, budget wait, retries — followed by a per-tier
@@ -196,6 +199,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .doctor import main as doctor_main
 
         return doctor_main(["--trend", *argv[1:]])
+    if argv and argv[0] == "goodput":
+        # ``python -m torchsnapshot_tpu.telemetry goodput <root>``:
+        # run-level wall-time attribution + storage-cost curves from
+        # the run ledger (telemetry/goodput.py).
+        from .goodput import main as goodput_main
+
+        return goodput_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="snapshot-stats",

@@ -355,6 +355,19 @@ class Mirror:
                 error=repr(job.error) if job.error is not None else None,
             )
             telemetry.emit_report(report, registry)
+            # Run-ledger settle event: how long the step's bytes existed
+            # only on the fast tier. The owned-root gate inside the post
+            # keeps this rank-0-only (co-hosted non-leader ranks' mirrors
+            # resolve to an un-owned ledger and never write).
+            from ..telemetry import ledger as run_ledger
+
+            run_ledger.post_mirror_settled(
+                job.fast_url,
+                lag_s=time.monotonic() - job.created_ts,
+                nbytes=job.bytes_done,
+                blobs=job.blobs_done,
+                error=job.error,
+            )
             # Per-job trace export: the mirror's span window (job span,
             # per-blob spans, retry instants) lands next to the fast
             # tier's take trace. The Mirror has no rank (plugins are
