@@ -513,6 +513,34 @@ def run_subprocess_legs() -> None:
             )
         _emit_partial("fanout_restore")
 
+    if _have_budget("peer_restore", 180):
+        # The recovery half of the robustness story: 2-proc save with
+        # the peer-RAM tier pushing shards into the ring neighbor,
+        # rank 1 "preempted" (cache wiped, replacement re-announces),
+        # then restore with peer on vs kill-switched off — recording
+        # the replacement's recovery wall and the per-tier byte split
+        # (peer vs storage) the ledger's restore-served events carry.
+        # docs/peer.md.
+        pr = _subprocess_json(
+            "peer-restore",
+            ("benchmarks", "peer_restore.py"),
+            ["--mib", "64", "--json"],
+            timeout=420,
+        )
+        if pr is not None:
+            RESULT["peer_restore"] = pr
+            RESULT["peer_recovery_wall_s"] = pr.get("peer_recovery_wall_s")
+            RESULT["fallback_recovery_wall_s"] = pr.get(
+                "fallback_recovery_wall_s"
+            )
+            _log(
+                f"bench: peer-tier recovery "
+                f"{pr.get('peer_recovery_wall_s')} s (tier split "
+                f"{pr.get('peer_recovery_tier_split')}) vs fallback "
+                f"{pr.get('fallback_recovery_wall_s')} s from storage"
+            )
+        _emit_partial("peer_restore")
+
 
 def cold_start_rows() -> None:
     """Restore-to-step0 (BASELINE.md north star): sync restore wall vs
@@ -631,9 +659,23 @@ def preemption_leg(workdir: str, total_bytes: int, est_take_s: float) -> None:
         restored = mgr2.restore_latest({"state": ts.PyTreeState(dest)})
         restore_s = time.perf_counter() - t0
         del state, dest
+        # Recovery accounting the peer tier adds (docs/peer.md): the
+        # wall the fleet paid for this restore and which tier of the
+        # peer -> fast -> durable ladder served the bytes (single
+        # process here, so the split is storage-only; the 2-proc
+        # peer_restore leg pins the peer-served case).
+        from torchsnapshot_tpu import telemetry as _telemetry
+
+        recovery_report = _telemetry.last_report(
+            "restore", path=mgr2.step_path(restored)
+        ) if restored is not None else None
         RESULT["preemption"] = {
             "restored_step": restored,
             "restore_s": round(restore_s, 3),
+            "recovery_wall_s": round(restore_s, 3),
+            "recovery_tier_split": (
+                recovery_report.tier_split if recovery_report else None
+            ),
             "goodput": _ledger_goodput(root),
         }
         _log(

@@ -1,0 +1,128 @@
+"""Preemption recovery via the peer-RAM tier on a 2-process fleet.
+
+The recovery half of the BENCH record's robustness story: a 2-process
+group saves a step with the peer tier pushing each rank's shards into
+its ring neighbor's host RAM, rank 1 is then "preempted" (its peer
+cache and process-local tier state are wiped and rebuilt — the
+replacement-rank scenario), and the world restores — once with the
+peer tier ON (the replacement's bytes ride the surviving peer's RAM)
+and once kill-switched OFF (every byte comes from storage). Records
+``recovery_wall_s`` and the ledger-shaped ``recovery_tier_split``
+(bytes served per tier of the peer -> fast -> durable ladder) for
+both runs. Spawned by bench.py's subprocess-leg runner; emits one JSON
+line on stdout.
+
+    python benchmarks/peer_restore.py --mib 64 --json
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _state(rank: int, mib: float):
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+
+    n = max(1024, int(mib * 1024 * 1024 / 4))
+    return {
+        "model": ts.PyTreeState(
+            {"w": (np.arange(n, dtype=np.float32) + rank)}
+        )
+    }
+
+
+def _recover_worker(pg, root, mib, enabled):
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu import telemetry
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.tiered import peer
+
+    os.environ["TORCHSNAPSHOT_TPU_PEER_TIER"] = "1" if enabled else "0"
+    wrapper = PGWrapper(pg)
+    mgr = ts.CheckpointManager(root, pg=pg)
+    mgr.save(0, _state(pg.rank, mib))
+    peer.maybe_drain(timeout=60)
+    wrapper.barrier()
+
+    if pg.rank == 1:
+        # Simulated single-rank preemption: the host died, its peer
+        # cache with it; the replacement re-announces under rank 1.
+        peer.reset_peer_tier()
+        peer.maybe_configure(wrapper)
+    wrapper.barrier()
+
+    dest = _state(pg.rank, mib)
+    np.asarray(dest["model"].tree["w"]).fill(0)
+    t0 = time.perf_counter()
+    step = mgr.restore_latest(dest)
+    wall = time.perf_counter() - t0
+    assert step == 0
+    expect = _state(pg.rank, mib)["model"].tree["w"]
+    np.testing.assert_array_equal(dest["model"].tree["w"], expect)
+    report = telemetry.last_report("restore", path=mgr.step_path(0))
+    return {
+        "rank": pg.rank,
+        "restore_s": round(wall, 3),
+        "tier_split": report.tier_split if report else None,
+        "peer": report.peer if report else None,
+        "bytes_moved": report.bytes_moved if report else None,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mib", type=float, default=64.0)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    out = {"state_mib_per_rank": args.mib}
+    for enabled, key in ((True, "peer"), (False, "fallback")):
+        root = os.path.join(
+            tempfile.mkdtemp(prefix="ts-peer-bench-"), "ckpt"
+        )
+        rows = run_multiprocess(
+            _recover_worker,
+            nproc=2,
+            args=(root, args.mib, enabled),
+            timeout=300,
+        )
+        # The replacement rank (1) is the recovery that matters: its
+        # host died, so every byte it gets at RAM speed is storage
+        # latency not paid.
+        replacement = next(r for r in rows if r["rank"] == 1)
+        split = {}
+        for r in rows:
+            for tier, b in (r.get("tier_split") or {}).items():
+                split[tier] = split.get(tier, 0) + int(b)
+        out[f"{key}_recovery_wall_s"] = replacement["restore_s"]
+        out[f"{key}_recovery_tier_split"] = split or None
+        out[f"{key}_replacement_tier_split"] = replacement.get(
+            "tier_split"
+        )
+        log(
+            f"peer-restore[{key}]: replacement restored in "
+            f"{replacement['restore_s']}s, world tier split {split}"
+        )
+    if args.json:
+        print(json.dumps(out, separators=(",", ":")), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,13 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_LEDGER", "0")
 # knobs.enable_fanout_restore() / an env override in their workers.
 os.environ.setdefault("TORCHSNAPSHOT_TPU_FANOUT_RESTORE", "0")
 
+# The peer-RAM checkpoint tier is pinned off in the suite ("0" = no
+# cache server, no pushes, no restore-ladder pulls): tier-1 manager and
+# restore tests assert about the exact pre-peer read/write paths and
+# file sets. Peer-tier tests opt back in via knobs.enable_peer_tier()
+# or an env override in their multiprocess workers.
+os.environ.setdefault("TORCHSNAPSHOT_TPU_PEER_TIER", "0")
+
 # The write-path autotuner is likewise off by default in the suite
 # ("0" = kill switch): tier-1 manager tests must run the exact
 # hand-set/default knob geometry they assert about, with no

@@ -52,6 +52,82 @@ def test_retry_gives_up_when_nobody_progresses() -> None:
         run_in_fresh_event_loop(strategy.run(op, (Transient,)))
 
 
+def test_decorrelated_backoff_schedules_diverge() -> None:
+    """The mirror-lockstep bug: N ranks losing the durable tier at the
+    same instant must NOT retry on near-identical schedules. Under
+    decorrelated jitter, two strategies' backoff sequences draw each
+    step's range from their own previous draw, so the schedules diverge
+    after the first sleep and stay diverged — unlike the old
+    exponential-with-bounded-jitter scheme, whose attempt-k draws all
+    landed in the same narrow [2^k/2, 2^k] band."""
+    import random
+
+    from torchsnapshot_tpu.storage_plugins.retry import (
+        _BACKOFF_BASE_SECONDS,
+        _BACKOFF_MAX_SECONDS,
+        decorrelated_backoff,
+    )
+
+    def schedule(seed: int, n: int = 8):
+        rng = random.Random(seed)
+        prev = _BACKOFF_BASE_SECONDS
+        out = []
+        for _ in range(n):
+            prev = decorrelated_backoff(prev, rng=rng)
+            out.append(prev)
+        return out
+
+    a, b = schedule(1), (schedule(2))
+    assert a != b
+    # Diverged means diverged: no step of the two schedules should
+    # agree to within the old scheme's band width fraction.
+    assert sum(1 for x, y in zip(a, b) if abs(x - y) > 1e-9) >= 6
+    # Bounds hold: every draw within [base, cap].
+    for s in a + b:
+        assert _BACKOFF_BASE_SECONDS <= s <= _BACKOFF_MAX_SECONDS
+    # Same seed -> same schedule (the seam tests rely on).
+    assert schedule(7) == schedule(7)
+
+
+def test_retry_run_uses_decorrelated_backoff_rng_seam() -> None:
+    """Two strategies retrying the same failing op under different RNG
+    seeds must sleep different amounts — pinned via the per-instance
+    rng seam and the recorded backoff totals."""
+    import random
+
+    totals = []
+    for seed in (11, 12):
+        strategy = CollectiveProgressRetryStrategy(
+            progress_window_seconds=30, rng=random.Random(seed)
+        )
+        attempts = 0
+
+        async def op():
+            nonlocal attempts
+            attempts += 1
+            if attempts < 3:
+                raise Transient()
+            return "ok"
+
+        async def main():
+            # Patch out the real sleep: the schedules, not the wall
+            # clock, are under test.
+            orig = asyncio.sleep
+
+            async def fake_sleep(_s):
+                await orig(0)
+
+            asyncio.sleep, restore = fake_sleep, orig
+            try:
+                return await strategy.run(op, (Transient,))
+            finally:
+                asyncio.sleep = restore
+
+        assert run_in_fresh_event_loop(main()) == "ok"
+        totals.append(strategy.backoff_s_total)
+    assert totals[0] != totals[1]
+
+
 def test_retry_nonretriable_raises_immediately() -> None:
     strategy = CollectiveProgressRetryStrategy(progress_window_seconds=30)
 

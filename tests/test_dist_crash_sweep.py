@@ -174,3 +174,114 @@ def test_restore_crash_sweep(pg) -> None:
     # sync/read, async/setup, sync/plan, async/plan
     for seed in (0, 4, 13, 17):
         _restore_case(pg, seed)
+
+
+# ---------------------------------------------------------------------------
+# Peer-tier sweep (docs/peer.md degradation matrix): kill the peer at
+# every stage of the push/pull lifecycle; the restore must fall through
+# the peer -> storage ladder to CORRECT bytes — bounded, never a hang —
+# and the ledger must record which tier served the shards.
+# ---------------------------------------------------------------------------
+
+
+def _peer_case(pg, scenario: str) -> None:
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu import telemetry
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.tiered import peer
+
+    os.environ["TORCHSNAPSHOT_TPU_PEER_TIER"] = "1"
+    os.environ["TORCHSNAPSHOT_TPU_PEER_TRANSFER_TIMEOUT_SECONDS"] = "1.5"
+    os.environ["TORCHSNAPSHOT_TPU_LEDGER"] = "1"
+
+    root = os.path.join(tempfile.gettempdir(), f"peer-sweep-{scenario}")
+    wrapper = PGWrapper(pg)
+    if pg.rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    wrapper.barrier()
+
+    # Fresh tier per scenario: the previous scenario may have killed
+    # this rank's server; a replacement always re-announces.
+    peer.reset_peer_tier()
+    n = 50_000
+    state = {
+        "m": ts.PyTreeState(
+            {"w": np.arange(n, dtype=np.float32) + pg.rank}
+        )
+    }
+    mgr = ts.CheckpointManager(root, pg=pg)
+    assert peer.get_replicator().configured
+    wrapper.barrier()
+
+    def _kill_own_server() -> None:
+        rep = peer.get_replicator()
+        rep._server.shutdown()
+        rep._server.server_close()
+
+    if scenario == "dead-mid-push" and pg.rank == 1:
+        # Rank 0's ring target dies before/while rank 0 pushes: the
+        # push job must time out, degrade, and never wedge the save.
+        _kill_own_server()
+    wrapper.barrier()
+
+    t0 = time.monotonic()
+    mgr.save(0, state)
+    if scenario == "dead-between-commit-and-drain" and pg.rank == 1:
+        # The commit landed; the peer dies before the drain settles.
+        _kill_own_server()
+    assert peer.maybe_drain(timeout=60), "peer drain wedged"
+    assert time.monotonic() - t0 < 90.0, f"{scenario}: push path wedged"
+    wrapper.barrier()
+
+    if scenario == "dead-mid-pull" and pg.rank == 1:
+        # Healthy push, then the peer dies before the restore pulls.
+        _kill_own_server()
+    wrapper.barrier()
+
+    dest = {"m": ts.PyTreeState({"w": np.zeros(n, dtype=np.float32)})}
+    t0 = time.monotonic()
+    assert mgr.restore_latest(dest) == 0
+    assert time.monotonic() - t0 < 90.0, f"{scenario}: restore wedged"
+    # Bytes match durable truth on EVERY rank, whatever tier served.
+    np.testing.assert_array_equal(
+        dest["m"].tree["w"], np.arange(n, dtype=np.float32) + pg.rank
+    )
+    report = telemetry.last_report("restore", path=mgr.step_path(0))
+    if report is not None and report.tier_split is not None:
+        # Whatever the ladder served must account for real bytes; the
+        # dead-peer side contributes durable/fast bytes only.
+        assert sum(report.tier_split.values()) > 0
+    wrapper.barrier()
+    if pg.rank == 0:
+        from torchsnapshot_tpu.telemetry.ledger import (
+            ledger_path_for,
+            load_ledger,
+        )
+
+        records = load_ledger(ledger_path_for(root))
+        served = [
+            r for r in records if r.get("event") == "restore-served"
+        ]
+        assert served, f"{scenario}: no restore-served ledger record"
+        if scenario == "dead-mid-pull":
+            # Rank 1's SERVER died, but rank 1's shards live in rank
+            # 0's surviving cache: rank 1's restore still rides the
+            # peer tier, so the world split must show peer bytes
+            # (rank 0's own shards fall through to storage — its ring
+            # target was the dead server).
+            tier_split = served[-1].get("tier_split") or {}
+            assert tier_split.get("peer", 0) > 0, served[-1]
+    wrapper.barrier()
+    peer.reset_peer_tier()
+
+
+@multiprocess_test(nproc=2)
+def test_peer_tier_crash_sweep(pg) -> None:
+    for scenario in (
+        "dead-mid-push",
+        "dead-mid-pull",
+        "dead-between-commit-and-drain",
+    ):
+        _peer_case(pg, scenario)

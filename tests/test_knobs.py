@@ -178,6 +178,41 @@ def test_fanout_restore_knob() -> None:
             os.environ["TORCHSNAPSHOT_TPU_FANOUT_RESTORE"] = prev
 
 
+def test_peer_tier_knobs() -> None:
+    """Suite default (conftest) pins the peer tier off; the packaged
+    default (no env var) is ON — but inert until a multi-rank pg with a
+    store configures the replicator, so single-process jobs never start
+    a server. Ring offset, cache budget and transfer timeout resolve
+    env > default."""
+    assert not knobs.is_peer_tier_enabled()  # conftest pin
+    with knobs.enable_peer_tier():
+        assert knobs.is_peer_tier_enabled()
+    assert not knobs.is_peer_tier_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_PEER_TIER", None)
+    try:
+        assert knobs.is_peer_tier_enabled()
+        with knobs.disable_peer_tier():
+            assert not knobs.is_peer_tier_enabled()
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_PEER_TIER"] = prev
+
+    assert knobs.get_peer_ring_offset() == 1
+    with knobs.override_peer_ring_offset(3):
+        assert knobs.get_peer_ring_offset() == 3
+    assert knobs.get_peer_ring_offset() == 1
+
+    assert knobs.get_peer_cache_budget_bytes() == 1024 * 1024 * 1024
+    with knobs.override_peer_cache_budget_bytes(1234):
+        assert knobs.get_peer_cache_budget_bytes() == 1234
+    assert knobs.get_peer_cache_budget_bytes() == 1024 * 1024 * 1024
+
+    assert knobs.get_peer_transfer_timeout_seconds() == 30.0
+    with knobs.override_peer_transfer_timeout_seconds(2.5):
+        assert knobs.get_peer_transfer_timeout_seconds() == 2.5
+    assert knobs.get_peer_transfer_timeout_seconds() == 30.0
+
+
 def test_memory_budget_fraction_knob() -> None:
     assert knobs.get_memory_budget_fraction() == 0.6
     with knobs.override_memory_budget_fraction(0.3):

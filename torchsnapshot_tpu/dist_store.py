@@ -30,7 +30,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _DEFAULT_TIMEOUT_S = 300.0
 _POLL_INTERVAL_S = 0.005
@@ -284,14 +284,22 @@ class Store(abc.ABC):
 _CMD_SET, _CMD_TRY_GET, _CMD_ADD, _CMD_DELETE = 0, 1, 2, 3
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    """Length-prefixed frame write — the one wire framing shared by the
+    TCP store and the peer-tier transport (tiered/peer.py), so the two
+    socket protocols cannot drift in how they delimit messages."""
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
 
-def _recv_msg(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket) -> bytes:
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack("<I", header)
     return _recv_exact(sock, length)
+
+
+# Internal aliases kept for the store's own call sites.
+_send_msg = send_frame
+_recv_msg = recv_frame
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -652,6 +660,46 @@ def _bootstrap_tcp_store(
         return tcp
     host, port = kv.get(addr_key, timeout).decode().rsplit(":", 1)
     return TCPStore(host=host, port=int(port), is_server=False)
+
+
+# ---------------------------------------------------------------------------
+# Endpoint registry (peer-tier transport bootstrap)
+# ---------------------------------------------------------------------------
+
+_ENDPOINT_PREFIX = "__endpoint"
+
+
+def publish_endpoint(
+    store: Store, service: str, rank: int, host: str, port: int
+) -> None:
+    """Advertise a per-rank network endpoint through the coordination
+    store. Unlike collective keys, endpoint keys are a *registry*: they
+    are overwritten on re-publish (a replacement rank re-announces
+    itself after a preemption under the same rank id) and never
+    cleaned up by a counter — a surviving peer must stay discoverable
+    for the whole run. Nonce-free by design: the rank id IS the
+    identity the ring placement keys on."""
+    store.set(f"{_ENDPOINT_PREFIX}/{service}/{rank}", f"{host}:{port}".encode())
+
+
+def lookup_endpoint(
+    store: Store, service: str, rank: int
+) -> Optional[Tuple[str, int]]:
+    """The advertised ``(host, port)`` for ``rank``, or None when the
+    rank never published (or the store read failed — an unreachable
+    registry must read as "no endpoint", never raise into a restore
+    that can correctly proceed without peers)."""
+    try:
+        raw = store.try_get(f"{_ENDPOINT_PREFIX}/{service}/{rank}")
+    except Exception:
+        return None
+    if raw is None:
+        return None
+    try:
+        host, port = raw.decode().rsplit(":", 1)
+        return host, int(port)
+    except (ValueError, UnicodeDecodeError):
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from . import knobs
 from .io_types import ReadIO, StoragePlugin
@@ -283,6 +283,138 @@ def _describe_partial_mirror(
     )
 
 
+def _verify_peer_placement(path: str) -> FsckReport:
+    """``fsck --tier peer``: audit the peer-RAM placement journal.
+
+    For each rank named by the snapshot's metadata, load its
+    ``.peer_placement-rank<r>.json`` (written by that rank's push job to
+    the local/fast tier) and union the claimed blob placements; every
+    required data blob (base-referenced locations excluded — they
+    belong to another step's placement) with no claim, and every
+    placement doc recording a degraded push, lands in the report."""
+    from .storage_plugin import split_tiered_url as _split
+    from .tiered.peer import placement_doc_path
+
+    problems: List[FsckProblem] = []
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin(path)
+        try:
+            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+            try:
+                event_loop.run_until_complete(storage.read(read_io))
+                metadata = SnapshotMetadata.from_yaml(
+                    bytes(read_io.buf).decode("utf-8")
+                )
+            except FileNotFoundError:
+                problems.append(
+                    FsckProblem(
+                        SNAPSHOT_METADATA_FNAME,
+                        "missing",
+                        "no commit marker: not a committed snapshot",
+                    )
+                )
+                return FsckReport(path, 0, 0, problems, False)
+            except Exception as e:  # noqa: BLE001
+                problems.append(
+                    FsckProblem(SNAPSHOT_METADATA_FNAME, "unreadable", repr(e))
+                )
+                return FsckReport(path, 0, 0, problems, False)
+
+            tiers = _split(path)
+            placement_storage = storage
+            placement_owned = False
+            if tiers is not None:
+                # Placement docs live on the FAST tier only (they are a
+                # local operator artifact, like the mirror journal).
+                placement_storage = url_to_storage_plugin(tiers[0])
+                placement_owned = True
+            try:
+                placed: Set[str] = set()
+                docs = 0
+                for rank in range(metadata.world_size):
+                    doc_io = ReadIO(path=placement_doc_path(rank))
+                    try:
+                        event_loop.run_until_complete(
+                            placement_storage.read(doc_io)
+                        )
+                        import json as _json
+
+                        doc = _json.loads(bytes(doc_io.buf))
+                    except FileNotFoundError:
+                        continue
+                    except Exception as e:  # noqa: BLE001
+                        problems.append(
+                            FsckProblem(
+                                placement_doc_path(rank),
+                                "unreadable",
+                                repr(e),
+                            )
+                        )
+                        continue
+                    docs += 1
+                    placed.update(
+                        str(blob) for blob in doc.get("blobs", [])
+                    )
+                    degraded = (
+                        doc.get("error")
+                        or doc.get("blobs_failed")
+                        or doc.get("blobs_refused")
+                    )
+                    if degraded:
+                        problems.append(
+                            FsckProblem(
+                                placement_doc_path(rank),
+                                "unmirrored",
+                                f"degraded push: "
+                                f"{doc.get('blobs_failed', 0)} failed, "
+                                f"{doc.get('blobs_refused', 0)} refused "
+                                f"({doc.get('error')})",
+                            )
+                        )
+                need = blob_requirements(metadata.manifest)
+                required = {
+                    loc
+                    for loc in need
+                    if not loc.startswith("../")
+                }
+                if docs == 0:
+                    problems.append(
+                        FsckProblem(
+                            placement_doc_path(0),
+                            "missing",
+                            "no peer placement recorded: the peer tier "
+                            "never pushed this step (tier off, "
+                            "single-process world, or every push failed)",
+                        )
+                    )
+                else:
+                    for loc in sorted(required - placed):
+                        problems.append(
+                            FsckProblem(
+                                loc,
+                                "missing",
+                                "no peer copy recorded: a preemption now "
+                                "restores this blob from storage",
+                            )
+                        )
+                return FsckReport(
+                    path=path,
+                    blobs_checked=len(required),
+                    bytes_verified=0,
+                    problems=problems,
+                    deep=False,
+                    crcs_verified=0,
+                )
+            finally:
+                if placement_owned:
+                    event_loop.run_until_complete(placement_storage.close())
+        finally:
+            event_loop.run_until_complete(storage.close())
+    finally:
+        event_loop.close()
+
+
 def verify_snapshot(
     path: str, deep: bool = False, tier: Optional[str] = None
 ) -> FsckReport:
@@ -292,12 +424,22 @@ def verify_snapshot(
     a report with the metadata problem recorded).
 
     ``tier`` (tiered:// paths only) restricts the audit to one tier:
-    ``"fast"`` or ``"durable"``. The default audits the composed view
+    ``"fast"`` or ``"durable"``; ``"peer"`` (any path) audits the
+    peer-RAM placement journal instead of storage bytes (docs/peer.md).
+    The default audits the composed view
     (reads fall back per blob, exactly as restore would resolve them).
     Auditing the durable tier of a partially-mirrored step reports an
     ``unmirrored`` problem with the journal's progress instead of a bare
     missing-commit-marker."""
     audit_path = path
+    if tier == "peer":
+        # The peer tier is host RAM, not storage: the audit reads the
+        # placement journal each pushing rank recorded next to the
+        # snapshot (fast tier for tiered paths) and reports which
+        # required blobs have NO recorded peer copy — the offline view
+        # of what a preemption right now could and could not recover at
+        # RAM speed.
+        return _verify_peer_placement(path)
     if tier is not None:
         tiers = split_tiered_url(path)
         if tiers is None:
@@ -305,7 +447,9 @@ def verify_snapshot(
                 f"tier={tier!r} requires a tiered:// path, got {path!r}"
             )
         if tier not in ("fast", "durable"):
-            raise ValueError(f"tier must be 'fast' or 'durable', got {tier!r}")
+            raise ValueError(
+                f"tier must be 'fast', 'durable' or 'peer', got {tier!r}"
+            )
         audit_path = tiers[0] if tier == "fast" else tiers[1]
     problems: List[FsckProblem] = []
     event_loop = asyncio.new_event_loop()
@@ -404,10 +548,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     p.add_argument(
         "--tier",
-        choices=("fast", "durable"),
+        choices=("fast", "durable", "peer"),
         default=None,
         help="for tiered:// paths: audit only this tier (default: the "
-        "composed view with per-blob durable fallback)",
+        "composed view with per-blob durable fallback). 'peer' audits "
+        "the peer-RAM placement journal instead of storage bytes: "
+        "which required blobs have a recorded peer copy (docs/peer.md)",
     )
     p.add_argument(
         "--stats",

@@ -63,6 +63,12 @@ _MEMORY_BUDGET_FRACTION_ENV = "TORCHSNAPSHOT_TPU_MEMORY_BUDGET_FRACTION"
 _FANOUT_RESTORE_ENV = "TORCHSNAPSHOT_TPU_FANOUT_RESTORE"
 _LEDGER_ENV = "TORCHSNAPSHOT_TPU_LEDGER"
 _LEDGER_MAX_RECORDS_ENV = "TORCHSNAPSHOT_TPU_LEDGER_MAX_RECORDS"
+_PEER_TIER_ENV = "TORCHSNAPSHOT_TPU_PEER_TIER"
+_PEER_RING_OFFSET_ENV = "TORCHSNAPSHOT_TPU_PEER_RING_OFFSET"
+_PEER_CACHE_BUDGET_BYTES_ENV = "TORCHSNAPSHOT_TPU_PEER_CACHE_BUDGET_BYTES"
+_PEER_TRANSFER_TIMEOUT_ENV = (
+    "TORCHSNAPSHOT_TPU_PEER_TRANSFER_TIMEOUT_SECONDS"
+)
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -70,6 +76,10 @@ _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS: float = 1800.0
 _DEFAULT_PROGRESS_SECONDS: float = 1.0
 _DEFAULT_HISTORY_MAX_RECORDS: int = 512
 _DEFAULT_LEDGER_MAX_RECORDS: int = 4096
+
+_DEFAULT_PEER_RING_OFFSET: int = 1
+_DEFAULT_PEER_CACHE_BUDGET_BYTES: int = 1024 * 1024 * 1024
+_DEFAULT_PEER_TRANSFER_TIMEOUT_SECONDS: float = 30.0
 
 _DEFAULT_STAGING_POOL_SLAB_BYTES: int = 128 * 1024 * 1024
 _DEFAULT_STAGING_POOL_SLABS: int = 2
@@ -463,6 +473,54 @@ def is_fanout_restore_enabled() -> bool:
     return os.environ.get(_FANOUT_RESTORE_ENV, "1") != "0"
 
 
+def is_peer_tier_enabled() -> bool:
+    """Peer-redundant hot checkpoints (docs/peer.md): every rank pushes
+    its committed shards into a neighbor rank's host-RAM cache (ring
+    placement), and restores resolve a peer RAM -> local fast tier ->
+    durable ladder per shard — so recovery after a single-host
+    preemption is bounded by host-RAM copy speed, not storage. On by
+    default, but inert until a process group with a coordination store
+    is configured (``CheckpointManager(pg=...)`` or an explicit
+    ``tiered.peer.maybe_configure``) — single-process jobs never start
+    a server. Set to ``"0"`` to kill the tier entirely: no server, no
+    pushes, no pulls; restores read exactly the pre-peer path. Every
+    peer failure mode degrades to a correct-if-slower restore either
+    way; the switch exists for bisecting and for fleets whose
+    interconnect should not carry checkpoint bytes."""
+    return os.environ.get(_PEER_TIER_ENV, "1") != "0"
+
+
+def get_peer_ring_offset() -> int:
+    """Ring placement distance: rank ``r`` pushes its shards to rank
+    ``(r + offset) % world``. The default of +1 survives any single-rank
+    preemption; widen it (e.g. to the hosts-per-failure-domain count)
+    when co-scheduled neighbors tend to be preempted together."""
+    return _get_int_env(_PEER_RING_OFFSET_ENV, _DEFAULT_PEER_RING_OFFSET)
+
+
+def get_peer_cache_budget_bytes() -> int:
+    """Host-RAM bound on one process's peer cache (the shards pushed TO
+    this rank). LRU by step with the newest committed step pinned; a
+    push that cannot fit even after eviction is refused — the pusher
+    degrades to storage-only durability for that blob, never the cache
+    over its budget."""
+    return _get_int_env(
+        _PEER_CACHE_BUDGET_BYTES_ENV, _DEFAULT_PEER_CACHE_BUDGET_BYTES
+    )
+
+
+def get_peer_transfer_timeout_seconds() -> float:
+    """Per-transfer deadline (connect + one blob push or pull) on the
+    peer transport, and the no-progress retry window for pushes. A dead
+    peer costs a pusher at most a few of these before the job degrades
+    (WARN + ``peer_tier_degraded``); a puller falls through to the next
+    tier after one."""
+    val = os.environ.get(_PEER_TRANSFER_TIMEOUT_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_PEER_TRANSFER_TIMEOUT_SECONDS
+
+
 def get_memory_budget_fraction() -> float:
     """Fraction of *available* host memory the per-process staging
     budget may claim (scheduler.get_process_memory_budget_bytes; the
@@ -760,6 +818,44 @@ def override_staging_threads(n: int) -> Generator[None, None, None]:
 @contextlib.contextmanager
 def override_per_rank_io_concurrency(n: int) -> Generator[None, None, None]:
     with _override_env(_PER_RANK_IO_CONCURRENCY_ENV, str(n)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_peer_tier() -> Generator[None, None, None]:
+    """Force the peer tier ON for the block (the test suite's conftest
+    pins it off so tier-1 saves/restores exercise the exact pre-peer
+    read/write paths they assert about; peer-tier tests opt back in
+    here or via an env override in their workers)."""
+    with _override_env(_PEER_TIER_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def disable_peer_tier() -> Generator[None, None, None]:
+    with _override_env(_PEER_TIER_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_peer_ring_offset(offset: int) -> Generator[None, None, None]:
+    with _override_env(_PEER_RING_OFFSET_ENV, str(offset)):
+        yield
+
+
+@contextlib.contextmanager
+def override_peer_cache_budget_bytes(
+    nbytes: int,
+) -> Generator[None, None, None]:
+    with _override_env(_PEER_CACHE_BUDGET_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_peer_transfer_timeout_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_PEER_TRANSFER_TIMEOUT_ENV, str(seconds)):
         yield
 
 

@@ -192,11 +192,16 @@ class CheckpointManager:
         keep_best_n: Optional[int] = None,
         best_mode: str = "min",
         keep_fast_last_n: Optional[int] = None,
+        keep_peer_last_n: Optional[int] = None,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
         if keep_best_n is not None and keep_best_n < 1:
             raise ValueError(f"keep_best_n must be >= 1, got {keep_best_n}")
+        if keep_peer_last_n is not None and keep_peer_last_n < 1:
+            raise ValueError(
+                f"keep_peer_last_n must be >= 1, got {keep_peer_last_n}"
+            )
         if keep_fast_last_n is not None and keep_fast_last_n < 1:
             raise ValueError(
                 f"keep_fast_last_n must be >= 1, got {keep_fast_last_n}"
@@ -227,6 +232,13 @@ class CheckpointManager:
         # (which the index's pin logic already guards for incremental
         # refs).
         self.keep_fast_last_n = keep_fast_last_n
+        # Peer-RAM retention (docs/peer.md): each rank's neighbor keeps
+        # the newest N committed steps' shards in its host-RAM cache.
+        # Default None = no count bound — the cache's byte budget (LRU
+        # with the newest committed step pinned) is then the only
+        # limit; set N=1 to keep exactly the step restore_latest would
+        # pick and nothing older.
+        self.keep_peer_last_n = keep_peer_last_n
         # Default for save()/async_save(): digest-enabled takes that
         # reference the previous committed step's unchanged chunks.
         self.incremental = incremental
@@ -235,6 +247,20 @@ class CheckpointManager:
         # sequence is shared across wrappers of the same pg (pg_wrapper).
         self._pg_arg = pg
         self._pg = PGWrapper(pg)
+        # Peer-tier bring-up (tiered/peer.py): start this process's
+        # cache server and advertise its endpoint through the
+        # coordination store. Inert for single-process jobs, under the
+        # TORCHSNAPSHOT_TPU_PEER_TIER=0 kill switch, or when pg carries
+        # no store; failures degrade (the tier is recovery insurance,
+        # never a reason a manager cannot construct).
+        try:
+            from .tiered import peer as peer_tier
+
+            peer_tier.maybe_configure(
+                self._pg, keep_last_n=keep_peer_last_n
+            )
+        except Exception as e:  # noqa: BLE001 - peer tier is best-effort
+            logger.warning("peer tier: configure failed: %r", e)
         # Lazily-constructed write-path autotuner (tuner/autotuner.py);
         # stays None while TORCHSNAPSHOT_TPU_AUTOTUNE=0 — the kill
         # switch means no tuner object, no state file, no broadcast.
@@ -865,10 +891,13 @@ class CheckpointManager:
             from .telemetry.progress import SNAPSHOT_PROGRESS_PREFIX
             from .telemetry.sink import SNAPSHOT_EVENTS_BASENAME
 
+            from .tiered.peer import placement_doc_path
+
             await _drop(SNAPSHOT_METADATA_FNAME)
             await _drop(SNAPSHOT_EVENTS_BASENAME)
             for rank in range(metadata.world_size):
                 await _drop(f"{SNAPSHOT_PROGRESS_PREFIX}{rank}.json")
+                await _drop(placement_doc_path(rank))
             slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
 
             async def _drop_slotted(location: str) -> None:
@@ -1030,6 +1059,8 @@ class CheckpointManager:
                 await storage.delete(SNAPSHOT_EVENTS_BASENAME)
             except FileNotFoundError:
                 pass  # sink was never enabled for this step
+            from .tiered.peer import placement_doc_path
+
             for rank in range(metadata.world_size):
                 try:
                     await storage.delete(
@@ -1037,6 +1068,10 @@ class CheckpointManager:
                     )
                 except FileNotFoundError:
                     pass  # no heartbeat recorded / already settled
+                try:
+                    await storage.delete(placement_doc_path(rank))
+                except FileNotFoundError:
+                    pass  # no peer push ever recorded placement
 
             locations: Set[str] = set()
             manifest: Manifest = metadata.manifest
@@ -1071,6 +1106,19 @@ class CheckpointManager:
                     raise r
         finally:
             await storage.close()
+        # Peer-RAM copies of the dropped step: best-effort eviction
+        # from every advertised peer cache (they self-bound via budget
+        # LRU + keep_peer_last_n regardless; this reclaims promptly).
+        try:
+            from .tiered.peer import maybe_evict_step
+
+            maybe_evict_step(self.step_path(step))
+        except Exception as e:  # noqa: BLE001 - GC must not fail a save
+            logger.warning(
+                "peer tier: evicting step %d peer copies failed: %r",
+                step,
+                e,
+            )
         self._post_gc_ledger(step, metadata.manifest)
         logger.info("Retention dropped step %d", step)
 

@@ -236,7 +236,15 @@ class PreemptionSaver:
         whatever misses the window is journaled, so the restarted job's
         ``CheckpointManager.resume_mirrors()`` resumes the upload instead
         of re-sending completed blobs. Drain failures are logged, never
-        raised (close() runs on the teardown path)."""
+        raised (close() runs on the teardown path).
+
+        The peer tier (tiered/peer.py) needs no registration: ``close``
+        always flushes pending peer pushes FIRST — shipping the last
+        committed step's delta into the surviving neighbor's host RAM
+        is the cheapest work the grace window can buy (host-RAM
+        bandwidth, not a durable upload), and it is what bounds the
+        replacement rank's restore by RAM copy speed instead of
+        storage. An unconfigured peer tier makes that flush a no-op."""
         self._drains.append(fn)
 
     def uninstall(self) -> None:
@@ -254,6 +262,25 @@ class PreemptionSaver:
         self._stop_poller.set()
         if self._poller is not None:
             self._poller.join(timeout=self.poll_interval + 1.0)
+        # Peer-tier flush FIRST (built-in drain hook): the last
+        # committed step's delta ships into the neighbor's host RAM at
+        # RAM-copy speed — the cheapest recovery insurance the grace
+        # window can buy, and strictly faster than the durable-tier
+        # drains registered below. A dead peer cannot wedge this: the
+        # push jobs themselves time out and degrade, and the drain wait
+        # is bounded. No-op when the tier is unconfigured.
+        try:
+            from .tiered import peer as peer_tier
+
+            if not peer_tier.maybe_drain(timeout=self.rendezvous_timeout):
+                logger.warning(
+                    "preemption drain: peer-tier pushes did not settle "
+                    "within %.0fs; the restore ladder falls through to "
+                    "storage for whatever is missing",
+                    self.rendezvous_timeout,
+                )
+        except Exception as e:  # noqa: BLE001 - teardown path
+            logger.warning("preemption peer-tier drain failed: %r", e)
         for fn in self._drains:
             try:
                 fn()

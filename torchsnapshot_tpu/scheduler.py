@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING, Callable, List, Optional
@@ -215,6 +216,46 @@ class StagingPool(MemoryBudget):
             "slab_bytes": self.slab_bytes,
             "slabs": self.slabs,
         }
+
+
+class PeerCacheBudget:
+    """Synchronous counting budget for the peer-RAM checkpoint cache
+    (tiered/peer.py) — :class:`MemoryBudget`'s accounting model
+    (total/available/peak) without the event-loop coupling: the peer
+    server's handler threads reserve and release under a plain lock,
+    and an oversized reservation is *refused* rather than queued — a
+    push that does not fit (even after the cache's LRU eviction) must
+    degrade to storage-only durability, never block the pusher or grow
+    the cache past its bound."""
+
+    def __init__(self, total_bytes: int) -> None:
+        self.total_bytes = max(0, int(total_bytes))
+        self.available_bytes = self.total_bytes
+        self.peak_reserved_bytes = 0
+        self._lock = threading.Lock()
+
+    def try_reserve(self, cost_bytes: int) -> bool:
+        """Reserve ``cost_bytes`` if they fit; False otherwise (the
+        caller evicts and retries, or refuses the push)."""
+        cost = int(cost_bytes)
+        with self._lock:
+            if cost > self.available_bytes:
+                return False
+            self.available_bytes -= cost
+            reserved = self.total_bytes - self.available_bytes
+            if reserved > self.peak_reserved_bytes:
+                self.peak_reserved_bytes = reserved
+            return True
+
+    def release(self, cost_bytes: int) -> None:
+        with self._lock:
+            self.available_bytes = min(
+                self.total_bytes, self.available_bytes + int(cost_bytes)
+            )
+
+    def reserved_bytes(self) -> int:
+        with self._lock:
+            return self.total_bytes - self.available_bytes
 
 
 class _PipelineStats:

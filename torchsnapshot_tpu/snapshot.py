@@ -150,6 +150,31 @@ def _merge_fanout_telemetry(pipeline: Optional[dict], fanout_ctx) -> None:
     )
 
 
+def _merge_peer_telemetry(pipeline: Optional[dict], peer_ctx) -> None:
+    """Fold a peer-tier restore context's per-tier byte accounting into
+    the restore's merged pipeline telemetry: ``tier_split`` (bytes
+    served per tier of the peer RAM -> fast -> durable ladder) and the
+    ``peer`` degradation evidence the ``peer-tier-degraded`` doctor
+    rule cites."""
+    if peer_ctx is None or pipeline is None:
+        return
+    pipeline.update(peer_ctx.pipeline_fields())
+
+
+def _maybe_push_to_peer(path: str, pending_io_work) -> None:
+    """Post-commit peer-tier hook (every rank): queue this rank's
+    written blobs — with the integrity entries the pipeline already
+    computed — for replication into the ring neighbor's host RAM
+    (tiered/peer.py). Inert unless the tier is configured; failures
+    degrade (WARN + metrics), never fail the take."""
+    try:
+        from .tiered import peer as peer_tier
+
+        peer_tier.maybe_enqueue_push(path, pending_io_work.checksums)
+    except Exception as e:  # noqa: BLE001 - the peer tier must never fail a take
+        logger.warning("peer tier: post-commit push hook failed: %r", e)
+
+
 def _mirror_state_for(path: str) -> Dict[str, Any]:
     """The process mirror's queue/lag state, for reports about tiered
     paths ({} otherwise): at take-report time the step's upload job was
@@ -202,6 +227,7 @@ def _emit_snapshot_report(
                 tunables if tunables is not None else knobs.tunable_snapshot()
             ),
         )
+        gathered = None
         if (
             nonce
             and pg_wrapper.get_world_size() > 1
@@ -260,7 +286,27 @@ def _emit_snapshot_report(
         if error is None and pg_wrapper.get_rank() == 0:
             from .telemetry import ledger as run_ledger
 
-            run_ledger.post_op_event(kind, path, report)
+            # Restores carry a tier split (which tier of the peer ->
+            # fast -> durable ladder served the bytes); when the gather
+            # ran, sum it across ranks so the ledger records the
+            # WORLD's recovery economics, not just rank 0's.
+            world_tier_split = None
+            if gathered:
+                splits = [
+                    r.get("tier_split")
+                    for r in gathered
+                    if isinstance(r, dict) and r.get("tier_split")
+                ]
+                if splits:
+                    world_tier_split = {}
+                    for s in splits:
+                        for t, b in s.items():
+                            world_tier_split[t] = (
+                                world_tier_split.get(t, 0) + int(b)
+                            )
+            run_ledger.post_op_event(
+                kind, path, report, world_tier_split=world_tier_split
+            )
         if trace_mark is not None:
             export_op_trace(kind, path, pg_wrapper.get_rank(), trace_mark)
     except Exception as e:  # noqa: BLE001 - telemetry must not fail the op
@@ -370,6 +416,9 @@ class Snapshot:
                     cls._write_snapshot_metadata(metadata, storage, event_loop)
                 if barrier is not None:
                     barrier.depart()
+            # Post-commit: hand this rank's blobs to the peer tier (the
+            # committed step is what a replacement rank would restore).
+            _maybe_push_to_peer(path, pending_io_work)
             event_loop.run_until_complete(storage.close())
             # The envelope span closes before the report/trace emission
             # so the exported timeline carries the take's full extent.
@@ -801,6 +850,17 @@ class Snapshot:
         event_loop = asyncio.new_event_loop()
         try:
             storage = url_to_storage_plugin(self.path)
+            # Peer-tier ladder (docs/peer.md): when surviving peers hold
+            # this step's shards in RAM, reads resolve peer -> fast ->
+            # durable per blob, digest-verified. Build is rank-local
+            # (inventory RPCs, no collectives), so peers building or
+            # not building the ladder independently can never diverge
+            # the restore schedule; every failure degrades to None.
+            from .tiered import peer as _peer_tier
+
+            peer_ctx = _peer_tier.build_restore_context(self.path)
+            if peer_ctx is not None:
+                storage = peer_ctx.wrap(storage)
             # Collectives FIRST, storage reads second (round 5; same
             # principle as _take_impl's budget-before-gather order): the
             # metadata and checksum-table reads are the restore's
@@ -900,6 +960,7 @@ class Snapshot:
             recorder.end(restore_span)
             pipeline = telemetry.merge_pipeline_telemetry(pipeline_sink)
             _merge_fanout_telemetry(pipeline, fanout_ctx)
+            _merge_peer_telemetry(pipeline, peer_ctx)
             _emit_snapshot_report(
                 kind="restore",
                 path=self.path,
@@ -1045,6 +1106,14 @@ class Snapshot:
                 else:
                     fanout_ctx = None  # nothing shard-shaped to fan out
 
+        # Peer-tier ladder, async flavor: the owner table is assembled
+        # on the calling thread (inventory RPCs only — cheap, and no
+        # rendezvous belongs on the read thread); the background
+        # pipeline then pulls table-resident blobs from peer RAM.
+        from .tiered import peer as _peer_tier
+
+        peer_ctx = _peer_tier.build_restore_context(self.path)
+
         return PendingRestore(
             path=self.path,
             keys=keys,
@@ -1059,6 +1128,7 @@ class Snapshot:
             trace_mark=trace_mark,
             tunables=knobs.tunable_snapshot(),
             fanout_ctx=fanout_ctx,
+            peer_ctx=peer_ctx,
         )
 
     def _load_stateful(
@@ -1660,6 +1730,10 @@ class PendingSnapshot:
                 )
             if barrier is not None:
                 barrier.depart()
+            # Post-commit peer push, same hook as the sync take's: the
+            # enqueue is queue-put cheap and the job runs on the peer
+            # replicator's own worker, not this commit thread.
+            _maybe_push_to_peer(self.path, self._pending_io_work)
             self._event_loop.run_until_complete(self._storage.close())
             recorder.end(commit_span)
             # Store-based gather + local file append only — safe on this
@@ -1776,6 +1850,7 @@ class PendingRestore:
         trace_mark: Optional[TraceMark] = None,
         tunables: Optional[Dict[str, Any]] = None,
         fanout_ctx=None,
+        peer_ctx=None,
     ) -> None:
         import threading
 
@@ -1795,6 +1870,9 @@ class PendingRestore:
         # background pipeline serves exchanged shard blobs from it (no
         # collectives off the main thread — the bytes already moved).
         self._fanout_ctx = fanout_ctx
+        # Peer-tier owner table built on the calling thread; pulls are
+        # point-to-point socket reads, safe on the read thread.
+        self._peer_ctx = peer_ctx
         # Created on the initiating thread; fed and settled by the
         # background read thread.
         self._progress_tracker = _progress.track(
@@ -1818,6 +1896,8 @@ class PendingRestore:
         )
         try:
             storage = url_to_storage_plugin(self.path)
+            if self._peer_ctx is not None:
+                storage = self._peer_ctx.wrap(storage)
             read_reqs = [
                 r for plan in self._plans.values() for r in plan.read_reqs
             ]
@@ -1857,6 +1937,7 @@ class PendingRestore:
             )
             self._pipeline_telemetry["bytes_needed"] = bytes_needed
             _merge_fanout_telemetry(self._pipeline_telemetry, fanout_ctx)
+            _merge_peer_telemetry(self._pipeline_telemetry, self._peer_ctx)
             placer.flush()
             # Whatever didn't stream (flush disabled, zero-read leaves)
             # places in one final batched device_put spanning all plans

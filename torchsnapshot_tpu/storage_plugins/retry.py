@@ -33,6 +33,26 @@ class RetriesExhausted(RuntimeError):
     pass
 
 
+def decorrelated_backoff(
+    prev_backoff_s: float,
+    rng: "random.Random | None" = None,
+    base_s: float = _BACKOFF_BASE_SECONDS,
+    max_s: float = _BACKOFF_MAX_SECONDS,
+) -> float:
+    """Next sleep under *decorrelated* jitter: uniform over
+    ``[base, 3 x previous sleep]``, capped. Plain exponential backoff
+    with bounded jitter keeps N ranks that lost the backend at the same
+    instant retrying in near-lockstep (attempt k lands in the same
+    narrow ``[2^k/2, 2^k]`` band everywhere — the synchronized-herd
+    pattern that re-knocks over a recovering durable tier). Feeding
+    each draw's range from the PREVIOUS draw decorrelates the schedules
+    after the first sleep: two processes' sequences diverge and stay
+    diverged (AWS architecture-blog construction). Shared by the cloud
+    plugins, the tiered mirror, and the peer tier."""
+    r: "random.Random | Any" = rng if rng is not None else random
+    return min(max_s, r.uniform(base_s, max(base_s, 3.0 * prev_backoff_s)))
+
+
 class CollectiveProgressRetryStrategy:
     """Shared-deadline retry coordinator for one storage plugin instance."""
 
@@ -40,8 +60,15 @@ class CollectiveProgressRetryStrategy:
         self,
         progress_window_seconds: float = DEFAULT_PROGRESS_WINDOW_SECONDS,
         scope: str = "",
+        rng: "random.Random | None" = None,
     ) -> None:
         self.progress_window_seconds = progress_window_seconds
+        # Backoff RNG seam: per-instance generators let tests pin two
+        # strategies' schedules and assert they diverge; production
+        # uses the module RNG (process-seeded, already uncorrelated
+        # ACROSS processes — the decorrelated draw below is what keeps
+        # them uncorrelated across attempts too).
+        self._rng = rng
         # The window only starts ticking at the first observed failure (not
         # at plugin construction): a checkpoint can spend minutes in
         # staging/collectives before its first storage op, and that quiet
@@ -74,6 +101,7 @@ class CollectiveProgressRetryStrategy:
         """Run ``op``, retrying transient failures until the collective
         deadline lapses with no progress from any concurrent operation."""
         attempt = 0
+        prev_backoff = _BACKOFF_BASE_SECONDS
         while True:
             try:
                 result = await op()
@@ -99,9 +127,8 @@ class CollectiveProgressRetryStrategy:
                         f"{self.progress_window_seconds:.0f}s; giving up "
                         f"after {attempt + 1} attempts"
                     ) from e
-                backoff = min(
-                    _BACKOFF_MAX_SECONDS, _BACKOFF_BASE_SECONDS * (2**attempt)
-                ) * (0.5 + random.random() / 2)
+                backoff = decorrelated_backoff(prev_backoff, rng=self._rng)
+                prev_backoff = backoff
                 self.backoff_s_total += backoff
                 registry.counter_inc(
                     metric_names.STORAGE_RETRY_BACKOFF_SECONDS_TOTAL,

@@ -549,6 +549,44 @@ def _restore_read_amplified(report: Dict[str, Any]):
     }
 
 
+@doctor_rule(names.RULE_PEER_TIER_DEGRADED)
+def _peer_tier_degraded(report: Dict[str, Any]):
+    """A restore that had an eligible peer-RAM copy was (partly) served
+    from storage: peer transfers failed (dead peer, timeout, checksum
+    mismatch) or pushed copies were missing, so recovery paid storage
+    latency the peer tier existed to avoid. Evidence cites the
+    transfer-failure count and the per-tier byte split the report's
+    ``tier_split``/``peer`` fields carry (docs/peer.md's degradation
+    matrix names the failure modes)."""
+    if report.get("kind") not in ("restore", "async_restore"):
+        return None
+    peer = report.get("peer") or {}
+    if not peer:
+        return None
+    failures = int(peer.get("failures", 0))
+    fallthrough = int(peer.get("fallthrough_bytes", 0))
+    if failures == 0 and fallthrough == 0:
+        return None
+    tier_split = report.get("tier_split") or {}
+    return {
+        "summary": (
+            "the restore had eligible peer-RAM copies but fell through "
+            "to storage for part of its bytes: peer transfers failed "
+            "or cached copies were missing/corrupt — recovery paid "
+            "storage latency the peer tier exists to avoid"
+        ),
+        "evidence": {
+            "peer_failures": failures,
+            "fallthrough_bytes": fallthrough,
+            "eligible_blobs": int(peer.get("eligible_blobs", 0)),
+            "served_blobs": int(peer.get("served_blobs", 0)),
+            "peer_bytes": int(tier_split.get("peer", 0)),
+            "fast_bytes": int(tier_split.get("fast", 0)),
+            "durable_bytes": int(tier_split.get("durable", 0)),
+        },
+    }
+
+
 @doctor_rule(names.RULE_RETRY_STORM)
 def _retry_storm(report: Dict[str, Any]):
     retries = report.get("retries") or {}

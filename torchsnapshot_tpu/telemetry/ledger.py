@@ -358,12 +358,21 @@ def prune_steps(root: str, steps: Iterable[int]) -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 
-def post_op_event(kind: str, path: str, report: Any) -> None:
+def post_op_event(
+    kind: str,
+    path: str,
+    report: Any,
+    world_tier_split: Optional[Dict[str, int]] = None,
+) -> None:
     """Ledger events for one completed snapshot operation, shaped from
     its SnapshotReport: takes post their training-visible stall (the
     whole wall for sync takes, return-to-caller for async ones) plus
     the overlapped background drain; restores post the recovery time
-    served. Routed through the owned-root gate (rank 0 only)."""
+    served — with a ``tier`` field naming which tier of the peer RAM ->
+    fast -> durable ladder dominated, and the full ``tier_split`` byte
+    map when the restore ran the ladder (``world_tier_split``, summed
+    across ranks by the report gather, wins over the rank-local split).
+    Routed through the owned-root gate (rank 0 only)."""
     phases = report.phases or {}
     wall = max((float(v) for v in phases.values()), default=0.0)
     if kind in ("take", "async_take"):
@@ -390,12 +399,24 @@ def post_op_event(kind: str, path: str, report: Any) -> None:
                 nbytes=int(report.bytes_moved),
             )
     elif kind in ("restore", "async_restore"):
+        fields: Dict[str, Any] = {
+            "kind": kind,
+            "restore_s": round(wall, 6),
+            "nbytes": int(report.bytes_moved),
+        }
+        tier_split = world_tier_split or getattr(
+            report, "tier_split", None
+        )
+        if tier_split:
+            fields["tier_split"] = {
+                k: int(v) for k, v in tier_split.items()
+            }
+            fields["tier"] = max(tier_split, key=lambda t: tier_split[t])
+        peer = getattr(report, "peer", None) or {}
+        if peer:
+            fields["peer_failures"] = int(peer.get("failures", 0))
         post_event_for_snapshot(
-            path,
-            names.EVENT_RESTORE_SERVED,
-            kind=kind,
-            restore_s=round(wall, 6),
-            nbytes=int(report.bytes_moved),
+            path, names.EVENT_RESTORE_SERVED, **fields
         )
 
 

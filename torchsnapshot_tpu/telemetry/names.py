@@ -50,6 +50,18 @@ MIRROR_JOBS_FAILED_TOTAL = "mirror_jobs_failed_total"
 MIRROR_RESUME_TOTAL = "mirror_resume_total"
 MIRROR_UPLOAD_LAG_SECONDS = "mirror_upload_lag_seconds"
 
+# -- peer tier (tiered/peer.py) ----------------------------------------------
+
+PEER_PUSH_BLOBS_TOTAL = "peer_push_blobs_total"
+PEER_PUSH_BYTES_TOTAL = "peer_push_bytes_total"
+PEER_PUSH_FAILURES_TOTAL = "peer_push_failures_total"
+PEER_PULL_HITS_TOTAL = "peer_pull_hits_total"
+PEER_PULL_MISSES_TOTAL = "peer_pull_misses_total"
+PEER_PULL_BYTES_TOTAL = "peer_pull_bytes_total"
+PEER_CACHE_BYTES = "peer_cache_bytes"
+PEER_CACHE_STEPS = "peer_cache_steps"
+PEER_TIER_DEGRADED_STATE = "peer_tier_degraded"
+
 # -- manager (manager.py) ----------------------------------------------------
 
 MANAGER_SAVES_TOTAL = "manager_saves_total"
@@ -136,6 +148,12 @@ SPAN_BATCHER_CONSUME_SPANNING = "batcher:consume_spanning"
 SPAN_MIRROR_JOB = "mirror:job"
 SPAN_MIRROR_BLOB = "mirror:blob"
 
+# peer tier (tiered/peer.py): one push job / per-blob transfer, and a
+# restore-side pull from a surviving peer's RAM
+SPAN_PEER_JOB = "peer:job"
+SPAN_PEER_PUSH = "peer:push"
+SPAN_PEER_PULL = "peer:pull"
+
 # utils/rss_profiler.py: a new peak RSS delta was observed
 INSTANT_RSS_PEAK = "rss:peak"
 
@@ -214,6 +232,11 @@ RULE_GOODPUT_DEGRADED = "goodput-degraded"
 # the checkpoint interval, not the per-save latency, is what needs
 # attention (evidence cites the ledger records).
 RULE_RECOVERY_COST_HIGH = "recovery-cost-high"
+# A restore that had an eligible peer-RAM copy was (partly) served from
+# storage instead: peer transfers failed or fell through, so recovery
+# paid storage latency the peer tier existed to avoid. Evidence cites
+# the peer transfer failures and the per-tier byte split.
+RULE_PEER_TIER_DEGRADED = "peer-tier-degraded"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).

@@ -109,6 +109,14 @@ class SnapshotReport:
     bytes_fetched: Optional[int] = None
     bytes_received: Optional[int] = None
     bytes_needed: Optional[int] = None
+    # Peer-tier restores only (None/empty elsewhere): bytes served per
+    # tier of the peer RAM -> local fast -> durable ladder
+    # (``{"peer": b, "fast": b, "durable": b}``), and the degradation
+    # evidence — eligible/served blob counts, transfer failures, and
+    # the bytes that fell through to storage despite an eligible peer
+    # copy. The ``peer-tier-degraded`` doctor rule keys off these.
+    tier_split: Optional[Dict[str, int]] = None
+    peer: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # The *effective* tunable-knob values the operation ran under
     # (knobs.tunable_snapshot(), captured at op start): env > tuner
     # override > default, already resolved. Recorded whether or not the
@@ -249,6 +257,12 @@ def build_report(
             if pipeline.get("bytes_needed") is not None
             else None
         ),
+        tier_split=(
+            {k: int(v) for k, v in pipeline["tier_split"].items()}
+            if pipeline.get("tier_split")
+            else None
+        ),
+        peer=dict(pipeline.get("peer") or {}),
         tunables=dict(tunables) if tunables is not None else None,
         retries=retries_from_deltas(counter_deltas),
         mirror=dict(mirror or {}),

@@ -22,15 +22,31 @@ this checkpointer:
 - ``tiered://<fast_url>|<durable_url>`` URLs dispatch here through
   ``storage_plugin.py``; ``CheckpointManager`` adds tier-aware retention
   (``keep_fast_last_n``) and a ``wait_durable(step)`` barrier.
+- The **peer tier** (peer.py, docs/peer.md) is the third tier: every
+  rank pushes its committed shards into a neighbor rank's host-RAM
+  cache (ring placement), and restores resolve a peer RAM -> fast ->
+  durable ladder per shard — preemption recovery at host-RAM copy
+  speed, degrading gracefully to storage on any peer failure.
+  ``CheckpointManager`` adds ``keep_peer_last_n`` and brings the tier
+  up when constructed with a multi-rank ``pg``.
 
 See docs/tiered.md for the architecture, journal format and failure
-matrix.
+matrix; docs/peer.md for the peer tier's ladder and degradation matrix.
 """
 
 from __future__ import annotations
 
 from .journal import JOURNAL_BACKUP_BLOB, JOURNAL_BLOB, MirrorJournal
 from .mirror import Mirror, get_mirror, reset_mirror, wait_durable
+from .peer import (
+    PeerCache,
+    PeerClient,
+    PeerReplicator,
+    PeerRestoreContext,
+    PeerTransferError,
+    get_replicator,
+    reset_peer_tier,
+)
 from .plugin import TieredStoragePlugin
 
 __all__ = [
@@ -38,8 +54,15 @@ __all__ = [
     "JOURNAL_BLOB",
     "Mirror",
     "MirrorJournal",
+    "PeerCache",
+    "PeerClient",
+    "PeerReplicator",
+    "PeerRestoreContext",
+    "PeerTransferError",
     "TieredStoragePlugin",
     "get_mirror",
+    "get_replicator",
     "reset_mirror",
+    "reset_peer_tier",
     "wait_durable",
 ]
