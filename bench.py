@@ -56,7 +56,10 @@ Leg order and what each contributes:
    number is the artifact-bound worst case while
    ``cold_restore_gbps``/``cold_restore_efficiency`` is the
    hardware-limit figure.
-5. Incremental unchanged-state save and the on-TPU async-take stall
+5. Incremental unchanged-state save, the zero-pack write-path
+   microbench (packed vs vectorized vs O_DIRECT on a >=256 MiB batched
+   take — ``write_path`` / ``write_path_zero_pack_speedup``), and the
+   on-TPU async-take stall
    split, budget-gated context fields. The steady-state autotune leg
    and the preemption-recovery leg additionally run with the goodput
    ledger on and record ``RESULT.goodput`` (run-level overhead
@@ -687,6 +690,105 @@ def preemption_leg(workdir: str, total_bytes: int, est_take_s: float) -> None:
     _emit_partial("preemption")
 
 
+def write_path_leg(workdir: str) -> None:
+    """Leg 5b: zero-pack write-path microbench (ISSUE 11's structural
+    claim, measured): one >=256 MiB batched take through each write-path
+    variant — the packed slab path (stage into a contiguous buffer, then
+    fused write+CRC), the zero-pack vectorized path (member buffers
+    straight to pwritev+CRC, no pack pass), and the packed path with
+    O_DIRECT enabled (declines to buffered on filesystems without it).
+    Host-numpy state on purpose: this leg isolates the host-side
+    pack+write cost the tentpole removes, not the device link the
+    headline legs own. Each variant's SnapshotReport ``write_path``
+    split is recorded so the numbers are attributable."""
+    if not _have_budget("write_path", 150):
+        return
+    from torchsnapshot_tpu import telemetry as _telemetry
+
+    mib = int(os.environ.get("TS_BENCH_WRITE_PATH_MIB", "256"))
+    trials = int(os.environ.get("TS_BENCH_WRITE_PATH_TRIALS", "3"))
+    n_members = max(2, mib // 8)
+    rng = np.random.default_rng(17)
+    state = {
+        f"w{i}": rng.integers(0, 255, (8 << 20,), dtype=np.uint8)
+        for i in range(n_members)
+    }
+    gib = sum(a.nbytes for a in state.values()) / (1 << 30)
+    variants = {
+        "packed": ts_knobs.disable_write_vectorized,
+        "vectorized": ts_knobs.enable_write_vectorized,
+        "packed_direct": None,  # packed + O_DIRECT, see run_once
+    }
+    results = {
+        "size_gib": round(gib, 3),
+        "trials": trials,
+        **{tag: {"times_s": []} for tag in variants},
+    }
+
+    def run_once(tag: str, timed: bool) -> float:
+        path = os.path.join(workdir, f"wp_{tag}")
+        if tag == "packed_direct":
+            import contextlib
+
+            ctx = contextlib.ExitStack()
+            ctx.enter_context(ts_knobs.disable_write_vectorized())
+            ctx.enter_context(ts_knobs.enable_fs_direct_io())
+        else:
+            ctx = variants[tag]()
+        with ctx:
+            os.sync()  # park earlier legs' dirty pages before timing
+            t0 = time.perf_counter()
+            ts.Snapshot.take(path, {"s": ts.PyTreeState(state)})
+            elapsed = time.perf_counter() - t0
+        if timed:
+            rep = _telemetry.last_report("take", path=path)
+            results[tag]["write_path"] = (
+                rep.write_path if rep is not None else None
+            )
+        shutil.rmtree(path, ignore_errors=True)
+        return elapsed
+
+    try:
+        with ts_knobs.enable_batching():
+            # One untimed warm-up round (thread pools, native lib, dir
+            # cache), then INTERLEAVED timed rounds: background
+            # writeback drifts minute-to-minute on a shared box, and
+            # back-to-back per-variant runs would charge that drift to
+            # whichever variant ran last. Median per variant.
+            for tag in variants:
+                run_once(tag, timed=False)
+            for _ in range(trials):
+                for tag in variants:
+                    results[tag]["times_s"].append(
+                        round(run_once(tag, timed=True), 3)
+                    )
+        for tag in variants:
+            med = statistics.median(results[tag]["times_s"])
+            results[tag]["take_s"] = round(med, 3)
+            results[tag]["gbps"] = round(gib / med, 3)
+        results["zero_pack_speedup"] = round(
+            results["packed"]["take_s"] / results["vectorized"]["take_s"], 3
+        )
+        RESULT["write_path"] = results
+        RESULT["write_path_zero_pack_speedup"] = results["zero_pack_speedup"]
+        _log(
+            f"bench: write-path microbench ({gib:.2f} GiB batched take, "
+            f"median of {trials} interleaved): packed "
+            f"{results['packed']['take_s']} s "
+            f"({results['packed']['gbps']} GB/s, {results['packed']['times_s']}) "
+            f"vs zero-pack {results['vectorized']['take_s']} s "
+            f"({results['vectorized']['gbps']} GB/s, "
+            f"{results['vectorized']['times_s']}) — "
+            f"{results['zero_pack_speedup']}x; packed+O_DIRECT "
+            f"{results['packed_direct']['take_s']} s "
+            f"({results['packed_direct']['times_s']}, variants "
+            f"{results['packed_direct'].get('write_path')})"
+        )
+    except Exception as e:  # noqa: BLE001 - context leg, fail-soft
+        _log(f"bench: write-path leg failed: {e!r}")
+    _emit_partial("write_path")
+
+
 def steady_state_leg(
     workdir: str,
     total_bytes: int,
@@ -712,9 +814,11 @@ def steady_state_leg(
     from torchsnapshot_tpu.tuner import state as tuner_state_mod
     from torchsnapshot_tpu.tuner import reset_overrides
 
+    from torchsnapshot_tpu import telemetry as _telemetry
+
     root = os.path.join(workdir, "steady")
     autotune_on = ts_knobs.is_autotune_enabled()
-    times, probes, effs, knob_traj = [], [], [], []
+    times, probes, effs, knob_traj, write_paths = [], [], [], [], []
     try:
         mgr = ts.CheckpointManager(root, keep_last_n=1)
         est = max(link_est, 1e-3)
@@ -737,6 +841,14 @@ def steady_state_leg(
             mgr.save(i, {"state": ts.PyTreeState(state)})
             times.append(time.perf_counter() - t0)
             del state
+            # Which write-path variant served this take (vectorized /
+            # direct / fused / buffered bytes): alongside the knob
+            # trajectory, what lets a knob flip be correlated with the
+            # efficiency move it caused.
+            rep = _telemetry.last_report("take", path=mgr.step_path(i))
+            write_paths.append(
+                rep.write_path if rep is not None else None
+            )
             probe(f"after steady {i}")
             effs.append((gib / times[-1]) / max(probes[-2], probes[-1]))
             _log(
@@ -763,6 +875,7 @@ def steady_state_leg(
             "d2h_probes": [round(p, 3) for p in probes],
             "final_efficiency": round(effs[-1], 3) if effs else None,
             "knob_trajectory": knob_traj,
+            "write_path_per_take": write_paths,
             "decisions": decisions,
             # Run-level accounting from the leg's ledger: the fraction
             # of THIS multi-take run's wall time that checkpointing
@@ -1232,6 +1345,10 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001
                 _log(f"bench: incremental context measurement failed: {e!r}")
             _emit_partial("incremental")
+
+        # ---- Leg 5b: zero-pack write-path microbench (context) ----
+        write_path_leg(workdir)
+
         # Release the last trial state before the async-stall state
         # materializes: 1x HBM peak throughout.
         state = None

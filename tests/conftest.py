@@ -51,6 +51,15 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_FANOUT_RESTORE", "0")
 # or an env override in their multiprocess workers.
 os.environ.setdefault("TORCHSNAPSHOT_TPU_PEER_TIER", "0")
 
+# O_DIRECT fs writes are pinned off in the suite ("0" = buffered; also
+# the packaged default): CI filesystems vary — some support O_DIRECT,
+# some decline with EINVAL — and tier-1 write-path assertions must not
+# depend on which one this container mounts. Direct-I/O tests opt back
+# in via knobs.enable_fs_direct_io() and assert BOTH outcomes. The
+# zero-pack vectorized write stays at its packaged default (ON) so the
+# tier-1 batching lane exercises the production slab path.
+os.environ.setdefault("TORCHSNAPSHOT_TPU_FS_DIRECT_IO", "0")
+
 # The write-path autotuner is likewise off by default in the suite
 # ("0" = kill switch): tier-1 manager tests must run the exact
 # hand-set/default knob geometry they assert about, with no

@@ -152,7 +152,12 @@ def test_concurrent_progress_extends_straggler_deadline() -> None:
         return "eventually"
 
     async def sibling():
-        for _ in range(20):
+        # Refresh until cancelled: decorrelated backoff jitter (PR 10)
+        # makes the straggler's two sleeps unbounded-ish (each uniform up
+        # to 3x the previous), so a fixed refresh count can lapse the
+        # window mid-backoff and flake the test. The straggler's own
+        # window (0.6 s vs ~1 s+ backoffs) still carries the assertion.
+        while True:
             await asyncio.sleep(0.1)
             strategy.record_progress()
 

@@ -213,6 +213,40 @@ def test_peer_tier_knobs() -> None:
     assert knobs.get_peer_transfer_timeout_seconds() == 30.0
 
 
+def test_write_path_knobs() -> None:
+    """Zero-pack vectorized writes default ON (an explicit "0" restores
+    the packed slab path); O_DIRECT defaults OFF and is pinned off by
+    the suite conftest (CI filesystems vary). Both are tunables: the
+    autotuner can flip them through the override layer, env wins."""
+    assert knobs.is_write_vectorized_enabled()
+    with knobs.disable_write_vectorized():
+        assert not knobs.is_write_vectorized_enabled()
+    with knobs.enable_write_vectorized():
+        assert knobs.is_write_vectorized_enabled()
+    assert knobs.is_write_vectorized_enabled()
+
+    assert not knobs.is_fs_direct_io_enabled()  # conftest pin (and default)
+    with knobs.enable_fs_direct_io():
+        assert knobs.is_fs_direct_io_enabled()
+    assert not knobs.is_fs_direct_io_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_FS_DIRECT_IO", None)
+    try:
+        assert not knobs.is_fs_direct_io_enabled()  # packaged default OFF
+        # Tuner override applies when no env var is set; env wins over it.
+        knobs.set_tuner_override("TORCHSNAPSHOT_TPU_FS_DIRECT_IO", 1)
+        assert knobs.is_fs_direct_io_enabled()
+        with knobs.disable_fs_direct_io():
+            assert not knobs.is_fs_direct_io_enabled()
+    finally:
+        knobs.clear_tuner_overrides()
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_FS_DIRECT_IO"] = prev
+
+    snap = knobs.tunable_snapshot()
+    assert snap["write_vectorized"] == 1
+    assert snap["fs_direct_io"] == 0
+
+
 def test_memory_budget_fraction_knob() -> None:
     assert knobs.get_memory_budget_fraction() == 0.6
     with knobs.override_memory_budget_fraction(0.3):

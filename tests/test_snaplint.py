@@ -741,6 +741,91 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# native-decl-sync
+# ---------------------------------------------------------------------------
+
+_NATIVE_DECLARE_OK = """
+import ctypes
+
+def _declare(l):
+    l.ts_write_file.argtypes = [ctypes.c_char_p]
+    l.ts_write_file.restype = ctypes.c_int
+    l.ts_crc32c.argtypes = [ctypes.c_void_p]
+    l.ts_crc32c.restype = ctypes.c_uint32
+"""
+
+_CPP_OK = """
+extern "C" {
+int ts_write_file(const char* path) { return 0; }
+uint32_t ts_crc32c(const void* buf) { return 0; }
+}
+"""
+
+# Declared on the Python side, missing from the C ABI — the segfault case.
+_CPP_MISSING_ONE = """
+extern "C" {
+int ts_write_file(const char* path) { return 0; }
+}
+"""
+
+# Exported from C, never declared — the drift case.
+_CPP_EXTRA_ONE = """
+extern "C" {
+int ts_write_file(const char* path) { return 0; }
+uint32_t ts_crc32c(const void* buf) { return 0; }
+int ts_orphan(const void* buf) { return 0; }
+}
+"""
+
+
+def _native_sync_errors(tmp_path, py_src, cpp_src):
+    from tools.snaplint.rules.native_decl_sync import check
+
+    py = tmp_path / "_native.py"
+    cpp = tmp_path / "ts_io.cpp"
+    py.write_text(py_src)
+    cpp.write_text(cpp_src)
+    return check(py, cpp)
+
+
+def test_native_decl_sync_detects_and_accepts_fix(tmp_path):
+    assert _native_sync_errors(tmp_path, _NATIVE_DECLARE_OK, _CPP_OK) == []
+    missing = _native_sync_errors(
+        tmp_path, _NATIVE_DECLARE_OK, _CPP_MISSING_ONE
+    )
+    assert len(missing) == 1 and "ts_crc32c" in missing[0]
+    assert "segfault" in missing[0]
+    extra = _native_sync_errors(tmp_path, _NATIVE_DECLARE_OK, _CPP_EXTRA_ONE)
+    assert len(extra) == 1 and "ts_orphan" in extra[0]
+    assert "never declared" in extra[0]
+
+
+def test_native_decl_sync_ignores_calls_and_helpers(tmp_path):
+    """C-side calls to ts_ functions and non-prefixed helpers are not
+    definitions; a one-symbol surface with an internal call stays clean."""
+    cpp = """
+namespace {
+int write_all(int fd) { return 0; }
+}
+extern "C" {
+int ts_write_file(const char* path) {
+  return write_all(0) + ts_write_file(path);
+}
+uint32_t ts_crc32c(const void* buf) { return 0; }
+}
+"""
+    assert _native_sync_errors(tmp_path, _NATIVE_DECLARE_OK, cpp) == []
+
+
+def test_native_decl_sync_repo_clean_on_head():
+    analyzer = Analyzer(root=REPO, select=["native-decl-sync"])
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == [], "\n".join(
+        f.render() for f in result.new_findings
+    )
+
+
+# ---------------------------------------------------------------------------
 # repo-wide lane: the analyzer is clean on HEAD and wired into CI
 # ---------------------------------------------------------------------------
 
@@ -812,6 +897,7 @@ def test_cli_json_output_and_rule_listing():
         "doctor-rule-ids",
         "ledger-event-ids",
         "tiered-test-markers",
+        "native-decl-sync",
     ):
         assert rule in listing.stdout
 

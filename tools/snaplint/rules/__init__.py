@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     executor_thread_leak,
     knob_env_literal,
     names_lint,
+    native_decl_sync,
     span_budget_balance,
     tiered_markers,
 )

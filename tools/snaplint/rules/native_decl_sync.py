@@ -1,0 +1,118 @@
+"""native-decl-sync: the ctypes declarations in ``_native._declare``
+and the C ABI surface of ``native/ts_io.cpp`` must name the same set of
+symbols.
+
+A symbol declared on the Python side but missing from the shared
+library is a runtime segfault (ctypes resolves lazily — the first
+foreign call dies, not the import); a symbol exported from C but never
+declared is unusable drift that the next declaration typo can silently
+shadow. Neither is a thing a test suite reliably catches (the native
+lib may be unbuildable in CI), so the sync is a lint: pure text/AST,
+no compiler needed.
+
+Convention: every C-ABI function in ts_io.cpp carries the ``ts_``
+prefix (helpers live in anonymous namespaces without it), and
+``_declare`` assigns ``l.<symbol>.argtypes`` / ``.restype`` for each.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List
+
+from ..core import Finding, Project, Rule, register
+
+NATIVE_PY_RELPATH = "torchsnapshot_tpu/_native.py"
+TS_IO_CPP_RELPATH = "torchsnapshot_tpu/native/ts_io.cpp"
+
+# A C function *definition* line: one-or-more type tokens, then the
+# ts_-prefixed name, then the parameter list opener. Calls never match
+# (they don't start a line with a type), and helpers lack the prefix.
+_CPP_DEF_RE = re.compile(
+    r"(?m)^\s*(?:[A-Za-z_][A-Za-z0-9_]*\s+)+\**\s*(ts_[A-Za-z0-9_]*)\s*\("
+)
+
+
+def declared_symbols(native_py_source: str) -> Dict[str, int]:
+    """``ts_*`` symbols the ``_declare`` function binds (name -> line),
+    from ``l.<name>.argtypes`` / ``l.<name>.restype`` assignments."""
+    tree = ast.parse(native_py_source)
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef) and node.name == "_declare"):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Attribute):
+                continue
+            # l.<name>.argtypes — the inner Attribute is l.<name>.
+            if sub.attr in ("argtypes", "restype") and isinstance(
+                sub.value, ast.Attribute
+            ):
+                name = sub.value.attr
+                if name.startswith("ts_") and name not in out:
+                    out[name] = sub.lineno
+    return out
+
+
+def exported_symbols(cpp_source: str) -> Dict[str, int]:
+    """``ts_*`` function definitions in the C++ source (name -> line)."""
+    out: Dict[str, int] = {}
+    for m in _CPP_DEF_RE.finditer(cpp_source):
+        name = m.group(1)
+        if name not in out:
+            out[name] = cpp_source.count("\n", 0, m.start()) + 1
+    return out
+
+
+def check(native_py: Path, ts_io_cpp: Path) -> List[str]:
+    """Mismatch messages (empty = in sync); the shared implementation
+    the Rule below and the tests drive."""
+    errors: List[str] = []
+    if not native_py.exists():
+        return [f"{native_py.name}: missing (ctypes declarations live here)"]
+    if not ts_io_cpp.exists():
+        return [f"{ts_io_cpp.name}: missing (the C ABI surface lives here)"]
+    declared = declared_symbols(native_py.read_text())
+    exported = exported_symbols(ts_io_cpp.read_text())
+    for name in sorted(set(declared) - set(exported)):
+        errors.append(
+            f"{native_py.name}:{declared[name]}: {name} is declared in "
+            f"_declare but not defined in {ts_io_cpp.name} — the first "
+            f"foreign call would segfault at runtime"
+        )
+    for name in sorted(set(exported) - set(declared)):
+        errors.append(
+            f"{ts_io_cpp.name}:{exported[name]}: {name} is exported from "
+            f"the C ABI but never declared in _declare — unusable, and "
+            f"drift the next signature change can hide behind"
+        )
+    return errors
+
+
+@register
+class NativeDeclSync(Rule):
+    name = "native-decl-sync"
+    description = (
+        "every symbol _native._declare binds exists in ts_io.cpp's C ABI "
+        "and vice versa (a drifted signature is a segfault, not a lint)"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        native_py = project.root / NATIVE_PY_RELPATH
+        if not (project.root / "torchsnapshot_tpu").is_dir():
+            return ()  # fixture run outside the real repo layout
+        for err in check(native_py, project.root / TS_IO_CPP_RELPATH):
+            path, line = NATIVE_PY_RELPATH, 1
+            m = re.match(r"^([^:]+):(\d+): ", err)
+            msg = err
+            if m:
+                base = m.group(1)
+                line = int(m.group(2))
+                msg = err[m.end():]
+                if base.endswith(".cpp"):
+                    path = TS_IO_CPP_RELPATH
+            elif ": " in err:
+                msg = err.split(": ", 1)[1]
+            yield Finding(rule=self.name, path=path, line=line, message=msg)

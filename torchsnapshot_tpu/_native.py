@@ -160,6 +160,25 @@ def _declare(l: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_uint32),
     ]
     l.ts_pread_crc.restype = ctypes.c_int
+    l.ts_pwritev_file_crc.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int,
+    ]
+    l.ts_pwritev_file_crc.restype = ctypes.c_int
+    l.ts_write_file_crc_direct.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int,
+    ]
+    l.ts_write_file_crc_direct.restype = ctypes.c_int
 
 
 def _raise_errno(rc: int, path: str) -> None:
@@ -171,15 +190,49 @@ def _addr_of(mv: memoryview) -> int:
     """Address of a contiguous memoryview's first byte (no copy).
 
     The address stays valid only while ``mv`` is alive — callers keep the
-    view referenced for the duration of the foreign call. Routed through
-    ``np.frombuffer`` because ctypes' ``from_buffer`` rejects read-only
-    objects (and bytes/serialized buffers are read-only).
+    view referenced for the duration of the foreign call. Writable
+    buffers resolve through ``ctypes.from_buffer`` (pure C, no wrapper
+    object churn); read-only ones (bytes / serialized payloads, which
+    ``from_buffer`` rejects) fall back to the ``np.frombuffer`` route.
+    This runs once per chunk on the hottest path in the library, so the
+    numpy import is hoisted to module scope (lazily, on first read-only
+    caller) instead of being re-resolved per call.
     """
-    import numpy as np
-
     if mv.nbytes == 0:
         return 0
-    return int(np.frombuffer(mv, dtype=np.uint8).ctypes.data)
+    if not mv.readonly:
+        return ctypes.addressof(ctypes.c_char.from_buffer(mv))
+    global _np
+    if _np is None:
+        import numpy
+
+        _np = numpy
+    return int(_np.frombuffer(mv, dtype=_np.uint8).ctypes.data)
+
+
+_np = None
+
+# O_DIRECT alignment unit (matches kDirectAlign in ts_io.cpp): buffer
+# addresses must sit on this boundary for the direct write path.
+DIRECT_IO_ALIGNMENT = 4096
+
+
+def aligned_buffer(nbytes: int, align: int = DIRECT_IO_ALIGNMENT) -> memoryview:
+    """A writable ``nbytes`` view whose first byte sits on an ``align``
+    boundary — what makes a staged slab O_DIRECT-eligible. The view
+    keeps its backing bytearray alive; zero-size requests still return
+    a (degenerate) view so callers never branch."""
+    raw = bytearray(nbytes + align)
+    base = ctypes.addressof(ctypes.c_char.from_buffer(raw))
+    off = (-base) % align
+    return memoryview(raw)[off : off + nbytes]
+
+
+def is_direct_aligned(mv: memoryview) -> bool:
+    """True when ``mv``'s first byte is O_DIRECT-aligned."""
+    if mv.nbytes == 0:
+        return False
+    return _addr_of(mv) % DIRECT_IO_ALIGNMENT == 0
 
 
 def write_file(path: str, buf, do_fsync: bool = False) -> bool:
@@ -284,6 +337,94 @@ def pread_into_crc(
     if rc != 0:
         _raise_errno(rc, path)
     return [int(crcs[i]) for i in range(n_pages)]
+
+
+def pwritev_file_crc(
+    path: str,
+    parts: Sequence[object],
+    page_size: Optional[int] = None,
+    do_fsync: bool = False,
+) -> Optional[List[int]]:
+    """Zero-pack vectorized write: gather ``parts`` (buffer-protocol
+    objects, concatenated in order) straight into a fresh file with
+    pwritev. With ``page_size`` set, additionally computes the CRC32-C
+    of each page of the concatenated stream (pages cross part
+    boundaries) in the same cache-hot pass and returns the page list;
+    without it, returns ``[]`` on success. ``None`` when the native
+    runtime is unavailable (nothing written)."""
+    l = lib()
+    if l is None:
+        return None
+    n = len(parts)
+    bufs = (ctypes.c_void_p * max(1, n))()
+    lens = (ctypes.c_uint64 * max(1, n))()
+    keepalive: List[memoryview] = []
+    total = 0
+    for i, part in enumerate(parts):
+        mv = memoryview(part)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        keepalive.append(mv)
+        bufs[i] = _addr_of(mv)
+        lens[i] = mv.nbytes
+        total += mv.nbytes
+    crcs = None
+    if page_size is not None:
+        n_pages = (total + page_size - 1) // page_size
+        crcs = (ctypes.c_uint32 * max(1, n_pages))()
+    rc = l.ts_pwritev_file_crc(
+        path.encode(),
+        bufs,
+        lens,
+        n,
+        page_size or 0,
+        crcs,
+        1 if do_fsync else 0,
+    )
+    if rc != 0:
+        _raise_errno(rc, path)
+    if crcs is None:
+        return []
+    n_pages = (total + page_size - 1) // page_size
+    return [int(crcs[i]) for i in range(n_pages)]
+
+
+def write_file_crc_direct(
+    path: str, buf, page_size: Optional[int] = None, do_fsync: bool = False
+) -> Optional[List[int]]:
+    """O_DIRECT fused write (+ optional integrity pass) for large aligned
+    buffers: the 4096-aligned body bypasses the page cache, the unaligned
+    tail is written buffered, and — with ``page_size`` set — each page's
+    CRC32-C is computed in the same loop. ``page_size=None`` skips the
+    CRC pass entirely (the kernel takes a NULL page array; no per-byte
+    CRC cost when the caller doesn't record checksums) and returns ``[]``
+    on success. ``None`` when the native runtime is unavailable. Raises
+    ``OSError(EINVAL)`` on filesystems without O_DIRECT support (tmpfs)
+    or for unaligned buffers — callers treat that as a sticky decline
+    back to the buffered fused path."""
+    l = lib()
+    if l is None:
+        return None
+    mv = memoryview(buf)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    if page_size is None:
+        n_pages = 0
+        out = None
+    else:
+        n_pages = (mv.nbytes + page_size - 1) // page_size
+        out = (ctypes.c_uint32 * max(1, n_pages))()
+    rc = l.ts_write_file_crc_direct(
+        path.encode(),
+        _addr_of(mv),
+        mv.nbytes,
+        page_size or 0,
+        out,
+        1 if do_fsync else 0,
+    )
+    if rc != 0:
+        _raise_errno(rc, path)
+    return [int(out[i]) for i in range(n_pages)]
 
 
 def write_file_crc(

@@ -36,14 +36,16 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import _native, knobs
+from . import _native, knobs, telemetry
 from .telemetry import names as metric_names
 from .telemetry.trace import get_recorder as _trace_recorder
 from .io_types import (
     BufferConsumer,
+    BufferList,
     BufferStager,
     BufferType,
     ReadReq,
+    as_bytes_view,
     WriteReq,
 )
 from .manifest import (
@@ -108,7 +110,11 @@ class BatchedBufferStager(BufferStager):
         # The group split (and the staging cost derived from it) is fixed
         # here: it depends on knob state and on stager.arr fields that
         # staging itself mutates, so admission and any later budget
-        # arithmetic must see one consistent value.
+        # arithmetic must see one consistent value. The vectorized-write
+        # decision is pinned for the same reason: a knob flip between
+        # admission and staging must not change what this stager costs
+        # or returns.
+        self._vectorized = knobs.is_write_vectorized_enabled()
         self._packed, self._rest = self._split_device_groups()
         pack_bytes = sum(size for items in self._packed for _, _, size in items)
         peak_member = max(
@@ -118,7 +124,13 @@ class BatchedBufferStager(BufferStager):
             ),
             default=0,
         )
-        self._staging_cost = self.total + pack_bytes + peak_member
+        if self._vectorized:
+            # Zero-pack: the members' own staged buffers ARE the output
+            # (handed to the plugin as a BufferList) — no slab
+            # allocation, no transient pack copies alongside it.
+            self._staging_cost = self.total
+        else:
+            self._staging_cost = self.total + pack_bytes + peak_member
 
     def capture(self, cache: dict) -> None:
         """Device-snapshot capture recurses into the slab's members:
@@ -220,18 +232,21 @@ class BatchedBufferStager(BufferStager):
     def _copy_member(
         self, view: memoryview, buf: BufferType, req: WriteReq, offset: int, size: int
     ) -> None:
-        mv = memoryview(buf)
-        if mv.format != "B" or mv.ndim != 1:
-            mv = mv.cast("B")
-        if len(mv) != size:
-            raise RuntimeError(
-                f"Slab member {req.path!r} staged {len(mv)} bytes but "
-                f"was planned at {size}; byte ranges in the manifest "
-                f"would be wrong"
-            )
+        mv = as_bytes_view(buf)
+        self._check_member_size(len(mv), req, size)
         view[offset : offset + size] = mv
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        if self._vectorized:
+            # Zero-pack path: no slab buffer exists, so no pack span is
+            # emitted — the distinct span name is the observable pin
+            # that the pack pass did not run.
+            with _trace_recorder().span(
+                metric_names.SPAN_BATCHER_STAGE_SLAB_VECTORIZED,
+                members=len(self.members),
+                bytes=self.total,
+            ):
+                return await self._stage_vectorized_impl(executor)
         # Recorder-only span (awaits inside): the slab's whole
         # pack+memcpy assembly as one timeline block.
         with _trace_recorder().span(
@@ -241,10 +256,130 @@ class BatchedBufferStager(BufferStager):
         ):
             return await self._stage_buffer_impl(executor)
 
+    def _pack_group_vectorized(
+        self, items: List[Tuple[WriteReq, int, int]]
+    ) -> List[Tuple[int, memoryview]]:
+        """Device-pack a group for the zero-pack path: one dispatch + one
+        D2H yields a host buffer whose per-member slices become BufferList
+        parts directly — no scatter into a slab. Falls back to per-member
+        staging on any failure, like the packed path."""
+        from .ops.device_pack import pack_async
+
+        out: List[Tuple[int, memoryview]] = []
+        try:
+            specs = []
+            for req, _, _ in items:
+                stager = req.buffer_stager
+                slc = stager.slc
+                specs.append(
+                    (
+                        stager.arr,
+                        (slc.start, slc.stop) if slc is not None else None,
+                    )
+                )
+            host = np.asarray(pack_async(specs))  # the single D2H
+            expected = sum(size for _, _, size in items)
+            if host.nbytes != expected:
+                raise RuntimeError(
+                    f"device pack produced {host.nbytes} bytes, "
+                    f"planned {expected}"
+                )
+            hostview = memoryview(host).cast("B")
+            src = 0
+            for req, offset, size in items:
+                out.append((offset, hostview[src : src + size]))
+                src += size
+                req.buffer_stager.arr = None  # release HBM promptly
+            return out
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "Device slab pack failed (%r); staging %d members "
+                "individually",
+                e,
+                len(items),
+            )
+            for req, offset, size in items:
+                if req.buffer_stager.arr is None:
+                    # This member's bytes already landed in ``out``.
+                    continue
+                buf = req.buffer_stager._stage_sync()
+                mv = as_bytes_view(buf)
+                self._check_member_size(len(mv), req, size)
+                out.append((offset, mv))
+            return out
+
+    async def _stage_vectorized_impl(
+        self, executor: Optional[Executor] = None
+    ) -> BufferList:
+        """Zero-pack slab staging: stage every member, hand the staged
+        buffers to the write path as a :class:`BufferList` in planned
+        offset order. The plugin's vectorized kernel (pwritev + fused
+        CRC) writes them without the gather_memcpy pack pass ever
+        running — the one-full-memory-pass-per-staged-byte elimination
+        this path exists for."""
+        loop = asyncio.get_running_loop()
+        parts: List[Tuple[int, memoryview]] = []
+        pack_futures = [
+            loop.run_in_executor(executor, self._pack_group_vectorized, items)
+            for items in self._packed
+        ]
+        first_exc: Optional[BaseException] = None
+        try:
+            for req, offset, size in self._rest:
+                buf = await req.buffer_stager.stage_buffer(executor)
+                mv = as_bytes_view(buf)
+                self._check_member_size(len(mv), req, size)
+                parts.append((offset, mv))
+        except BaseException as e:  # noqa: BLE001 - settle packs first
+            first_exc = e
+        for fut in pack_futures:
+            try:
+                parts.extend(await fut)
+            except BaseException as pack_exc:  # noqa: BLE001
+                if first_exc is None:
+                    first_exc = pack_exc
+                else:
+                    logger.warning(
+                        "Device pack failed while aborting slab staging: %r",
+                        pack_exc,
+                    )
+        if first_exc is not None:
+            raise first_exc
+        parts.sort(key=lambda item: item[0])
+        expect = 0
+        for offset, mv in parts:
+            if offset != expect:
+                raise RuntimeError(
+                    f"vectorized slab has a hole at byte {expect} "
+                    f"(next member starts at {offset}); manifest byte "
+                    f"ranges would be wrong"
+                )
+            expect = offset + mv.nbytes
+        if expect != self.total:
+            raise RuntimeError(
+                f"vectorized slab staged {expect} bytes, planned "
+                f"{self.total}"
+            )
+        telemetry.metrics().counter_inc(
+            metric_names.BATCHER_PACK_BYTES_AVOIDED_TOTAL, self.total
+        )
+        return BufferList([mv for _, mv in parts])
+
+    def _check_member_size(self, staged: int, req: WriteReq, size: int) -> None:
+        if staged != size:
+            raise RuntimeError(
+                f"Slab member {req.path!r} staged {staged} bytes but "
+                f"was planned at {size}; byte ranges in the manifest "
+                f"would be wrong"
+            )
+
     async def _stage_buffer_impl(
         self, executor: Optional[Executor] = None
     ) -> BufferType:
-        slab = bytearray(self.total)
+        # 4096-aligned allocation: a packed slab qualifies for the fs
+        # plugin's O_DIRECT write path (alignment is the eligibility
+        # gate; see docs/storage.md "Native write path").
+        slab = _native.aligned_buffer(self.total)
         view = memoryview(slab)
         loop = asyncio.get_running_loop()
         packed, rest = self._packed, self._rest
@@ -265,9 +400,7 @@ class BatchedBufferStager(BufferStager):
                 # Large members copy with the multithreaded native memcpy;
                 # small ones aren't worth the thread spawn.
                 if size >= (8 << 20):
-                    mv = memoryview(buf)
-                    if mv.format != "B" or mv.ndim != 1:
-                        mv = mv.cast("B")
+                    mv = as_bytes_view(buf)
                     if len(mv) == size and _native.gather_memcpy(
                         slab, [(mv, offset)], n_threads=4
                     ):
@@ -370,9 +503,7 @@ class BatchedBufferConsumer(BufferConsumer):
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
-        mv = memoryview(buf)
-        if mv.format != "B" or mv.ndim != 1:
-            mv = mv.cast("B")
+        mv = as_bytes_view(buf)
         # Recorder-only span: the spanning read's fan-out to member
         # consumers, previously invisible on any timeline.
         with _trace_recorder().span(

@@ -36,7 +36,14 @@ import zlib
 from typing import Dict, Optional, Tuple
 
 from . import _native, knobs
-from .io_types import BufferType, ReadIO, StoragePlugin, WriteIO
+from .io_types import (
+    BufferList,
+    BufferType,
+    ReadIO,
+    StoragePlugin,
+    WriteIO,
+    as_bytes_view as _as_bytes_view,
+)
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -53,13 +60,6 @@ ChecksumTable = Dict[str, Tuple]
 
 def table_path(rank: int) -> str:
     return f"{CHECKSUM_DIR}/{rank}"
-
-
-def _as_bytes_view(buf: BufferType) -> memoryview:
-    mv = memoryview(buf)
-    if mv.format != "B":
-        mv = mv.cast("B")
-    return mv
 
 
 def _pick_alg() -> str:
@@ -137,12 +137,18 @@ def compute_checksum(buf: BufferType) -> Tuple[str, int]:
     return (alg, _crc_of(_as_bytes_view(buf), alg))
 
 
-def compute_checksum_entry(buf: BufferType) -> Tuple:
+def compute_checksum_entry(buf) -> Tuple:
     """Full table entry for one staged blob. Single-page blobs get the
     whole-blob digest; larger blobs additionally get per-page digests for
     ranged-read verification. The whole-blob digest is folded from the
     page digests with GF(2) shift operators (the zlib crc32_combine
-    construction) — O(1) per page, so each byte is CRC'd exactly once."""
+    construction) — O(1) per page, so each byte is CRC'd exactly once.
+    Accepts a :class:`BufferList` (the zero-pack vectorized payload):
+    page digests then chain across part boundaries, yielding the exact
+    entry the consolidated bytes would — bit-identical tables on both
+    write paths, without consolidating."""
+    if isinstance(buf, BufferList):
+        return _entry_from_parts(buf.parts, buf.nbytes)
     mv = _as_bytes_view(buf)
     nbytes = mv.nbytes
     alg = _pick_alg()
@@ -152,6 +158,32 @@ def compute_checksum_entry(buf: BufferType) -> Tuple:
         _crc_of(mv[off : off + PAGE_SIZE], alg)
         for off in range(0, nbytes, PAGE_SIZE)
     ]
+    return entry_from_page_crcs(pages, nbytes, alg)
+
+
+def _entry_from_parts(parts, nbytes: int) -> Tuple:
+    """Table entry for a logically-concatenated multi-part blob: per-page
+    digests over the concatenated stream (both CRC implementations
+    support continuation, so a page straddling parts chains its running
+    digest through the seed), folded exactly like the contiguous path."""
+    alg = _pick_alg()
+    pages: list = []
+    cur = 0
+    cur_len = 0
+    for mv in parts:
+        off = 0
+        while off < mv.nbytes:
+            take = min(PAGE_SIZE - cur_len, mv.nbytes - off)
+            cur = _crc_of(mv[off : off + take], alg, seed=cur)
+            cur_len += take
+            off += take
+            if cur_len == PAGE_SIZE:
+                pages.append(cur)
+                cur, cur_len = 0, 0
+    if cur_len:
+        pages.append(cur)
+    if nbytes <= PAGE_SIZE:
+        return (alg, pages[0] if pages else _crc_of(memoryview(b""), alg), nbytes)
     return entry_from_page_crcs(pages, nbytes, alg)
 
 

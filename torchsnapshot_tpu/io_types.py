@@ -12,18 +12,92 @@ from __future__ import annotations
 
 import abc
 from concurrent.futures import Executor
-from dataclasses import dataclass
-from typing import Optional, Tuple, Union
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple, Union
 
 BufferType = Union[bytes, bytearray, memoryview]
 
 
+def as_bytes_view(buf: BufferType) -> memoryview:
+    """The one contiguous-byte-view normalization (flat ``B``-format
+    memoryview) the Python layers share — batcher, plugins and the
+    integrity module funnel through here, so a future change (e.g.
+    non-contiguous handling) has one home. ``_native`` keeps its own
+    inline copy: it is the dependency-free bottom layer."""
+    mv = memoryview(buf)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+class BufferList:
+    """An ordered list of byte buffers forming ONE logical blob — the
+    zero-pack write payload. The batcher's vectorized slab stage hands
+    its members' staged buffers straight to the storage plugin as a
+    ``BufferList`` instead of packing them into a staging bytearray
+    (one full memory pass over every staged byte, eliminated); plugins
+    that declare ``supports_multibuffer`` gather-write the parts in one
+    vectorized kernel (fs: ``pwritev`` + fused CRC), and the scheduler
+    consolidates for plugins that don't — paying exactly the old pack,
+    never more.
+
+    ``len()`` is the total byte count (scheduler budget accounting);
+    ``parts`` are contiguous B-format memoryviews in blob order (the
+    originals are kept referenced so the views stay valid)."""
+
+    __slots__ = ("parts", "nbytes", "_keepalive")
+
+    def __init__(self, parts: Sequence[BufferType]) -> None:
+        self._keepalive = list(parts)
+        self.parts: List[memoryview] = []
+        total = 0
+        for part in self._keepalive:
+            mv = as_bytes_view(part)
+            if mv.nbytes == 0:
+                continue  # zero-length parts add nothing to the stream
+            self.parts.append(mv)
+            total += mv.nbytes
+        self.nbytes = total
+
+    def __len__(self) -> int:
+        return self.nbytes
+
+    def consolidate(self) -> memoryview:
+        """One contiguous copy of the logical blob — the pack pass the
+        zero-pack path avoids, kept as the compatibility fallback for
+        plugins without multi-buffer support."""
+        out = bytearray(self.nbytes)
+        off = 0
+        for mv in self.parts:
+            out[off : off + mv.nbytes] = mv
+            off += mv.nbytes
+        return memoryview(out)
+
+
+WritePayload = Union[BufferType, BufferList]
+
+
+def payload_nbytes(buf: WritePayload) -> int:
+    """Total byte count of a write payload, single-buffer or vectorized."""
+    if isinstance(buf, BufferList):
+        return buf.nbytes
+    return as_bytes_view(buf).nbytes
+
+
 @dataclass
 class WriteIO:
-    """A fully-staged write: raw bytes destined for ``path``."""
+    """A fully-staged write: raw bytes destined for ``path``. ``buf`` is
+    a single contiguous buffer or a :class:`BufferList` (zero-pack
+    vectorized form — only handed to plugins whose
+    ``supports_multibuffer`` is true; the scheduler consolidates first
+    otherwise). ``variant`` is set by the plugin after the write with
+    the path that actually served it (``vectorized`` | ``direct`` |
+    ``fused`` | ``buffered``) — the per-take write-path accounting
+    SnapshotReports carry."""
 
     path: str
-    buf: BufferType
+    buf: WritePayload
+    variant: Optional[str] = field(default=None, compare=False)
 
 
 @dataclass
@@ -108,6 +182,13 @@ class StoragePlugin(abc.ABC):
     ``read_io.buf`` (respecting ``byte_range``); ``write`` persists
     ``write_io.buf`` at ``write_io.path`` relative to the plugin root.
     """
+
+    # Capability flag: plugins that can persist a BufferList payload
+    # without consolidating it (fs: pwritev) set this true; for all
+    # others the scheduler consolidates before the write ever reaches
+    # the plugin, so write()/write_with_checksum() implementations may
+    # assume a single contiguous buffer unless they opt in.
+    supports_multibuffer: bool = False
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None: ...

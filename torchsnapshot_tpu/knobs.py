@@ -69,6 +69,8 @@ _PEER_CACHE_BUDGET_BYTES_ENV = "TORCHSNAPSHOT_TPU_PEER_CACHE_BUDGET_BYTES"
 _PEER_TRANSFER_TIMEOUT_ENV = (
     "TORCHSNAPSHOT_TPU_PEER_TRANSFER_TIMEOUT_SECONDS"
 )
+_WRITE_VECTORIZED_ENV = "TORCHSNAPSHOT_TPU_WRITE_VECTORIZED"
+_FS_DIRECT_IO_ENV = "TORCHSNAPSHOT_TPU_FS_DIRECT_IO"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -521,6 +523,32 @@ def get_peer_transfer_timeout_seconds() -> float:
     return _DEFAULT_PEER_TRANSFER_TIMEOUT_SECONDS
 
 
+def is_write_vectorized_enabled() -> bool:
+    """Zero-pack vectorized slab writes (default ON): the batcher's slab
+    stage hands its members' staged buffers straight to the storage
+    plugin as a multi-buffer payload, written with one vectorized
+    ``pwritev`` + fused per-page CRC kernel — the ``gather_memcpy``
+    slab-pack pass (one full memory pass over every staged byte)
+    disappears. Set to ``"0"`` to restore the packed path (stage into a
+    contiguous slab buffer first). Plugins without multi-buffer support
+    are consolidated for transparently either way; blob bytes and
+    integrity tables are bit-identical on both paths. Tunable: the
+    autotuner may flip it (env always wins)."""
+    return _get_tunable_int(_WRITE_VECTORIZED_ENV, 1) != 0
+
+
+def is_fs_direct_io_enabled() -> bool:
+    """O_DIRECT fs writes for large 4096-aligned buffers (default OFF —
+    filesystems vary; the autotuner can turn it on where the doctor says
+    the storage tier is the wall): the aligned body of a qualifying blob
+    bypasses the page cache (checkpoint bytes the trainer never re-reads
+    would only evict pages it will), the unaligned tail is written
+    buffered, and per-page CRCs ride the same pass. Unsupported
+    filesystems (tmpfs: EINVAL) decline sticky-per-plugin back to the
+    buffered path — correctness is identical everywhere."""
+    return _get_tunable_int(_FS_DIRECT_IO_ENV, 0) != 0
+
+
 def get_memory_budget_fraction() -> float:
     """Fraction of *available* host memory the per-process staging
     budget may claim (scheduler.get_process_memory_budget_bytes; the
@@ -548,6 +576,8 @@ def tunable_snapshot() -> Dict[str, Union[int, float]]:
         "max_chunk_size_bytes": get_max_chunk_size_bytes(),
         "max_shard_size_bytes": get_max_shard_size_bytes(),
         "slab_size_threshold_bytes": get_slab_size_threshold_bytes(),
+        "write_vectorized": int(is_write_vectorized_enabled()),
+        "fs_direct_io": int(is_fs_direct_io_enabled()),
     }
 
 
@@ -856,6 +886,35 @@ def override_peer_transfer_timeout_seconds(
     seconds: float,
 ) -> Generator[None, None, None]:
     with _override_env(_PEER_TRANSFER_TIMEOUT_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def disable_write_vectorized() -> Generator[None, None, None]:
+    """Force the packed slab path for the block (byte-identity tests
+    compare it against the default zero-pack path)."""
+    with _override_env(_WRITE_VECTORIZED_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def enable_write_vectorized() -> Generator[None, None, None]:
+    with _override_env(_WRITE_VECTORIZED_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def enable_fs_direct_io() -> Generator[None, None, None]:
+    """Force O_DIRECT eligibility ON for the block (the suite's conftest
+    pins it off — CI filesystems vary; direct-I/O tests opt back in and
+    assert the decline ladder where the fs refuses)."""
+    with _override_env(_FS_DIRECT_IO_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def disable_fs_direct_io() -> Generator[None, None, None]:
+    with _override_env(_FS_DIRECT_IO_ENV, "0"):
         yield
 
 

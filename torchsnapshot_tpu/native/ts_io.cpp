@@ -18,11 +18,24 @@
 #include <cstdint>
 #include <cstring>
 #include <fcntl.h>
+#include <limits.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <thread>
 #include <unistd.h>
 #include <vector>
+
+#ifndef O_DIRECT
+// Non-Linux libc: no O_DIRECT flag. ts_write_file_crc_direct then opens
+// with a zero flag and behaves like the buffered fused write — callers
+// treat the path as "supported but not actually direct", which is the
+// correct degradation (bytes and CRCs are identical either way).
+#define O_DIRECT 0
+#endif
+#ifndef IOV_MAX
+#define IOV_MAX 1024
+#endif
 
 namespace {
 
@@ -247,6 +260,190 @@ int ts_write_file_crc(const char* path, const void* buf, uint64_t len,
   if (rc == 0 && do_fsync) {
     if (::fdatasync(fd) != 0) rc = -errno;
   }
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
+// Zero-pack vectorized write: gather `n` caller-owned buffers straight
+// into a fresh file with pwritev — no staging-buffer pack pass — while
+// (optionally) computing the CRC32-C of every `page_size` page of the
+// CONCATENATED byte stream, pages crossing iovec boundaries freely.
+// `out_page_crcs` may be NULL (plain vectorized write, no integrity
+// pass); otherwise it must hold ceil(sum(lens) / page_size) entries.
+// Writes go out in cache-sized batches (<= IOV_MAX iovecs each) and
+// each batch is CRC'd immediately after its pwritev returns, while the
+// bytes are still cache-hot — the same one-memory-pass property as
+// ts_write_file_crc, without the pack that used to precede it.
+int ts_pwritev_file_crc(const char* path, const void** bufs,
+                        const uint64_t* lens, uint64_t n,
+                        uint64_t page_size, uint32_t* out_page_crcs,
+                        int do_fsync) {
+  if (out_page_crcs != nullptr && page_size == 0) return -EINVAL;
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (fd < 0) return -errno;
+  const bool hw = crc32c_hw_available();
+  // Batch bound: small enough that the post-write CRC pass still finds
+  // the bytes in cache, large enough to amortize the syscall.
+  constexpr uint64_t kBatchBytes = 4ull << 20;
+  int rc = 0;
+  uint64_t off = 0;          // file offset (== bytes fully written)
+  uint64_t i = 0;            // current source buffer
+  uint64_t part_off = 0;     // progress within bufs[i]
+  // Rolling page-CRC state across batches/iovecs.
+  uint64_t page = 0;
+  uint64_t page_fill = 0;
+  uint32_t crc = 0xFFFFFFFFu;
+  struct iovec iov[IOV_MAX];
+  while (i < n) {
+    // Assemble the next batch of iovecs.
+    uint64_t bi = i, bpart = part_off, batch_bytes = 0;
+    int cnt = 0;
+    while (bi < n && cnt < IOV_MAX && batch_bytes < kBatchBytes) {
+      uint64_t avail = lens[bi] - bpart;
+      if (avail == 0) { ++bi; bpart = 0; continue; }
+      uint64_t take = avail < kBatchBytes - batch_bytes
+                          ? avail
+                          : kBatchBytes - batch_bytes;
+      iov[cnt].iov_base =
+          const_cast<char*>(static_cast<const char*>(bufs[bi])) + bpart;
+      iov[cnt].iov_len = static_cast<size_t>(take);
+      ++cnt;
+      batch_bytes += take;
+      bpart += take;
+      if (bpart == lens[bi]) { ++bi; bpart = 0; }
+    }
+    if (cnt == 0) { i = bi; part_off = bpart; continue; }
+    // Write the batch, handling short writes by advancing the iovecs.
+    uint64_t written = 0;
+    int k = 0;
+    while (written < batch_bytes) {
+      ssize_t w = ::pwritev(fd, iov + k, cnt - k,
+                            static_cast<off_t>(off + written));
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        rc = -errno;
+        break;
+      }
+      written += static_cast<uint64_t>(w);
+      uint64_t adv = static_cast<uint64_t>(w);
+      while (adv > 0 && k < cnt) {
+        if (adv >= iov[k].iov_len) {
+          adv -= iov[k].iov_len;
+          ++k;
+        } else {
+          iov[k].iov_base = static_cast<char*>(iov[k].iov_base) + adv;
+          iov[k].iov_len -= static_cast<size_t>(adv);
+          adv = 0;
+        }
+      }
+    }
+    if (rc != 0) break;
+    // CRC the batch's bytes (cache-hot), chaining pages across
+    // iovec/batch boundaries.
+    if (out_page_crcs != nullptr) {
+      uint64_t ci = i, cpart = part_off, left = batch_bytes;
+      while (left > 0) {
+        uint64_t avail = lens[ci] - cpart;
+        if (avail == 0) { ++ci; cpart = 0; continue; }
+        uint64_t take = avail < left ? avail : left;
+        const unsigned char* q =
+            static_cast<const unsigned char*>(bufs[ci]) + cpart;
+        while (take > 0) {
+          uint64_t room = page_size - page_fill;
+          uint64_t span = take < room ? take : room;
+          crc = hw ? crc32c_hw(q, span, crc) : crc32c_sw(q, span, crc);
+          page_fill += span;
+          q += span;
+          take -= span;
+          left -= span;
+          cpart += span;
+          if (page_fill == page_size) {
+            out_page_crcs[page++] = ~crc;
+            crc = 0xFFFFFFFFu;
+            page_fill = 0;
+          }
+        }
+        if (cpart == lens[ci]) { ++ci; cpart = 0; }
+      }
+    }
+    off += batch_bytes;
+    i = bi;
+    part_off = bpart;
+  }
+  if (rc == 0 && out_page_crcs != nullptr && page_fill > 0) {
+    out_page_crcs[page++] = ~crc;
+  }
+  if (rc == 0 && do_fsync) {
+    if (::fdatasync(fd) != 0) rc = -errno;
+  }
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
+// Page-cache-bypassing fused write for large ALIGNED buffers: open
+// O_DIRECT, write the 4096-aligned body straight to the device (the
+// trainer never re-reads checkpoint bytes — caching them only evicts
+// pages it will), write the unaligned tail through a second buffered
+// fd, and compute each `page_size` page's CRC32-C in the same loop.
+// `out_page_crcs` may be NULL (no integrity consumer — the plain-write
+// path): the CRC pass is skipped entirely, and `page_size` 0 then
+// defaults to an internal chunking unit. The caller guarantees `buf`
+// is kDirectAlign-aligned; filesystems without O_DIRECT (tmpfs) fail
+// the open with EINVAL, which the Python side treats as a sticky
+// per-plugin decline back to the buffered path.
+constexpr uint64_t kDirectAlign = 4096;
+
+int ts_write_file_crc_direct(const char* path, const void* buf,
+                             uint64_t len, uint64_t page_size,
+                             uint32_t* out_page_crcs, int do_fsync) {
+  if (out_page_crcs != nullptr &&
+      (page_size == 0 || page_size % kDirectAlign != 0)) {
+    return -EINVAL;
+  }
+  if (page_size == 0) page_size = 4ull << 20;
+  if (page_size % kDirectAlign != 0) return -EINVAL;
+  if (reinterpret_cast<uintptr_t>(buf) % kDirectAlign != 0) return -EINVAL;
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC | O_DIRECT,
+                  0644);
+  if (fd < 0) return -errno;
+  const bool hw = crc32c_hw_available();
+  const char* p = static_cast<const char*>(buf);
+  const uint64_t body = len / kDirectAlign * kDirectAlign;
+  uint64_t off = 0;
+  uint64_t page = 0;
+  int rc = 0;
+  int tail_fd = -1;
+  while (off < len) {
+    uint64_t n = len - off < page_size ? len - off : page_size;
+    uint64_t direct_n = off + n <= body ? n : (body > off ? body - off : 0);
+    if (direct_n > 0) {
+      rc = write_all(fd, p + off, direct_n, off);
+      if (rc != 0) break;
+    }
+    if (direct_n < n) {
+      // Unaligned tail (final page only): buffered fd, same file.
+      if (tail_fd < 0) {
+        tail_fd = ::open(path, O_WRONLY | O_CLOEXEC);
+        if (tail_fd < 0) { rc = -errno; break; }
+      }
+      rc = write_all(tail_fd, p + off + direct_n, n - direct_n,
+                     off + direct_n);
+      if (rc != 0) break;
+    }
+    if (out_page_crcs != nullptr) {
+      const unsigned char* q =
+          reinterpret_cast<const unsigned char*>(p + off);
+      uint32_t crc = 0xFFFFFFFFu;
+      crc = hw ? crc32c_hw(q, n, crc) : crc32c_sw(q, n, crc);
+      out_page_crcs[page++] = ~crc;
+    }
+    off += n;
+  }
+  if (rc == 0 && do_fsync) {
+    if (::fdatasync(fd) != 0) rc = -errno;
+    if (rc == 0 && tail_fd >= 0 && ::fdatasync(tail_fd) != 0) rc = -errno;
+  }
+  if (tail_fd >= 0 && ::close(tail_fd) != 0 && rc == 0) rc = -errno;
   if (::close(fd) != 0 && rc == 0) rc = -errno;
   return rc;
 }

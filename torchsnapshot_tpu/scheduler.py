@@ -48,7 +48,14 @@ from .integrity import (
     verify_page_crcs,
     verify_range_checksum,
 )
-from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .io_types import (
+    BufferList,
+    ReadIO,
+    ReadReq,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+)
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -269,6 +276,12 @@ class _PipelineStats:
         self.done = 0
         self.bytes_moved = 0
         self.bytes_staged = 0
+        # Write pipelines: bytes served per write-path variant
+        # ("vectorized" | "direct" | "fused" | "buffered"), as stamped
+        # by the storage plugin on each WriteIO — the per-take record
+        # that lets doctor --trend correlate a write-path knob flip
+        # with an efficiency move.
+        self.write_variant_bytes: dict = {}
         # Read pipelines only: how many of the moved bytes were pulled
         # from the storage plugin itself ("fetched") versus served from
         # a peer-exchanged cache (fan-out restore; those bytes were
@@ -394,6 +407,8 @@ class _ProgressReporter:
         }
         if isinstance(self.budget, StagingPool):
             out["staging_pool"] = self.budget.geometry()
+        if self.stats.write_variant_bytes:
+            out["write_path"] = dict(self.stats.write_variant_bytes)
         return out
 
 
@@ -530,6 +545,24 @@ async def execute_write_reqs(
         nonlocal fused_declined
         buf_len = len(buf)
         try:
+            # Zero-pack payloads only reach plugins that can vector-write
+            # them; for the rest, consolidate here — paying exactly the
+            # pack pass the old path always paid, never more. The copy
+            # transiently holds parts + contiguous buffer, so re-price
+            # the reservation for its duration (adjust never blocks —
+            # bounded overshoot now, later admissions wait it out), and
+            # run the full-slab memcpy in the executor like the pack
+            # pass it replaces.
+            if isinstance(buf, BufferList) and not getattr(
+                storage, "supports_multibuffer", False
+            ):
+                await budget.adjust(buf_len)
+                try:
+                    buf = await asyncio.get_running_loop().run_in_executor(
+                        executor, buf.consolidate
+                    )
+                finally:
+                    await budget.adjust(-buf_len)
             # Fused write+checksum (one cache-hot memory pass) when the
             # plugin overrides it; otherwise checksum first (off the I/O
             # slot), then write.
@@ -542,6 +575,10 @@ async def execute_write_reqs(
             if record_checksums and not fused:
                 checksums[req.path] = await checksum_off_slot(buf)
             declined = False
+            # One WriteIO for the whole request: the plugin stamps the
+            # write-path variant that actually served it (vectorized /
+            # direct / fused / buffered) onto this object.
+            write_io = WriteIO(path=req.path, buf=buf)
             async with io_slots:
                 stats.waiting_io -= 1
                 stats.io += 1
@@ -549,7 +586,6 @@ async def execute_write_reqs(
                     # I/O spans are emitted inside the storage plugin's
                     # executor work (fs.py): wrapping the await here would
                     # record suspension time of interleaved tasks, not I/O.
-                    write_io = WriteIO(path=req.path, buf=buf)
                     if fused:
                         entry = await storage.write_with_checksum(write_io)
                         if entry is not None:
@@ -575,9 +611,13 @@ async def execute_write_reqs(
                     stats.waiting_io -= 1
                     stats.io += 1
                     try:
-                        await storage.write(WriteIO(path=req.path, buf=buf))
+                        await storage.write(write_io)
                     finally:
                         stats.io -= 1
+            variant = write_io.variant or "buffered"
+            stats.write_variant_bytes[variant] = (
+                stats.write_variant_bytes.get(variant, 0) + buf_len
+            )
         finally:
             del buf
             await budget.release(buf_len)

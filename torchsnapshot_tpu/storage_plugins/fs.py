@@ -16,9 +16,12 @@ only after all data writes return).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import time
 from typing import Set
+
+logger = logging.getLogger(__name__)
 
 try:
     import aiofiles
@@ -30,21 +33,76 @@ except ImportError:
     # unaffected either way.
     aiofiles = None
 
-from .. import _native
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+import errno
+
+from .. import _native, knobs, telemetry
+from ..io_types import (
+    BufferList,
+    ReadIO,
+    StoragePlugin,
+    WriteIO,
+    as_bytes_view,
+    payload_nbytes,
+)
 from ..telemetry import names as metric_names, observe_io
 from ..telemetry.trace import io_span
 from ..utils.tracing import trace_annotation
 
+# O_DIRECT is for LARGE writes: below this the page-cache copy is noise
+# and the alignment bookkeeping isn't worth a syscall pattern change.
+_DIRECT_IO_MIN_BYTES = 8 * 1024 * 1024
+
+# errnos that mean "this filesystem / this buffer can't take O_DIRECT"
+# (tmpfs fails the open with EINVAL) — a capability signal, not an I/O
+# error: decline sticky-per-plugin back to the buffered path, mirroring
+# the scheduler's fused_declined pattern.
+_DIRECT_DECLINE_ERRNOS = {errno.EINVAL, errno.ENOTSUP, errno.EOPNOTSUPP}
+
 
 class FSStoragePlugin(StoragePlugin):
+    # BufferList payloads are written with one vectorized pwritev kernel
+    # (native) or sequential part writes into one fd (fallback) — never
+    # consolidated into a pack buffer here.
+    supports_multibuffer = True
+
     def __init__(self, root: str) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
         self._native = _native.lib() is not None
+        # Sticky per-plugin decline for the O_DIRECT variant: the first
+        # EINVAL/unsupported-fs error turns it off for this plugin's
+        # lifetime (same root = same filesystem), so later writes never
+        # re-pay a doomed open.
+        self._direct_declined = False
 
     def _full_path(self, path: str) -> str:
         return os.path.join(self.root, path)
+
+    def _direct_eligible(self, buf) -> bool:
+        """Whether this single-buffer write qualifies for O_DIRECT:
+        knob on, native runtime present, no sticky decline, large
+        enough, and 4096-aligned (StagingPool/batcher slabs are
+        allocated aligned; incidental alignment also qualifies)."""
+        if (
+            not self._native
+            or self._direct_declined
+            or not knobs.is_fs_direct_io_enabled()
+            or isinstance(buf, BufferList)
+        ):
+            return False
+        mv = as_bytes_view(buf)
+        return (
+            mv.nbytes >= _DIRECT_IO_MIN_BYTES and _native.is_direct_aligned(mv)
+        )
+
+    def _decline_direct(self, e: OSError, path: str) -> None:
+        self._direct_declined = True
+        logger.info(
+            "O_DIRECT declined for %s (%s); buffered writes for the rest "
+            "of this plugin's lifetime",
+            path,
+            e,
+        )
 
     async def _ensure_parent_dir(self, full_path: str) -> None:
         parent = os.path.dirname(full_path)
@@ -59,7 +117,7 @@ class FSStoragePlugin(StoragePlugin):
             self._dir_cache.add(parent)
 
     async def write(self, write_io: WriteIO) -> None:
-        nbytes = memoryview(write_io.buf).cast("B").nbytes
+        nbytes = payload_nbytes(write_io.buf)
         t0 = time.monotonic()
         with io_span("fs", "write", write_io.path, nbytes):
             await self._write_impl(write_io)
@@ -68,37 +126,115 @@ class FSStoragePlugin(StoragePlugin):
     async def _write_impl(self, write_io: WriteIO) -> None:
         full_path = self._full_path(write_io.path)
         await self._ensure_parent_dir(full_path)
+        buf = write_io.buf
         if self._native:
             loop = asyncio.get_running_loop()
             # buf stays referenced by write_io for the call's duration.
-            # write_file returns False (wrote nothing) if the native lib
-            # became unavailable after construction — fall through then.
-            def _write_native() -> bool:
-                with trace_annotation(
-                    metric_names.SPAN_FS_NATIVE_WRITE, blob=write_io.path
-                ):
-                    return _native.write_file(full_path, write_io.buf)
+            # The native kernels return None/False (wrote nothing) if the
+            # lib became unavailable after construction — fall through.
+            if isinstance(buf, BufferList):
 
-            if await loop.run_in_executor(None, _write_native):
+                def _writev_native() -> bool:
+                    with trace_annotation(
+                        metric_names.SPAN_FS_NATIVE_PWRITEV,
+                        blob=write_io.path,
+                    ):
+                        return (
+                            _native.pwritev_file_crc(full_path, buf.parts)
+                            is not None
+                        )
+
+                if await loop.run_in_executor(None, _writev_native):
+                    write_io.variant = "vectorized"
+                    telemetry.metrics().counter_inc(
+                        metric_names.FS_VECTORIZED_WRITE_BYTES_TOTAL,
+                        buf.nbytes,
+                        plugin="fs",
+                    )
+                    return
+            else:
+                if self._direct_eligible(buf):
+                    try:
+                        if await loop.run_in_executor(
+                            None, self._write_direct_kernel, full_path, write_io
+                        ):
+                            return
+                    except OSError as e:
+                        if e.errno not in _DIRECT_DECLINE_ERRNOS:
+                            raise
+                        self._decline_direct(e, write_io.path)
+
+                def _write_native() -> bool:
+                    with trace_annotation(
+                        metric_names.SPAN_FS_NATIVE_WRITE, blob=write_io.path
+                    ):
+                        return _native.write_file(full_path, buf)
+
+                if await loop.run_in_executor(None, _write_native):
+                    write_io.variant = "buffered"
+                    return
+        if isinstance(buf, BufferList):
+            # Pure-Python zero-pack fallback: sequential part writes into
+            # one fd — still no consolidation pass.
+            write_io.variant = "buffered"
+            if aiofiles is not None:
+                async with aiofiles.open(full_path, "wb") as f:
+                    for part in buf.parts:
+                        await f.write(part)
                 return
+
+            def _writev_blocking() -> None:
+                with open(full_path, "wb") as f:
+                    for part in buf.parts:
+                        f.write(part)
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, _writev_blocking
+            )
+            return
+        write_io.variant = "buffered"
         if aiofiles is not None:
             async with aiofiles.open(full_path, "wb") as f:
-                await f.write(write_io.buf)
+                await f.write(buf)
             return
 
         def _write_blocking() -> None:
             with open(full_path, "wb") as f:
-                f.write(write_io.buf)
+                f.write(buf)
 
         await asyncio.get_running_loop().run_in_executor(
             None, _write_blocking
         )
 
+    def _write_direct_kernel(self, full_path: str, write_io: WriteIO) -> bool:
+        """Executor-thread O_DIRECT write for the plain (no-checksum)
+        path — the CRC pass is skipped outright (``page_size=None`` hands
+        the kernel a NULL page array), so a checksums-off run never pays
+        a per-byte CRC for a result nobody reads. True on success; raises
+        OSError with a decline errno for the caller's sticky fallback."""
+        with trace_annotation(
+            metric_names.SPAN_FS_NATIVE_DIRECT_WRITE, blob=write_io.path
+        ):
+            pages = _native.write_file_crc_direct(full_path, write_io.buf)
+        if pages is None:
+            return False
+        write_io.variant = "direct"
+        telemetry.metrics().counter_inc(
+            metric_names.FS_DIRECT_WRITE_BYTES_TOTAL,
+            payload_nbytes(write_io.buf),
+            plugin="fs",
+        )
+        return True
+
     async def write_with_checksum(self, write_io: WriteIO):
         """Fused write + integrity pass (one cache-hot memory pass, one
         executor hop): returns the checksum-table entry, or None when the
         native runtime is unavailable (the scheduler then runs the
-        two-step compute-then-write path)."""
+        two-step compute-then-write path). Serves all three native
+        variants: vectorized pwritev for BufferList payloads (zero-pack),
+        O_DIRECT for large aligned single buffers (knob-gated, sticky
+        decline on unsupported filesystems), and the plain fused
+        write+CRC otherwise."""
         if not self._native:
             return None
         from ..integrity import PAGE_SIZE, entry_from_page_crcs
@@ -106,24 +242,66 @@ class FSStoragePlugin(StoragePlugin):
         full_path = self._full_path(write_io.path)
         await self._ensure_parent_dir(full_path)
         loop = asyncio.get_running_loop()
+        buf = write_io.buf
+        nbytes = payload_nbytes(buf)
+
+        def _writev_crc():
+            with trace_annotation(
+                metric_names.SPAN_FS_NATIVE_PWRITEV, blob=write_io.path
+            ):
+                pages = _native.pwritev_file_crc(
+                    full_path, buf.parts, page_size=PAGE_SIZE
+                )
+            if pages is None:
+                return None
+            write_io.variant = "vectorized"
+            telemetry.metrics().counter_inc(
+                metric_names.FS_VECTORIZED_WRITE_BYTES_TOTAL,
+                nbytes,
+                plugin="fs",
+            )
+            return entry_from_page_crcs(pages, nbytes)
+
+        def _direct_crc():
+            with trace_annotation(
+                metric_names.SPAN_FS_NATIVE_DIRECT_WRITE, blob=write_io.path
+            ):
+                pages = _native.write_file_crc_direct(
+                    full_path, buf, PAGE_SIZE
+                )
+            if pages is None:
+                return None
+            write_io.variant = "direct"
+            telemetry.metrics().counter_inc(
+                metric_names.FS_DIRECT_WRITE_BYTES_TOTAL, nbytes, plugin="fs"
+            )
+            return entry_from_page_crcs(pages, nbytes)
 
         def _write_crc():
             with trace_annotation(
                 metric_names.SPAN_FS_NATIVE_WRITE, blob=write_io.path
             ):
-                pages = _native.write_file_crc(
-                    full_path, write_io.buf, PAGE_SIZE
-                )
+                pages = _native.write_file_crc(full_path, buf, PAGE_SIZE)
             if pages is None:
                 return None
-            return entry_from_page_crcs(
-                pages, memoryview(write_io.buf).cast("B").nbytes
-            )
+            write_io.variant = "fused"
+            return entry_from_page_crcs(pages, nbytes)
 
-        nbytes = memoryview(write_io.buf).cast("B").nbytes
         t0 = time.monotonic()
         with io_span("fs", "write", write_io.path, nbytes):
-            entry = await loop.run_in_executor(None, _write_crc)
+            entry = None
+            if isinstance(buf, BufferList):
+                entry = await loop.run_in_executor(None, _writev_crc)
+            else:
+                if self._direct_eligible(buf):
+                    try:
+                        entry = await loop.run_in_executor(None, _direct_crc)
+                    except OSError as e:
+                        if e.errno not in _DIRECT_DECLINE_ERRNOS:
+                            raise
+                        self._decline_direct(e, write_io.path)
+                if entry is None:
+                    entry = await loop.run_in_executor(None, _write_crc)
         if entry is not None:
             # A declined fused write wrote nothing; the scheduler's
             # two-step fallback lands in write(), which accounts itself.

@@ -103,6 +103,13 @@ def summarize_report(
         "visible_s": (
             round(report.visible_s, 6) if report.visible_s is not None else None
         ),
+        # Which write-path variant served the take's bytes (vectorized /
+        # direct / fused / buffered): alongside ``tunables``, what lets
+        # doctor --trend correlate a write-path knob flip with the
+        # efficiency move it caused.
+        "write_path": (
+            dict(report.write_path) if report.write_path is not None else None
+        ),
         # The effective tunable-knob values the take ran under: lets a
         # trend regression be correlated with the knob change that
         # caused it (the autotuner's decision log cross-references the

@@ -30,6 +30,13 @@ STORAGE_WRITE_SECONDS = "storage_write_seconds"
 STORAGE_READ_BYTES_TOTAL = "storage_read_bytes_total"
 STORAGE_READ_OPS_TOTAL = "storage_read_ops_total"
 STORAGE_READ_SECONDS = "storage_read_seconds"
+# Zero-pack / direct write-path accounting (storage_plugins/fs.py):
+# bytes that went out through the vectorized pwritev kernel (each one a
+# byte the slab-pack pass did NOT copy) and through O_DIRECT.
+FS_VECTORIZED_WRITE_BYTES_TOTAL = "fs_vectorized_write_bytes_total"
+FS_DIRECT_WRITE_BYTES_TOTAL = "fs_direct_write_bytes_total"
+# batcher.py: slab bytes staged zero-pack — the pack pass they avoided.
+BATCHER_PACK_BYTES_AVOIDED_TOTAL = "batcher_pack_bytes_avoided_total"
 
 # -- retry machinery (storage_plugins/retry.py, gcs.py) ----------------------
 
@@ -137,11 +144,18 @@ SPAN_STORAGE_WRITE = "storage:write"
 SPAN_STORAGE_READ = "storage:read"
 SPAN_FS_NATIVE_WRITE = "storage:fs_native_write"
 SPAN_FS_NATIVE_READ = "storage:fs_native_read"
+# Zero-pack / direct write kernels: the vectorized pwritev+CRC gather
+# write and the O_DIRECT aligned-body write.
+SPAN_FS_NATIVE_PWRITEV = "storage:fs_native_pwritev"
+SPAN_FS_NATIVE_DIRECT_WRITE = "storage:fs_native_direct_write"
 INSTANT_STORAGE_RETRY = "storage:retry"
 INSTANT_GCS_RECOVER = "storage:gcs_recover"
 
-# batcher.py slab staging / spanning-read dispatch
+# batcher.py slab staging / spanning-read dispatch. The vectorized
+# variant is a DISTINCT span: its presence (and stage_slab's absence)
+# is the observable pin that the slab-pack pass did not run.
 SPAN_BATCHER_STAGE_SLAB = "batcher:stage_slab"
+SPAN_BATCHER_STAGE_SLAB_VECTORIZED = "batcher:stage_slab_vectorized"
 SPAN_BATCHER_CONSUME_SPANNING = "batcher:consume_spanning"
 
 # tiered mirror

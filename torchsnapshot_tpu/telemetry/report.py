@@ -117,6 +117,13 @@ class SnapshotReport:
     # copy. The ``peer-tier-degraded`` doctor rule keys off these.
     tier_split: Optional[Dict[str, int]] = None
     peer: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Write pipelines only (None elsewhere): bytes served per write-path
+    # variant (``{"vectorized": b, "direct": b, "fused": b,
+    # "buffered": b}``), as stamped by the storage plugin per write —
+    # which path actually served this take, so a ``doctor --trend``
+    # efficiency move can be correlated with the write-path knob flip
+    # that caused it (the ``tunables`` field below carries the knobs).
+    write_path: Optional[Dict[str, int]] = None
     # The *effective* tunable-knob values the operation ran under
     # (knobs.tunable_snapshot(), captured at op start): env > tuner
     # override > default, already resolved. Recorded whether or not the
@@ -172,6 +179,12 @@ def merge_pipeline_telemetry(
         for key in ("bytes_fetched", "bytes_received", "bytes_needed"):
             if key in p:
                 out[key] = out.get(key, 0) + int(p[key])
+        # Write-path variant split (write pipelines only): per-variant
+        # byte sums fold across pipelines.
+        if p.get("write_path"):
+            wp = out.setdefault("write_path", {})
+            for variant, nbytes in p["write_path"].items():
+                wp[variant] = wp.get(variant, 0) + int(nbytes)
     out["budget_wait_s"] = round(out["budget_wait_s"], 6)
     return out
 
@@ -260,6 +273,11 @@ def build_report(
         tier_split=(
             {k: int(v) for k, v in pipeline["tier_split"].items()}
             if pipeline.get("tier_split")
+            else None
+        ),
+        write_path=(
+            {k: int(v) for k, v in pipeline["write_path"].items()}
+            if pipeline.get("write_path")
             else None
         ),
         peer=dict(pipeline.get("peer") or {}),

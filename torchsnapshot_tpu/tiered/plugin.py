@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, payload_nbytes
 from ..storage_plugin import url_to_storage_plugin
 
 _METADATA_FNAME = ".snapshot_metadata"  # == snapshot.SNAPSHOT_METADATA_FNAME
@@ -61,18 +61,20 @@ class TieredStoragePlugin(StoragePlugin):
 
     # -- writes: fast tier only ------------------------------------------
 
+    @property
+    def supports_multibuffer(self) -> bool:  # type: ignore[override]
+        # Writes land on the fast tier, so its capability decides whether
+        # the scheduler may hand us a zero-pack BufferList payload.
+        return getattr(self.fast, "supports_multibuffer", False)
+
     async def write(self, write_io: WriteIO) -> None:
         await self.fast.write(write_io)
-        self._written[write_io.path] = memoryview(write_io.buf).cast(
-            "B"
-        ).nbytes
+        self._written[write_io.path] = payload_nbytes(write_io.buf)
 
     async def write_with_checksum(self, write_io: WriteIO):
         entry = await self.fast.write_with_checksum(write_io)
         if entry is not None:
-            self._written[write_io.path] = memoryview(write_io.buf).cast(
-                "B"
-            ).nbytes
+            self._written[write_io.path] = payload_nbytes(write_io.buf)
         return entry
 
     # -- reads: fast first, durable per-blob fallback --------------------

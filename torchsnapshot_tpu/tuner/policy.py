@@ -6,10 +6,13 @@ Not ML — a policy table. Each doctor verdict names a *direction*
 - ``budget-starved``: requests sat blocked on the host-memory budget —
   raise the budget fraction, then widen the staging pool.
 - ``write-tail-stall``: one blob's write dominated the op — more I/O
-  streams first, then smaller tail chunks so no single write can hold
-  the drain hostage.
+  streams first, then O_DIRECT (a page-cache writeback storm is the
+  classic single-blob tail), then smaller tail chunks so no single
+  write can hold the drain hostage.
 - ``storage-tier-slow``: the post-staging drain dominates — raise I/O
-  concurrency, then deepen the pool so staging can run further ahead.
+  concurrency, re-enable the zero-pack vectorized write if something
+  turned it off, try O_DIRECT, then deepen the pool so staging can run
+  further ahead.
 - ``retry-storm``: the backend is throwing under load — *back off* the
   I/O concurrency.
 - ``d2h-bound``: staging (D2H) is the wall — that's the physical
@@ -39,10 +42,13 @@ VERDICT_ACTIONS: Dict[str, List[Tuple[str, int]]] = {
     ],
     names.RULE_WRITE_TAIL_STALL: [
         ("io_concurrency", +1),
+        ("fs_direct_io", +1),
         ("max_chunk_size_bytes", -1),
     ],
     names.RULE_STORAGE_TIER_SLOW: [
         ("io_concurrency", +1),
+        ("write_vectorized", +1),
+        ("fs_direct_io", +1),
         ("staging_pool_slabs", +1),
     ],
     names.RULE_RETRY_STORM: [

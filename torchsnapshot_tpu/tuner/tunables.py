@@ -110,6 +110,12 @@ TUNABLES: Dict[str, Tunable] = {
             512 * MIB,
             2.0,
         ),
+        # Binary write-path selectors (0/1): one "up" move enables, one
+        # "down" move disables — the int-move floor of +-1 makes the
+        # multiplicative step degenerate into a clean toggle, and
+        # revert-on-regression gives a flip that hurt its normal undo.
+        Tunable("write_vectorized", knobs._WRITE_VECTORIZED_ENV, 0, 1, 2.0),
+        Tunable("fs_direct_io", knobs._FS_DIRECT_IO_ENV, 0, 1, 2.0),
     )
 }
 
