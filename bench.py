@@ -79,7 +79,11 @@ TS_BENCH_SKIP_PROTOCOL=1 skips the CPU-mesh subprocess legs (the cold
 restore leg still runs — it is part of the restore story).
 TS_BENCH_BUDGET_S overrides the wall-clock budget.
 TS_BENCH_STEADY_TAKES overrides the steady-state autotune leg's take
-count. ``--json-out PATH`` additionally writes the final record to a
+count. TS_BENCH_RETENTION_MIB / TS_BENCH_RETENTION_STEPS size leg 9
+(``retention_curve``): the 2-proc keep-last-N dense-retention loop
+comparing cumulative storage, mirror-shipped and peer-pushed bytes with
+the content-addressed chunk store on vs off (docs/cas.md).
+``--json-out PATH`` additionally writes the final record to a
 file (the stdout tail can be truncated by the driver's capture —
 BENCH_r04/r05 both parsed null for exactly that reason).
 """
@@ -144,6 +148,8 @@ _OVERRIDES = [
         "TS_BENCH_SKIP_PROTOCOL",
         "TS_BENCH_BUDGET_S",
         "TS_BENCH_STEADY_TAKES",
+        "TS_BENCH_RETENTION_MIB",
+        "TS_BENCH_RETENTION_STEPS",
     )
     if os.environ.get(k)
 ]
@@ -543,6 +549,40 @@ def run_subprocess_legs() -> None:
                 f"{pr.get('fallback_recovery_wall_s')} s from storage"
             )
         _emit_partial("peer_restore")
+
+    if _have_budget("retention_curve", 240):
+        # Leg 9 — dense-retention economics (docs/cas.md): a 2-proc
+        # keep_last_n=20 manager loop over a sparsely-updated layered
+        # state on a tiered root with peer pushes and the ledger on,
+        # content-addressed store ON vs the legacy layout. The three
+        # curves (cumulative storage footprint, mirror bytes shipped,
+        # peer bytes pushed) are the acceptance instrument: CAS should
+        # hold storage at ~1 full step + deltas while mirror/peer
+        # traffic shrinks to the novel chunks.
+        rc = _subprocess_json(
+            "retention-curve",
+            ("benchmarks", "retention_curve.py"),
+            ["--mib", os.environ.get("TS_BENCH_RETENTION_MIB", "32"),
+             "--steps", os.environ.get("TS_BENCH_RETENTION_STEPS", "6"),
+             "--json"],
+            timeout=540,
+        )
+        if rc is not None:
+            RESULT["retention_curve"] = rc
+            RESULT["cas_storage_ratio_vs_one_step"] = (
+                rc.get("cas") or {}
+            ).get("storage_ratio_vs_one_step")
+            RESULT["legacy_storage_ratio_vs_one_step"] = (
+                rc.get("legacy") or {}
+            ).get("storage_ratio_vs_one_step")
+            RESULT["cas_storage_savings"] = rc.get("cas_storage_savings")
+            _log(
+                f"bench: retention curve — CAS storage "
+                f"{RESULT['cas_storage_ratio_vs_one_step']}x of one step "
+                f"vs legacy {RESULT['legacy_storage_ratio_vs_one_step']}x "
+                f"({rc.get('cas_storage_savings')}x total savings)"
+            )
+        _emit_partial("retention_curve")
 
 
 def cold_start_rows() -> None:

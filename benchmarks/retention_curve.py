@@ -1,0 +1,202 @@
+"""Leg 9: dense-retention storage/traffic curves, CAS on vs off.
+
+The content-addressed chunk store's acceptance instrument (docs/cas.md):
+a 2-process group runs a ``keep_last_n=20`` manager loop over a
+sparsely-updated state (~5% of the weights change per step) on a tiered
+root with the peer tier pushing and the run ledger on, once with
+``TORCHSNAPSHOT_TPU_CAS=1`` and once with the legacy layout. Records,
+per step, the cumulative storage footprint (both tiers), the mirror
+bytes actually shipped to the durable tier, and the peer-tier bytes
+pushed across the wire — the three curves the ISSUE's ≤1.5×-one-step
+claim is judged on — plus the goodput ledger's storage attribution
+(bytes per retained step, reuse ratio) as the proof instrument of
+record. Spawned by bench.py's subprocess-leg runner; emits one JSON
+line on stdout.
+
+    python benchmarks/retention_curve.py --mib 32 --steps 6 --json
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _du(path: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for name in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                pass
+    return total
+
+
+def _retention_worker(pg, base: str, mib: float, steps: int, cas: bool):
+    import numpy as np
+
+    import torchsnapshot_tpu as ts
+    from torchsnapshot_tpu import telemetry
+    from torchsnapshot_tpu.telemetry import names as tn
+    from torchsnapshot_tpu.tiered import peer
+    from torchsnapshot_tpu.tiered.mirror import get_mirror
+
+    os.environ["TORCHSNAPSHOT_TPU_CAS"] = "1" if cas else "0"
+    os.environ["TORCHSNAPSHOT_TPU_PEER_TIER"] = "1"
+    os.environ["TORCHSNAPSHOT_TPU_LEDGER"] = "1"
+
+    # Many-leaf state (a layered model), ONE leaf touched per step:
+    # the realistic sparse-update shape (embedding slices, unfrozen
+    # towers) whose unchanged leaves are what dense retention should
+    # not re-pay for. Dedup granularity is the write granularity, so a
+    # monolithic array would (correctly) re-store wholesale on any
+    # touch — that is the legacy curve's behavior for everything.
+    layers = 16
+    per = max(1024, int(mib * 1024 * 1024 / 4 / layers))
+    rng = np.random.default_rng(7 + pg.rank)
+    leaves = {
+        f"layer{i:02d}": rng.standard_normal(per).astype(np.float32)
+        for i in range(layers)
+    }
+
+    root = f"tiered://{base}/fast|{base}/dur"
+    mgr = ts.CheckpointManager(root, keep_last_n=20, pg=pg)
+    counters0 = telemetry.metrics().counters_snapshot()
+    storage_curve, mirror_curve, peer_curve, save_s = [], [], [], []
+    for step in range(steps):
+        # Sparse update: one layer (~1/16 of the state) moves per step.
+        leaves[f"layer{step % layers:02d}"] += 1.0
+        t0 = time.perf_counter()
+        mgr.save(
+            step,
+            {"m": ts.PyTreeState(dict(leaves))},
+            record_digests=True,
+        )
+        save_s.append(round(time.perf_counter() - t0, 3))
+        mgr.wait_durable(step, timeout=120)
+        peer.maybe_drain(timeout=60)
+        if pg.rank == 0:
+            storage_curve.append(_du(base))
+            mirror_curve.append(
+                int(get_mirror().metrics()["bytes_mirrored"])
+            )
+        counters = telemetry.metrics().counters_snapshot()
+        peer_curve.append(
+            int(
+                counters.get(tn.PEER_PUSH_BYTES_TOTAL, 0)
+                - counters0.get(tn.PEER_PUSH_BYTES_TOTAL, 0)
+            )
+        )
+    row = {
+        "rank": pg.rank,
+        "save_s": save_s,
+        "peer_bytes_pushed_curve": peer_curve,
+        "peer_bytes_deduped": int(
+            telemetry.metrics()
+            .counters_snapshot()
+            .get(tn.PEER_PUSH_BYTES_DEDUPED_TOTAL, 0)
+        ),
+    }
+    if pg.rank == 0:
+        row["storage_bytes_curve"] = storage_curve
+        row["mirror_bytes_shipped_curve"] = mirror_curve
+        # The goodput ledger's storage attribution — the curves of
+        # record the acceptance criterion cites.
+        try:
+            from torchsnapshot_tpu.telemetry.goodput import analyze
+            from torchsnapshot_tpu.telemetry.ledger import (
+                find_ledger_for,
+                load_ledger,
+            )
+
+            lf = find_ledger_for(f"{base}/fast")
+            if lf:
+                storage = analyze(load_ledger(lf))["storage"]
+                row["goodput_storage"] = {
+                    k: storage.get(k)
+                    for k in (
+                        "retained_steps",
+                        "bytes_per_retained_step",
+                        "incremental_reuse_ratio",
+                        "bytes_reused_total",
+                    )
+                }
+        except Exception as e:  # noqa: BLE001 - context metric only
+            log(f"retention-curve: goodput read failed: {e!r}")
+    return row
+
+
+def _run_mode(mib: float, steps: int, cas: bool):
+    from torchsnapshot_tpu.test_utils import run_multiprocess
+
+    base = tempfile.mkdtemp(prefix="ts-retention-")
+    rows = run_multiprocess(
+        _retention_worker,
+        nproc=2,
+        args=(base, mib, steps, cas),
+        timeout=600,
+    )
+    r0 = next(r for r in rows if r["rank"] == 0)
+    peer_total = sum(
+        r["peer_bytes_pushed_curve"][-1]
+        for r in rows
+        if r["peer_bytes_pushed_curve"]
+    )
+    out = {
+        "storage_bytes_curve": r0["storage_bytes_curve"],
+        "mirror_bytes_shipped_curve": r0["mirror_bytes_shipped_curve"],
+        "peer_bytes_pushed_total": peer_total,
+        "peer_bytes_deduped_total": sum(
+            r["peer_bytes_deduped"] for r in rows
+        ),
+        "save_s": r0["save_s"],
+        "goodput_storage": r0.get("goodput_storage"),
+    }
+    curve = out["storage_bytes_curve"]
+    if curve:
+        out["storage_bytes_final"] = curve[-1]
+        out["storage_bytes_first_step"] = curve[0]
+        out["storage_ratio_vs_one_step"] = round(
+            curve[-1] / max(1, curve[0]), 3
+        )
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mib", type=float, default=32.0)
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+
+    out = {"state_mib_per_rank": args.mib, "steps": args.steps}
+    for cas, key in ((True, "cas"), (False, "legacy")):
+        out[key] = _run_mode(args.mib, args.steps, cas)
+        log(
+            f"retention-curve[{key}]: storage "
+            f"{out[key].get('storage_ratio_vs_one_step')}x of one step, "
+            f"mirror shipped "
+            f"{(out[key]['mirror_bytes_shipped_curve'] or [0])[-1]} B, "
+            f"peer pushed {out[key]['peer_bytes_pushed_total']} B"
+        )
+    cas_final = out["cas"].get("storage_bytes_final")
+    legacy_final = out["legacy"].get("storage_bytes_final")
+    if cas_final and legacy_final:
+        out["cas_storage_savings"] = round(legacy_final / cas_final, 3)
+    if args.json:
+        print(json.dumps(out, separators=(",", ":")), flush=True)
+
+
+if __name__ == "__main__":
+    main()

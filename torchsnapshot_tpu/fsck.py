@@ -372,11 +372,18 @@ def _verify_peer_placement(path: str) -> FsckReport:
                                 f"({doc.get('error')})",
                             )
                         )
+                from .cas import is_chunk_location
+
                 need = blob_requirements(metadata.manifest)
+                # Base-referenced locations belong to another step's
+                # placement — EXCEPT content-addressed chunk refs, which
+                # are this step's payload (pushed or dedup-referenced
+                # into the peer pool) and must have a recorded copy for
+                # a preemption to recover at RAM speed.
                 required = {
                     loc
                     for loc in need
-                    if not loc.startswith("../")
+                    if not loc.startswith("../") or is_chunk_location(loc)
                 }
                 if docs == 0:
                     problems.append(
@@ -409,6 +416,226 @@ def _verify_peer_placement(path: str) -> FsckReport:
             finally:
                 if placement_owned:
                     event_loop.run_until_complete(placement_storage.close())
+        finally:
+            event_loop.run_until_complete(storage.close())
+    finally:
+        event_loop.close()
+
+
+@dataclasses.dataclass
+class CasStoreReport:
+    """Whole-store audit of a manager root's content-addressed chunk
+    store (docs/cas.md): every referenced chunk exists with the byte
+    length its digest key claims (and, with ``deep``, bytes matching
+    the digest itself — the key is self-verifying), no committed
+    manifest reference dangles, and leftover unreferenced chunks are
+    listed (informational: pre-GC orphans of crashed takes, or dead
+    chunks inside the GC grace window — they never fail the audit)."""
+
+    root: str
+    steps: List[int]
+    chunks_present: int
+    stored_bytes: int
+    chunks_referenced: int
+    logical_bytes: int  # retention-visible bytes across all steps
+    problems: List[FsckProblem]
+    unreferenced: Dict[str, int]
+    deep: bool
+    crcs_verified: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    @property
+    def dedup_ratio(self) -> float:
+        """Logical (retention-visible) bytes per stored byte — 1.0 means
+        no sharing; N retained steps of an unchanged state approach N."""
+        return self.logical_bytes / self.stored_bytes if self.stored_bytes else 0.0
+
+    @property
+    def bytes_per_retained_step(self) -> float:
+        return self.stored_bytes / len(self.steps) if self.steps else 0.0
+
+
+def _present_chunks(root: str) -> Dict[str, Dict[str, int]]:
+    """Chunk files across every locally-listable tier of the root (fast
+    AND durable for all-fs tiered roots): ``key -> {tier dir: size}``.
+    Per-copy sizes are kept so the audit can flag a torn copy in ONE
+    tier even when another tier holds the full bytes (collapsing with
+    ``max`` would pass a root whose durable tier is unrestorable)."""
+    import os as _os
+
+    from .cas import CHUNKS_DIRNAME, is_chunk_key
+
+    urls = [root]
+    tiers = split_tiered_url(root)
+    if tiers is not None:
+        urls = list(tiers)
+    present: Dict[str, Dict[str, int]] = {}
+    from .telemetry.sink import local_fs_root
+
+    for url in urls:
+        local = local_fs_root(url)
+        if local is None:
+            continue
+        chunk_dir = _os.path.join(local, CHUNKS_DIRNAME)
+        try:
+            names = _os.listdir(chunk_dir)
+        except OSError:
+            continue
+        for name in names:
+            if not is_chunk_key(name):
+                continue
+            try:
+                size = _os.path.getsize(_os.path.join(chunk_dir, name))
+            except OSError:
+                continue
+            present.setdefault(name, {})[chunk_dir] = size
+    return present
+
+
+def verify_cas_store(root: str, deep: bool = False) -> CasStoreReport:
+    """Audit one manager root's chunk store against its committed
+    steps' manifests. Never raises for store damage — every problem
+    lands in the report."""
+    from . import manager as manager_mod
+    from .cas import CHUNKS_DIRNAME, chunk_refs, nbytes_of_key, parse_key
+
+    problems: List[FsckProblem] = []
+    steps: List[int] = []
+    referenced: Dict[str, int] = {}
+    logical_bytes = 0
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin(root)
+        try:
+            # Committed + pinned steps from the manager index (the
+            # source of truth for what must be restorable).
+            try:
+                index = event_loop.run_until_complete(
+                    manager_mod.read_index_full_async(storage)
+                )
+                steps = sorted(set(index["steps"]) | set(index["pinned"]))
+            except Exception as e:  # noqa: BLE001 - index damage is a finding
+                problems.append(
+                    FsckProblem(manager_mod.INDEX_BLOB, "unreadable", repr(e))
+                )
+
+            for step in steps:
+                meta_path = (
+                    f"{manager_mod._step_dirname(step)}/"
+                    f"{SNAPSHOT_METADATA_FNAME}"
+                )
+                read_io = ReadIO(path=meta_path)
+                try:
+                    event_loop.run_until_complete(storage.read(read_io))
+                    metadata = SnapshotMetadata.from_yaml(
+                        bytes(read_io.buf).decode("utf-8")
+                    )
+                except FileNotFoundError:
+                    problems.append(
+                        FsckProblem(
+                            meta_path,
+                            "missing",
+                            "indexed step has no commit marker",
+                        )
+                    )
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    problems.append(
+                        FsckProblem(meta_path, "unreadable", repr(e))
+                    )
+                    continue
+                refs = chunk_refs(metadata.manifest)
+                logical_bytes += sum(refs.values())
+                for key, nbytes in refs.items():
+                    referenced[key] = max(referenced.get(key, 0), nbytes)
+
+            present = _present_chunks(root)
+            for key in sorted(set(referenced) - set(present)):
+                problems.append(
+                    FsckProblem(
+                        f"{CHUNKS_DIRNAME}/{key}",
+                        "missing",
+                        "chunk referenced by a committed manifest is "
+                        "absent from the store (dangling ref)",
+                    )
+                )
+            crcs_verified = 0
+            slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
+            checks: List[Tuple[str, int]] = []
+            for key in sorted(set(referenced) & set(present)):
+                want = nbytes_of_key(key)
+                torn = False
+                if want is not None:
+                    # Every tier's copy must match the key's embedded
+                    # length — a torn copy on one tier is a finding even
+                    # when another tier holds the full bytes (restore
+                    # from the damaged tier alone would fail).
+                    for tier_dir, size in sorted(present[key].items()):
+                        if size != want:
+                            torn = True
+                            problems.append(
+                                FsckProblem(
+                                    f"{CHUNKS_DIRNAME}/{key}",
+                                    "truncated",
+                                    f"digest key claims {want} bytes, "
+                                    f"{size} stored in {tier_dir} "
+                                    f"(torn chunk write)",
+                                )
+                            )
+                if torn:
+                    continue
+                checks.append((key, want if want is not None else 0))
+
+            if deep and checks:
+
+                async def _deep_one(key: str, nbytes: int) -> bool:
+                    # The key IS the expected entry: self-verifying.
+                    parsed = parse_key(key)
+                    if parsed is None:
+                        return False
+                    alg, want_n, want_crc = parsed
+                    location = f"{CHUNKS_DIRNAME}/{key}"
+                    _, ok = await _check_blob(
+                        storage,
+                        location,
+                        nbytes,
+                        True,
+                        {location: (alg, want_crc, want_n)},
+                        problems,
+                        slots,
+                    )
+                    return ok
+
+                async def _run_deep() -> List[bool]:
+                    return await asyncio.gather(
+                        *(_deep_one(k, n) for k, n in checks)
+                    )
+
+                results = event_loop.run_until_complete(_run_deep())
+                crcs_verified = sum(1 for ok in results if ok)
+
+            unreferenced = {
+                k: max(copies.values())
+                for k, copies in sorted(present.items())
+                if k not in referenced
+            }
+            return CasStoreReport(
+                root=root,
+                steps=steps,
+                chunks_present=len(present),
+                stored_bytes=sum(
+                    max(copies.values()) for copies in present.values()
+                ),
+                chunks_referenced=len(referenced),
+                logical_bytes=logical_bytes,
+                problems=problems,
+                unreferenced=unreferenced,
+                deep=deep,
+                crcs_verified=crcs_verified,
+            )
         finally:
             event_loop.run_until_complete(storage.close())
     finally:
@@ -533,6 +760,45 @@ def verify_snapshot(
         event_loop.close()
 
 
+def _cas_main(root: str, deep: bool) -> int:
+    report = verify_cas_store(root, deep=deep)
+    for prob in report.problems:
+        print(f"FSCK {prob.kind}: {prob.location}: {prob.detail}")
+    mode = "deep" if report.deep else "shallow"
+    print(
+        f"chunk store: {report.chunks_present} chunk(s), "
+        f"{report.stored_bytes / 1e6:.1f} MB stored across "
+        f"{len(report.steps)} retained step(s)"
+    )
+    print(
+        f"  dedup ratio: {report.dedup_ratio:.2f}x "
+        f"({report.logical_bytes / 1e6:.1f} MB retention-visible per "
+        f"{report.stored_bytes / 1e6:.1f} MB stored); "
+        f"{report.bytes_per_retained_step / 1e6:.2f} MB per retained step"
+    )
+    if report.unreferenced:
+        waste = sum(report.unreferenced.values())
+        print(
+            f"  {len(report.unreferenced)} unreferenced chunk(s) "
+            f"({waste / 1e6:.1f} MB): pre-GC orphans of crashed takes or "
+            f"dead chunks inside the GC grace window — reclaimed by the "
+            f"manager's next retention pass"
+        )
+    if report.deep:
+        print(f"  {report.crcs_verified} chunk(s) CRC-verified")
+    if report.ok:
+        print(
+            f"OK ({mode}): {report.chunks_referenced} referenced "
+            f"chunk(s) checked"
+        )
+        return 0
+    print(
+        f"FAILED ({mode}): {len(report.problems)} problem(s) across "
+        f"{report.chunks_referenced} referenced chunk(s)"
+    )
+    return 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -562,7 +828,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "events (.telemetry.jsonl written by the JSONL sink; see "
         "docs/observability.md)",
     )
+    p.add_argument(
+        "--cas",
+        action="store_true",
+        help="treat PATH as a manager ROOT and audit its content-"
+        "addressed chunk store (docs/cas.md): every committed "
+        "manifest's chunk refs resolve, every referenced chunk has "
+        "the byte length its digest key claims (--deep additionally "
+        "verifies the bytes against the digest), unreferenced "
+        "leftovers are listed, and the dedup ratio / bytes per "
+        "retained step are reported",
+    )
     args = p.parse_args(argv)
+    if args.cas:
+        return _cas_main(args.path, deep=args.deep)
     report = verify_snapshot(args.path, deep=args.deep, tier=args.tier)
     if args.stats:
         # One artifact sweep: the same Evidence bundle drives the

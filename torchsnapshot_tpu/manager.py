@@ -50,6 +50,7 @@ from .manifest import (
     Manifest,
     ShardedArrayEntry,
     SnapshotMetadata,
+    entry_locations,
 )
 from .pg_wrapper import PGWrapper
 from .snapshot import SNAPSHOT_METADATA_FNAME, PendingSnapshot, Snapshot
@@ -82,15 +83,130 @@ def referenced_steps(manifest: Manifest) -> Set[int]:
     return out
 
 
-def _entry_locations(entry: Entry) -> List[str]:
-    """Every storage location a manifest entry's bytes live at (batched
-    entries share slab locations; callers dedupe)."""
-    if isinstance(entry, ShardedArrayEntry):
-        return [shard.array.location for shard in entry.shards]
-    if isinstance(entry, ChunkedArrayEntry):
-        return [chunk.array.location for chunk in entry.chunks]
-    location = getattr(entry, "location", None)
-    return [location] if location else []
+# Re-exported for existing importers; the implementation moved to
+# manifest.entry_locations (the CAS refcount derivation needs it below
+# the manager layer).
+_entry_locations = entry_locations
+
+
+def _manifest_chunk_refs(manifest: Manifest) -> Dict[str, int]:
+    """The content-addressed chunks a manifest references (digest key ->
+    nbytes); empty for legacy-layout snapshots."""
+    from .cas import chunk_refs
+
+    return chunk_refs(manifest)
+
+
+def _manifest_digest_map(manifest: Manifest) -> Dict[Any, Any]:
+    """Every on-device digest a manifest records, keyed by structural
+    position ``(manifest path, offsets, sizes)`` with the covered byte
+    count — comparing two consecutive steps' maps measures how much of
+    the state the digests say was unchanged (ledger evidence for the
+    dedup-ineffective doctor rule). Empty for digest-less takes."""
+    from .serialization import array_size_bytes
+
+    out: Dict[Any, Any] = {}
+    for path, entry in manifest.items():
+        if isinstance(entry, (ShardedArrayEntry, ChunkedArrayEntry)):
+            pieces = (
+                entry.shards
+                if isinstance(entry, ShardedArrayEntry)
+                else entry.chunks
+            )
+            for piece in pieces:
+                if piece.array.digest:
+                    out[(path, tuple(piece.offsets), tuple(piece.sizes))] = (
+                        piece.array.digest,
+                        array_size_bytes(
+                            piece.array.shape, piece.array.dtype
+                        ),
+                    )
+        else:
+            digest = getattr(entry, "digest", None)
+            if digest:
+                out[(path, (), ())] = (
+                    digest,
+                    array_size_bytes(entry.shape, entry.dtype),
+                )
+    return out
+
+
+async def read_index_full_async(storage: StoragePlugin) -> Dict[str, Any]:
+    """Primary slot, falling back to the backup slot: the index is
+    rewritten on every save (backup slot first), so a crash mid-write
+    must not brick the manager — whichever slot survives is valid,
+    at worst one save stale. Returns ``{"steps": [...], "refs":
+    {step: [origin steps]}, "pinned": [...]}``; the latter two default
+    empty for pre-incremental indexes. Module-level so read-only
+    consumers (``fsck --cas``) share the exact recovery semantics."""
+    io_failed: List[str] = []
+    corrupt: List[str] = []
+    absent: List[str] = []
+    for slot in (INDEX_BLOB, INDEX_BACKUP_BLOB):
+        read_io = ReadIO(path=slot)
+        try:
+            await storage.read(read_io)
+        except FileNotFoundError:
+            absent.append(slot)
+            continue
+        except Exception as e:  # noqa: BLE001
+            logger.warning("Could not read index slot %s: %r", slot, e)
+            io_failed.append(slot)
+            continue
+        if read_io.buf is None:
+            absent.append(slot)
+            continue
+        try:
+            raw = json.loads(bytes(read_io.buf))
+            return {
+                "steps": sorted(int(s) for s in raw["steps"]),
+                "refs": {
+                    str(int(k)): sorted(int(v) for v in vs)
+                    for k, vs in raw.get("refs", {}).items()
+                },
+                "pinned": sorted(int(p) for p in raw.get("pinned", [])),
+                "metrics": {
+                    str(int(k)): float(v)
+                    for k, v in raw.get("metrics", {}).items()
+                },
+                "evicted": sorted(
+                    int(s) for s in raw.get("evicted", [])
+                ),
+                # Pre-marker indexes with committed steps may predate
+                # incremental-ref recording entirely: a missing refs
+                # entry there means "unknown" and GC must verify before
+                # deleting. A fresh (empty) index is trivially complete.
+                "refs_complete": bool(
+                    raw.get("refs_complete", not raw["steps"])
+                ),
+            }
+        except (ValueError, KeyError, TypeError) as e:
+            logger.warning(
+                "Index slot %s is corrupt (%r); trying %s",
+                slot,
+                e,
+                INDEX_BACKUP_BLOB,
+            )
+            corrupt.append(slot)
+    # "Slots absent" (fresh directory) yields []. One corrupt slot with
+    # the OTHER slot absent is the same thing: writes go backup-then-
+    # primary (_write_index_async), so that state can only be a torn
+    # FIRST-ever index write — no step list was ever readable; self-
+    # recover.  Everything else ("slots unreadable": transient I/O
+    # errors, or BOTH slots corrupt) must NOT be treated as empty — a
+    # subsequent index rewrite would silently orphan every previously
+    # committed step.  Fail the operation loudly instead; a transient
+    # storage error heals on retry.
+    if io_failed or len(corrupt) > 1:
+        raise RuntimeError(
+            "checkpoint index unreadable "
+            f"(io_failed={io_failed!r}, corrupt={corrupt!r}); "
+            "refusing to treat the step list as empty"
+        )
+    return {
+        "steps": [], "refs": {}, "pinned": [], "metrics": {},
+        "evicted": [], "refs_complete": True,
+    }
 
 
 class _PendingManagedSnapshot:
@@ -140,6 +256,9 @@ class _PendingManagedSnapshot:
                 self._step,
                 refs=lambda: referenced_steps(snapshot.metadata.manifest),
                 metric=self._metric,
+                chunk_refs=lambda: _manifest_chunk_refs(
+                    snapshot.metadata.manifest
+                ),
             )
             telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
             self._manager._record_step_history(self._step)
@@ -261,6 +380,24 @@ class CheckpointManager:
             )
         except Exception as e:  # noqa: BLE001 - peer tier is best-effort
             logger.warning("peer tier: configure failed: %r", e)
+        # Content-addressed chunk store (docs/cas.md): lazily-resolved
+        # rank-0 handle over the root's ``chunks/`` refcount journal.
+        # False = unresolved; None = root has no local tier (no CAS).
+        # Resolution is evidence-driven, not knob-driven: a root holding
+        # CAS steps from an earlier run keeps refcounted GC even with
+        # the knob now off.
+        self._cas_store: Any = False
+        # Exact per-step storage accounting computed at commit time
+        # (chunks newly materialized vs. reused), read back by
+        # _post_step_ledger; and the previous committed manifest's
+        # digest map, for the ledger's bytes_digest_unchanged signal.
+        self._last_cas_accounting: Optional[Dict[str, Any]] = None
+        self._prev_digest_map: Dict[str, Any] = {}
+        if self._pg.get_rank() == 0:
+            try:
+                self._reconcile_cas()
+            except Exception as e:  # noqa: BLE001 - healing is best-effort
+                logger.warning("CAS refcount reconcile failed: %r", e)
         # Lazily-constructed write-path autotuner (tuner/autotuner.py);
         # stays None while TORCHSNAPSHOT_TPU_AUTOTUNE=0 — the kill
         # switch means no tuner object, no state file, no broadcast.
@@ -333,6 +470,9 @@ class CheckpointManager:
             step,
             refs=lambda: referenced_steps(snapshot.metadata.manifest),
             metric=metric,
+            chunk_refs=lambda: _manifest_chunk_refs(
+                snapshot.metadata.manifest
+            ),
         )
         telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         self._record_step_history(step)
@@ -428,6 +568,38 @@ class CheckpointManager:
                 "bytes_total": int(bytes_new + bytes_reused),
                 "blobs": len(need),
             }
+            # CAS steps: every data location is a ``../chunks/`` ref, so
+            # the prefix split above cannot see new vs. reused — replace
+            # it with the EXACT per-chunk accounting the commit's
+            # refcount pin computed (chunks already pinned = reused).
+            acct = self._last_cas_accounting
+            if acct is not None and acct.get("step") == step:
+                fields.update(
+                    cas=True,
+                    bytes_new=acct["bytes_new"],
+                    bytes_reused=acct["bytes_reused"],
+                    bytes_total=acct["bytes_total"],
+                    chunks_new=acct["chunks_new"],
+                    chunks_reused=acct["chunks_reused"],
+                )
+            # How much of the state the on-device digests say was
+            # UNCHANGED since the previous committed step — the
+            # ``dedup-ineffective`` doctor rule compares this against
+            # the realized reuse ratio (unchanged bytes that were
+            # nevertheless re-stored mean the dedup path is broken).
+            cur_digests = _manifest_digest_map(snapshot.metadata.manifest)
+            if cur_digests:
+                prev = self._prev_digest_map
+                unchanged = sum(
+                    n
+                    for k, (d, n) in cur_digests.items()
+                    if prev.get(k, (None, 0))[0] == d
+                )
+                fields["bytes_digest_unchanged"] = int(unchanged)
+                fields["bytes_digest_covered"] = int(
+                    sum(n for _, n in cur_digests.values())
+                )
+            self._prev_digest_map = cur_digests
             report = last_report(
                 "take", "async_take", path=self.step_path(step)
             )
@@ -590,19 +762,22 @@ class CheckpointManager:
         step: int,
         refs: Optional[Any] = None,
         metric: Optional[float] = None,
+        chunk_refs: Optional[Any] = None,
     ) -> None:
-        """``refs`` may be a set or a zero-arg callable returning one.
-        Pass a callable when computing refs requires the snapshot
-        manifest: it is evaluated only on rank 0, after the early
+        """``refs``/``chunk_refs`` may be values or zero-arg callables.
+        Pass callables when computing them requires the snapshot
+        manifest: they are evaluated only on rank 0, after the early
         return — non-leader ranks hold no in-memory metadata and must
         not pull the global manifest from storage just to drop it."""
         if self._pg.get_rank() != 0:
             return
         if callable(refs):
             refs = refs()
+        if callable(chunk_refs):
+            chunk_refs = chunk_refs()
         self._with_root_storage(
             lambda storage: self._commit_step_async(
-                step, storage, refs or set(), metric
+                step, storage, refs or set(), metric, chunk_refs or {}
             )
         )
 
@@ -655,6 +830,7 @@ class CheckpointManager:
         storage: StoragePlugin,
         refs: Set[int],
         metric: Optional[float] = None,
+        chunk_refs: Optional[Dict[str, int]] = None,
     ) -> None:
         index = await self._read_index_full_async(storage)
         steps = [s for s in index["steps"] if s != step]
@@ -665,6 +841,15 @@ class CheckpointManager:
             refs_map[str(step)] = sorted(refs)
         else:
             refs_map.pop(str(step), None)
+        # CAS refcounts: pin the step's chunks BEFORE the index write —
+        # a crash between the two leaves a pinned-but-uncommitted step
+        # (garbage retained until reconcile), never an indexed step
+        # whose chunks a racing GC could reclaim. The pin also yields
+        # the step's exact storage accounting (chunks already live =
+        # reused bytes) for the run ledger.
+        self._last_cas_accounting = self._cas_pin_step(
+            step, chunk_refs or {}
+        )
         metrics: Dict[str, float] = dict(index["metrics"])
         if metric is not None:
             metrics[str(step)] = float(metric)
@@ -677,6 +862,22 @@ class CheckpointManager:
         retained = self._retained(steps, step, metrics)
         dropped = [s for s in steps if s not in retained]
         steps = retained
+
+        # Explicit retention check (the orphaned-base guard): in an
+        # index NOT marked ``refs_complete`` — written before
+        # incremental refs existed — a retained step's missing refs
+        # entry means "unknown", not "none": presuming it ref-free
+        # while GC deletes bases would leave its ``../step_*``
+        # locations dangling, with fsck the only thing that would ever
+        # notice. Re-derive refs from each such step's own manifest,
+        # exactly once: every index this version writes carries the
+        # marker, under which absence soundly means verified-empty.
+        if not index["refs_complete"]:
+            for s in steps:
+                if str(s) not in refs_map:
+                    derived = await self._derive_refs_async(storage, s)
+                    if derived:
+                        refs_map[str(s)] = sorted(derived)
 
         # Pin-or-delete: a dropped (or previously pinned) step whose blobs
         # a *retained* step's manifest still references must keep its
@@ -740,6 +941,254 @@ class CheckpointManager:
                 await self._delete_step_async(old)
             except Exception as e:  # noqa: BLE001 - GC must not fail a save
                 logger.warning("Failed to GC step %d: %r", old, e)
+        # Chunk-store GC: unpin the deleted steps and reclaim chunks no
+        # pinned step references (grace-window + orphan deferral inside).
+        # Runs AFTER the step deletes so an interrupted pass errs toward
+        # retaining chunks, never toward dangling refs. Runs on EVERY
+        # commit, not only ones that dropped steps — grace-deferred
+        # orphans and crashed takes' strays must age out even in runs
+        # whose retention never deletes anything (keep-everything, or
+        # still inside the first keep_last_n saves).
+        try:
+            await self._cas_collect_async(storage, step, to_delete)
+        except Exception as e:  # noqa: BLE001 - GC must not fail a save
+            logger.warning("CAS chunk GC failed: %r", e)
+
+    async def _derive_refs_async(
+        self, storage: StoragePlugin, step: int
+    ) -> Set[int]:
+        """Re-derive a step's origin-step refs from its committed
+        manifest (the explicit retention check for refs-less index
+        entries). An unreadable manifest conservatively pins nothing
+        AND nothing referencing it is deleted this pass — the read
+        error propagates to the caller's warning path."""
+        read_io = ReadIO(
+            path=f"{_step_dirname(step)}/{SNAPSHOT_METADATA_FNAME}"
+        )
+        try:
+            await storage.read(read_io)
+        except FileNotFoundError:
+            return set()
+        metadata = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
+        return referenced_steps(metadata.manifest)
+
+    # ------------------------------------------------------------------
+    # content-addressed chunk store (docs/cas.md; rank 0 only)
+    # ------------------------------------------------------------------
+
+    def _get_cas_store(self):
+        """The root's chunk store handle, or None for roots without a
+        local filesystem tier. Resolved once; cheap for legacy roots
+        (the journal load of a nonexistent file is one failed open)."""
+        if self._cas_store is not False:
+            return self._cas_store
+        from .cas import CASStore, local_chunks_dir
+
+        if local_chunks_dir(self.root) is None:
+            self._cas_store = None
+        else:
+            self._cas_store = CASStore(self.root)
+        return self._cas_store
+
+    def _cas_pin_step(
+        self, step: int, chunk_refs: Dict[str, int]
+    ) -> Optional[Dict[str, Any]]:
+        """Pin a committing step's chunks in the refcount journal and
+        return its exact storage accounting (bytes newly materialized
+        vs. reused from already-pinned chunks). None for legacy steps
+        (no chunk refs) — the journal is never created for them."""
+        store = self._get_cas_store()
+        if store is None or not chunk_refs:
+            return None
+        pins, orphans = store.load()
+        pinned_before: Set[str] = set()
+        for s, chunks in pins.items():
+            if s != step:
+                pinned_before.update(chunks)
+        reused = {
+            k: n for k, n in chunk_refs.items() if k in pinned_before
+        }
+        new = {
+            k: n for k, n in chunk_refs.items() if k not in pinned_before
+        }
+        store.pin(step, chunk_refs)
+        # Chunks resurrected from the orphan (grace-deferred) list are
+        # live again: drop them from it so GC stops considering them.
+        revived = set(chunk_refs) & set(orphans)
+        if revived:
+            store.clear_orphans(revived)
+        return {
+            "step": step,
+            "chunks_new": len(new),
+            "chunks_reused": len(reused),
+            "bytes_new": int(sum(new.values())),
+            "bytes_reused": int(sum(reused.values())),
+            "bytes_total": int(sum(chunk_refs.values())),
+        }
+
+    async def _cas_collect_async(
+        self,
+        storage: StoragePlugin,
+        trigger_step: int,
+        deleted_steps: List[int],
+    ) -> None:
+        """Unpin GC'd steps and reclaim refcount-dead chunks. A dead
+        chunk younger than the grace window is deferred as a journaled
+        orphan (a concurrent not-yet-pinned take may have just deduped
+        against it — its touch keeps the mtime fresh) and retried on a
+        later pass. Reclaimed bytes are posted to the run ledger so the
+        goodput storage curve tracks what retention actually keeps."""
+        from .cas import CHUNKS_DIRNAME
+
+        store = self._get_cas_store()
+        if store is None:
+            return
+        pins, orphans = store.load()
+        candidates: Dict[str, int] = dict(orphans)
+        unpinned = False
+        for old in deleted_steps:
+            chunks = pins.pop(old, None)
+            if chunks is not None:
+                store.unpin(old)
+                unpinned = True
+                candidates.update(chunks)
+        live = store.live_chunks(pins)
+        # Stray sweep: on-disk chunks in NO pin and NO orphan record —
+        # a take that crashed before its commit pinned them, or pins
+        # reconcile dropped. Without this they would never become GC
+        # candidates (candidates are otherwise journal-derived only)
+        # and leak forever. Folding them into this pass is safe for a
+        # concurrent in-flight take: its fresh chunks defer through the
+        # grace window below, and its commit's pin revives them from
+        # the orphan list.
+        for key, nbytes in store.list_chunks().items():
+            if key not in live and key not in candidates:
+                candidates[key] = nbytes
+        if not candidates:
+            if unpinned:
+                store.maybe_compact()
+            return
+        grace = knobs.get_cas_gc_grace_seconds()
+        reclaimed: Dict[str, int] = {}
+        cleared: Set[str] = set()
+        deferred: Dict[str, int] = {}
+        for key, nbytes in candidates.items():
+            if key in live:
+                cleared.add(key)  # re-pinned since it was orphaned
+                continue
+            age = store.chunk_age_seconds(key)
+            if age is None:
+                cleared.add(key)  # already gone (fsck/manual cleanup)
+                continue
+            if grace > 0 and age < grace:
+                deferred[key] = nbytes
+                continue
+            try:
+                await storage.delete(f"{CHUNKS_DIRNAME}/{key}")
+            except FileNotFoundError:
+                pass
+            reclaimed[key] = nbytes
+        store.clear_orphans((cleared | set(reclaimed)) & set(orphans))
+        store.record_orphans(
+            {k: n for k, n in deferred.items() if k not in orphans}
+        )
+        store.maybe_compact()
+        if reclaimed:
+            registry = telemetry.metrics()
+            registry.counter_inc(
+                metric_names.CAS_CHUNKS_RECLAIMED_TOTAL, len(reclaimed)
+            )
+            registry.counter_inc(
+                metric_names.CAS_BYTES_RECLAIMED_TOTAL,
+                sum(reclaimed.values()),
+            )
+            if knobs.is_ledger_enabled():
+                try:
+                    from .telemetry import ledger as run_ledger
+                    from .telemetry import names as event_names
+
+                    run_ledger.post_event(
+                        self.root,
+                        event_names.EVENT_GC_RECLAIMED,
+                        step=trigger_step,
+                        bytes_reclaimed=int(sum(reclaimed.values())),
+                        blobs=len(reclaimed),
+                        chunks=True,
+                    )
+                except Exception as e:  # noqa: BLE001 - best-effort
+                    logger.warning(
+                        "could not post chunk GC to the run ledger: %r", e
+                    )
+        if deferred:
+            logger.info(
+                "CAS GC deferred %d dead-but-fresh chunk(s) inside the "
+                "%.0fs grace window (a concurrent take may hold them); "
+                "a later pass reclaims them",
+                len(deferred),
+                grace,
+            )
+
+    def _reconcile_cas(self) -> None:
+        """Construction-time healing (rank 0): bring the refcount
+        journal in line with the index + manifests. Covers a crash that
+        lost or tore the journal after steps committed (chunks written,
+        refcount append missing — wholesale OR one step's pin lost
+        while other pins survived), and stale pins of steps that left
+        the index. No-op — zero manifest reads — when the root has no
+        chunk store, the store is empty, or every indexed step's pin
+        state already matches the journal."""
+        import os as _os
+
+        store = self._get_cas_store()
+        if store is None or not _os.path.isdir(store.local_dir):
+            return
+        from .cas import chunk_refs as _chunk_refs
+
+        pins, _ = store.load()
+        if not pins and not store.list_chunks():
+            return  # empty store: nothing pinned, nothing on disk
+        index = self._with_root_storage(self._read_index_full_async)
+        expected = set(index["steps"]) | set(index["pinned"])
+        stale_pins = set(pins) - expected
+        # Indexed steps with NO pin record: a legacy-layout step (no
+        # chunk refs — absence from the journal IS canonical) or a
+        # committed CAS step whose pin append was lost or torn while
+        # OTHER pins survived (partial journal damage). Only the
+        # manifest can tell them apart, and guessing wrong would let
+        # the stray sweep reclaim a committed step's chunks — so read
+        # exactly these manifests and re-derive. Steps whose pin record
+        # survived are trusted as-is (the pin was derived from the same
+        # manifest at commit time).
+        missing_pins = expected - set(pins)
+        if not stale_pins and not missing_pins:
+            return
+
+        async def _refs_of_missing(storage: StoragePlugin):
+            mapping: Dict[int, Dict[str, int]] = {}
+            for s in sorted(missing_pins):
+                read_io = ReadIO(
+                    path=f"{_step_dirname(s)}/{SNAPSHOT_METADATA_FNAME}"
+                )
+                try:
+                    await storage.read(read_io)
+                except FileNotFoundError:
+                    mapping[s] = {}
+                    continue
+                metadata = SnapshotMetadata.from_yaml(
+                    bytes(read_io.buf).decode()
+                )
+                mapping[s] = _chunk_refs(metadata.manifest)
+            return mapping
+
+        mapping = self._with_root_storage(_refs_of_missing)
+        for s in expected & set(pins):
+            mapping[s] = pins[s]
+        if store.reconcile(mapping):
+            logger.info(
+                "CAS refcount journal reconciled against the index "
+                "(%d committed/pinned steps)",
+                len(expected),
+            )
 
     async def _read_index_async(self, storage: StoragePlugin) -> List[int]:
         return (await self._read_index_full_async(storage))["steps"]
@@ -747,73 +1196,7 @@ class CheckpointManager:
     async def _read_index_full_async(
         self, storage: StoragePlugin
     ) -> Dict[str, Any]:
-        """Primary slot, falling back to the backup slot: the index is
-        rewritten on every save (backup slot first), so a crash mid-write
-        must not brick the manager — whichever slot survives is valid,
-        at worst one save stale. Returns ``{"steps": [...], "refs":
-        {step: [origin steps]}, "pinned": [...]}``; the latter two default
-        empty for pre-incremental indexes."""
-        io_failed: List[str] = []
-        corrupt: List[str] = []
-        absent: List[str] = []
-        for slot in (INDEX_BLOB, INDEX_BACKUP_BLOB):
-            read_io = ReadIO(path=slot)
-            try:
-                await storage.read(read_io)
-            except FileNotFoundError:
-                absent.append(slot)
-                continue
-            except Exception as e:  # noqa: BLE001
-                logger.warning("Could not read index slot %s: %r", slot, e)
-                io_failed.append(slot)
-                continue
-            if read_io.buf is None:
-                absent.append(slot)
-                continue
-            try:
-                raw = json.loads(bytes(read_io.buf))
-                return {
-                    "steps": sorted(int(s) for s in raw["steps"]),
-                    "refs": {
-                        str(int(k)): sorted(int(v) for v in vs)
-                        for k, vs in raw.get("refs", {}).items()
-                    },
-                    "pinned": sorted(int(p) for p in raw.get("pinned", [])),
-                    "metrics": {
-                        str(int(k)): float(v)
-                        for k, v in raw.get("metrics", {}).items()
-                    },
-                    "evicted": sorted(
-                        int(s) for s in raw.get("evicted", [])
-                    ),
-                }
-            except (ValueError, KeyError, TypeError) as e:
-                logger.warning(
-                    "Index slot %s is corrupt (%r); trying %s",
-                    slot,
-                    e,
-                    INDEX_BACKUP_BLOB,
-                )
-                corrupt.append(slot)
-        # "Slots absent" (fresh directory) yields []. One corrupt slot with
-        # the OTHER slot absent is the same thing: writes go backup-then-
-        # primary (_write_index_async), so that state can only be a torn
-        # FIRST-ever index write — no step list was ever readable; self-
-        # recover.  Everything else ("slots unreadable": transient I/O
-        # errors, or BOTH slots corrupt) must NOT be treated as empty — a
-        # subsequent index rewrite would silently orphan every previously
-        # committed step.  Fail the operation loudly instead; a transient
-        # storage error heals on retry.
-        if io_failed or len(corrupt) > 1:
-            raise RuntimeError(
-                "checkpoint index unreadable "
-                f"(io_failed={io_failed!r}, corrupt={corrupt!r}); "
-                "refusing to treat the step list as empty"
-            )
-        return {
-            "steps": [], "refs": {}, "pinned": [], "metrics": {},
-            "evicted": [],
-        }
+        return await read_index_full_async(storage)
 
     async def _write_index_async(
         self,
@@ -825,6 +1208,11 @@ class CheckpointManager:
         evicted: Optional[List[int]] = None,
     ) -> None:
         payload_obj: Dict[str, Any] = {"steps": steps}
+        if steps:
+            # Under this marker, a step's ABSENT refs entry soundly
+            # means verified-empty — the GC retention check re-derives
+            # refs from manifests only for unmarked (older) indexes.
+            payload_obj["refs_complete"] = True
         if refs:
             payload_obj["refs"] = refs
         if pinned:
@@ -874,8 +1262,11 @@ class CheckpointManager:
             for entry in metadata.manifest.values():
                 locations.update(_entry_locations(entry))
             locations = {l for l in locations if not l.startswith("../")}
+            from .cas import chunk_map_path
+
             for rank in range(metadata.world_size):
                 locations.add(table_path(rank))
+                locations.add(chunk_map_path(rank))
 
             async def _drop(location: str) -> None:
                 try:
@@ -1080,8 +1471,13 @@ class CheckpointManager:
             # Parent-relative locations are another step's blobs (this
             # step was incremental): never delete outside the step dir.
             locations = {l for l in locations if not l.startswith("../")}
+            from .cas import chunk_map_path
+
             for rank in range(metadata.world_size):
                 locations.add(table_path(rank))
+                # CAS chunk maps are step blobs too (absent for legacy
+                # steps; _delete_one tolerates the miss).
+                locations.add(chunk_map_path(rank))
             # Bounded-concurrent deletes: a dropped step of a large sharded
             # model has thousands of blobs, and serial object-store
             # round-trips would stall rank 0's save() for minutes.

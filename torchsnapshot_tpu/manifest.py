@@ -444,6 +444,19 @@ def sharded_blob_windows(manifest: Manifest) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+def entry_locations(entry: Entry) -> List[str]:
+    """Every storage location a manifest entry's bytes live at (batched
+    slab members and deduplicated chunks share locations; callers
+    dedupe). The one location walk shared by the manager's GC, the
+    mirror's resume planner, and the CAS refcount derivation."""
+    if isinstance(entry, ShardedArrayEntry):
+        return [shard.array.location for shard in entry.shards]
+    if isinstance(entry, ChunkedArrayEntry):
+        return [chunk.array.location for chunk in entry.chunks]
+    location = getattr(entry, "location", None)
+    return [location] if location else []
+
+
 def is_replicated(entry: Entry) -> bool:
     return bool(getattr(entry, "replicated", False))
 

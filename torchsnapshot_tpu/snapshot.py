@@ -175,6 +175,36 @@ def _maybe_push_to_peer(path: str, pending_io_work) -> None:
         logger.warning("peer tier: post-commit push hook failed: %r", e)
 
 
+def _maybe_cas_storage(
+    storage: StoragePlugin, path: str, cas_on: bool
+) -> StoragePlugin:
+    """Wrap a take's storage plugin with the content-addressed write
+    interceptor (docs/cas.md) when the broadcast-agreed decision says
+    so. The decision rides the existing path broadcast (rank 0 decides;
+    env skew can never mix layouts *within* one blob — and even a
+    per-rank mix composes, since the rank-0 rewrite is per-blob)."""
+    if not cas_on:
+        return storage
+    from .cas import CASStoragePlugin
+
+    return CASStoragePlugin(storage, path)
+
+
+def _maybe_write_cas_map(
+    storage: StoragePlugin,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> None:
+    """Persist this rank's CAS ``path -> digest`` map (``cas/{rank}``)
+    before the commit barrier — the input of rank 0's manifest rewrite,
+    committed with the same always-before-barrier discipline as the
+    checksum table. No-op for legacy takes."""
+    from .cas import CASStoragePlugin
+
+    if isinstance(storage, CASStoragePlugin):
+        event_loop.run_until_complete(storage.write_chunk_map(rank))
+
+
 def _mirror_state_for(path: str) -> Dict[str, Any]:
     """The process mirror's queue/lag state, for reports about tiered
     paths ({} otherwise): at take-report time the step's upload job was
@@ -353,8 +383,15 @@ class Snapshot:
         base, making this snapshot usable as a future base."""
         import uuid
 
+        from .cas import cas_eligible
+
         pg_wrapper = PGWrapper(pg)
-        path = pg_wrapper.broadcast_object(path)  # rank-0 path wins
+        # Rank-0 path wins; the CAS layout decision rides the same
+        # broadcast (one agreement, no extra collective) so ranks can
+        # never diverge on where data bytes land.
+        path, cas_on = pg_wrapper.broadcast_object(
+            (path, cas_eligible(path))
+        )
         # Error-propagating commit barrier, same design as async_take's:
         # a rank whose writes fail must not strand its peers for the full
         # store timeout — they observe the reported error at arrive() and
@@ -380,7 +417,9 @@ class Snapshot:
         tracker = _progress.track("take", path, pg_wrapper.get_rank())
         op_error: Optional[BaseException] = None
         try:
-            storage = url_to_storage_plugin(path)
+            storage = _maybe_cas_storage(
+                url_to_storage_plugin(path), path, cas_on
+            )
             with _reporting_to(barrier, "take"):
                 pending_io_work, metadata = cls._take_impl(
                     path=path,
@@ -399,6 +438,9 @@ class Snapshot:
                 pending_io_work.finalize_checksums()
                 _maybe_write_checksum_table(
                     pending_io_work, pg_wrapper.get_rank(), storage, event_loop
+                )
+                _maybe_write_cas_map(
+                    storage, pg_wrapper.get_rank(), event_loop
                 )
 
             # All writes are durable on every rank before the commit marker
@@ -479,9 +521,15 @@ class Snapshot:
         ``incremental_base``/``record_digests`` as in :meth:`take`."""
         import uuid
 
+        from .cas import cas_eligible
+
         op_begin = time.monotonic()
         pg_wrapper = PGWrapper(pg)
-        path = pg_wrapper.broadcast_object(path)
+        # Same combined broadcast as the sync take: rank-0 path wins and
+        # the CAS layout decision is agreed before any write exists.
+        path, cas_on = pg_wrapper.broadcast_object(
+            (path, cas_eligible(path))
+        )
         # Unique per-take commit nonce: barrier keys from any earlier take
         # to the same path (including failed ones) must never alias this
         # take's barrier.
@@ -501,7 +549,9 @@ class Snapshot:
         tunables_at_start = knobs.tunable_snapshot()
         recorder = _trace_recorder()
         trace_mark = recorder.mark()
-        storage = url_to_storage_plugin(path)
+        storage = _maybe_cas_storage(
+            url_to_storage_plugin(path), path, cas_on
+        )
         tracker = _progress.track("async_take", path, pg_wrapper.get_rank())
         defer_staging = knobs.is_async_device_snapshot_enabled()
         try:
@@ -747,6 +797,21 @@ class Snapshot:
             pending_io_work.checksum_finalizer = (
                 lambda: incr_ctx.inherit_checksums(pending_io_work.checksums)
             )
+        from .cas import CASStoragePlugin
+
+        if isinstance(storage, CASStoragePlugin):
+            # CAS takes additionally re-home the table entries from the
+            # original write paths to the chunk locations the rewritten
+            # manifest will name — composed AFTER the incremental
+            # inherit (whose entries already carry chunk-ref keys).
+            prev_finalizer = pending_io_work.checksum_finalizer
+
+            def _cas_finalize(prev=prev_finalizer) -> None:
+                if prev is not None:
+                    prev()
+                storage.rekey_checksums(pending_io_work.checksums)
+
+            pending_io_work.checksum_finalizer = _cas_finalize
         return pending_io_work, metadata
 
     @staticmethod
@@ -755,6 +820,16 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
     ) -> None:
+        # CAS takes: fold every rank's committed ``cas/{rank}`` chunk
+        # map into the manifest first — entry locations become
+        # ``../chunks/<key>`` parent refs, after which the snapshot
+        # reads like any other to every consumer. No-op for legacy
+        # takes (the wrapper's absence is the signal).
+        from .cas import maybe_rewrite_manifest
+
+        event_loop.run_until_complete(
+            maybe_rewrite_manifest(metadata, storage)
+        )
         # Committed as JSON — a YAML subset (reference manifest.py:19-22
         # invariant), so any YAML tooling still reads it, and loading takes
         # the fast json.loads path instead of a YAML parse.
@@ -1721,6 +1796,9 @@ class PendingSnapshot:
                 self.pg.get_rank(),
                 self._storage,
                 self._event_loop,
+            )
+            _maybe_write_cas_map(
+                self._storage, self.pg.get_rank(), self._event_loop
             )
             if barrier is not None:
                 barrier.arrive()

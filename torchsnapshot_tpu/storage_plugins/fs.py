@@ -76,7 +76,18 @@ class FSStoragePlugin(StoragePlugin):
         self._direct_declined = False
 
     def _full_path(self, path: str) -> str:
-        return os.path.join(self.root, path)
+        full = os.path.join(self.root, path)
+        if ".." in path:
+            # Parent-relative refs (incremental ``../step_*``, CAS
+            # ``../chunks/<key>``) resolve lexically, matching the
+            # object-store plugins' normalize_object_key: kernel ``..``
+            # resolution walks the directory tree, so an un-normalized
+            # open would demand this plugin's own root dir EXIST on
+            # this tier — which it may not yet (the mirror's durable-
+            # side chunk probe runs before the step's first upload
+            # creates the step dir there).
+            full = os.path.normpath(full)
+        return full
 
     def _direct_eligible(self, buf) -> bool:
         """Whether this single-buffer write qualifies for O_DIRECT:

@@ -90,6 +90,14 @@ GOODPUT_MIN_WALL_S = 30.0
 # (work replayed since the last committed step + the restore that
 # recovered it) reached this many seconds.
 RECOVERY_COST_S = 60.0
+# dedup-ineffective: over at least this many trailing CAS step-committed
+# ledger records, the realized chunk-reuse ratio stayed below the floor
+# while the on-device digests said at least the unchanged fraction of
+# the state did not change — unchanged bytes being re-stored means the
+# dedup path is broken in practice.
+DEDUP_WINDOW_STEPS = 3
+DEDUP_REUSE_FLOOR = 0.05
+DEDUP_UNCHANGED_FRAC = 0.5
 # Bench-trial epistemics (formerly private to bench.py):
 # adjacent probes disagreeing beyond this factor = unstable link;
 # achieved/bracket below this ratio on a stable bracket = in-take stall.
@@ -795,6 +803,64 @@ def _tuner_thrashing(ev: Evidence):
                 }
             )
     return out or None
+
+
+@doctor_rule(names.RULE_DEDUP_INEFFECTIVE, scope="evidence")
+def _dedup_ineffective(ev: Evidence):
+    """The content-addressed store is on (step-committed records carry
+    ``cas: true`` with exact per-chunk accounting) but the trailing
+    window realized ~zero reuse while the on-device digests recorded
+    that most of the state was unchanged between steps. When dedup
+    works, a digest-unchanged byte is *always* a reused byte (an
+    incremental ref, or a chunk the store already held) — so this gap
+    means the path is broken in practice: the chunks dir was wiped or
+    relocated between steps, serialization stopped being deterministic,
+    or chunk geometry churned. Evidence cites the ledger records the
+    goodput storage curve is built from."""
+    cas_steps = [
+        r
+        for r in ev.ledger_records
+        if r.get("event") == names.EVENT_STEP_COMMITTED and r.get("cas")
+    ]
+    window = cas_steps[-max(DEDUP_WINDOW_STEPS, 1) :]
+    if len(window) < DEDUP_WINDOW_STEPS:
+        return None
+    total = sum(int(r.get("bytes_total", 0)) for r in window)
+    reused = sum(int(r.get("bytes_reused", 0)) for r in window)
+    unchanged = sum(
+        int(r.get("bytes_digest_unchanged", 0)) for r in window
+    )
+    covered = sum(int(r.get("bytes_digest_covered", 0)) for r in window)
+    if total <= 0 or covered <= 0:
+        return None  # no digest evidence: cannot say the state was static
+    reuse_frac = reused / total
+    unchanged_frac = unchanged / covered
+    if (
+        reuse_frac >= DEDUP_REUSE_FLOOR
+        or unchanged_frac < DEDUP_UNCHANGED_FRAC
+    ):
+        return None
+    return {
+        "summary": (
+            "the content-addressed store reused almost nothing across "
+            "recent steps even though the on-device digests say the "
+            "state was mostly unchanged — check that the root's chunks/ "
+            "directory persists between steps and that serialization "
+            "is deterministic (fsck --cas audits the store)"
+        ),
+        "severity": "warning",
+        "evidence": {
+            "steps": [r.get("step") for r in window],
+            "reuse_fraction": round(reuse_frac, 4),
+            "digest_unchanged_fraction": round(unchanged_frac, 4),
+            "bytes_total": total,
+            "bytes_reused": reused,
+            "window": DEDUP_WINDOW_STEPS,
+            "reuse_floor": DEDUP_REUSE_FLOOR,
+            "unchanged_threshold": DEDUP_UNCHANGED_FRAC,
+        },
+        "source": os.path.basename(ev.ledger_file),
+    }
 
 
 @doctor_rule(names.RULE_GOODPUT_DEGRADED, scope="evidence")

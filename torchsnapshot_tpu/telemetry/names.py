@@ -69,6 +69,23 @@ PEER_CACHE_BYTES = "peer_cache_bytes"
 PEER_CACHE_STEPS = "peer_cache_steps"
 PEER_TIER_DEGRADED_STATE = "peer_tier_degraded"
 
+# -- content-addressed chunk store (cas/) ------------------------------------
+#
+# Write-side dedup accounting: chunks newly materialized into the store
+# vs. writes satisfied by an existing chunk (the bytes a dense-retention
+# run did NOT spend), plus the mirror's chunk-level shipping skips and
+# the peer tier's inventory-by-digest dedup.
+
+CAS_CHUNKS_WRITTEN_TOTAL = "cas_chunks_written_total"
+CAS_BYTES_WRITTEN_TOTAL = "cas_bytes_written_total"
+CAS_CHUNKS_DEDUPED_TOTAL = "cas_chunks_deduped_total"
+CAS_BYTES_DEDUPED_TOTAL = "cas_bytes_deduped_total"
+CAS_CHUNKS_RECLAIMED_TOTAL = "cas_chunks_reclaimed_total"
+CAS_BYTES_RECLAIMED_TOTAL = "cas_bytes_reclaimed_total"
+MIRROR_CHUNKS_SKIPPED_TOTAL = "mirror_chunks_skipped_total"
+PEER_PUSH_CHUNKS_DEDUPED_TOTAL = "peer_push_chunks_deduped_total"
+PEER_PUSH_BYTES_DEDUPED_TOTAL = "peer_push_bytes_deduped_total"
+
 # -- manager (manager.py) ----------------------------------------------------
 
 MANAGER_SAVES_TOTAL = "manager_saves_total"
@@ -251,6 +268,13 @@ RULE_RECOVERY_COST_HIGH = "recovery-cost-high"
 # paid storage latency the peer tier existed to avoid. Evidence cites
 # the peer transfer failures and the per-tier byte split.
 RULE_PEER_TIER_DEGRADED = "peer-tier-degraded"
+# The content-addressed store is on but recent committed steps reused
+# ~none of their bytes even though the on-device digests say the state
+# was mostly unchanged — the dedup path is broken in practice (chunks
+# dir wiped/relocated, nondeterministic serialization, or an ineligible
+# root silently running the legacy layout). Evidence cites the ledger's
+# step-committed storage records.
+RULE_DEDUP_INEFFECTIVE = "dedup-ineffective"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).

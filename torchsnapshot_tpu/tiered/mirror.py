@@ -405,6 +405,41 @@ class Mirror:
 
             async def copy_one(path: str) -> int:
                 async def op() -> int:
+                    # Content-addressed chunks ship only when the
+                    # durable tier doesn't already hold them: the chunk
+                    # key IS the content AND embeds the byte length, so
+                    # a ranged read of the LAST byte (one byte, no data
+                    # transfer) is a full equality check — a truncated
+                    # copy left by a crashed upload misses the probe and
+                    # is re-shipped (overwritten whole), while dense
+                    # retention mirrors one full step plus deltas
+                    # instead of every retained step's bytes.
+                    from ..cas import (
+                        is_chunk_location,
+                        key_of_location,
+                        nbytes_of_key,
+                    )
+
+                    if is_chunk_location(path):
+                        key = key_of_location(path)
+                        want = nbytes_of_key(key) if key else None
+                        held = False
+                        if want:
+                            probe = ReadIO(
+                                path=path, byte_range=(want - 1, want)
+                            )
+                            try:
+                                await durable.read(probe)
+                                held = (
+                                    memoryview(probe.buf).nbytes == 1
+                                )
+                            except (FileNotFoundError, OSError):
+                                held = False
+                        if held:
+                            telemetry.metrics().counter_inc(
+                                metric_names.MIRROR_CHUNKS_SKIPPED_TOTAL
+                            )
+                            return 0
                     read_io = ReadIO(path=path)
                     await fast.read(read_io)
                     nbytes = memoryview(read_io.buf).nbytes
@@ -529,19 +564,30 @@ async def _resume_plan(fast_url: str, durable_url: str):
         blobs: Dict[str, int] = {}
         from ..manager import _entry_locations
 
+        from ..cas import chunk_map_path, is_chunk_location
+
         for entry in metadata.manifest.values():
             for location in _entry_locations(entry):
+                if not location:
+                    continue
                 # Parent-relative refs are another step's blobs; that
-                # step mirrors (or mirrored) itself.
-                if location and not location.startswith("../"):
-                    blobs[location] = 0
+                # step mirrors (or mirrored) itself — EXCEPT chunk refs:
+                # the chunk store belongs to every referencing step, and
+                # the worker's existence probe skips whatever the
+                # durable side already holds.
+                if location.startswith("../") and not is_chunk_location(
+                    location
+                ):
+                    continue
+                blobs[location] = 0
         for rank in range(metadata.world_size):
-            probe = ReadIO(path=table_path(rank), byte_range=(0, 1))
-            try:
-                await fast.read(probe)
-            except (FileNotFoundError, OSError):
-                continue
-            blobs[table_path(rank)] = 0
+            for control in (table_path(rank), chunk_map_path(rank)):
+                probe = ReadIO(path=control, byte_range=(0, 1))
+                try:
+                    await fast.read(probe)
+                except (FileNotFoundError, OSError):
+                    continue
+                blobs[control] = 0
         blobs[_METADATA_FNAME] = len(meta_bytes)
         return blobs, _METADATA_FNAME
     finally:

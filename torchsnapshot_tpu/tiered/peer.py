@@ -122,13 +122,20 @@ class PeerTransferError(RuntimeError):
 
 
 class _StepSlot:
-    __slots__ = ("blobs", "committed", "step")
+    __slots__ = ("blobs", "committed", "step", "chunk_refs")
 
     def __init__(self, step: Optional[int]) -> None:
         # path -> (checksum-table entry, bytes)
         self.blobs: Dict[str, Tuple[tuple, bytes]] = {}
+        # Content-addressed blobs this step references in the cache's
+        # shared chunk pool (bytes stored once across steps).
+        self.chunk_refs: set = set()
         self.committed = False
         self.step = step
+
+    @property
+    def holds_bytes(self) -> bool:
+        return bool(self.blobs or self.chunk_refs)
 
 
 class PeerCache:
@@ -157,8 +164,43 @@ class PeerCache:
         # preserve it and `move_to_end`-style refreshes re-insert.
         self._steps: Dict[str, _StepSlot] = {}
         self._pinned: Optional[str] = None
+        # Shared chunk pool (docs/cas.md): content-addressed blobs are
+        # stored ONCE across steps — path -> (entry, bytes) plus a
+        # per-chunk refcount of the step slots referencing it. Budget
+        # bytes are reserved at first insert and released when the last
+        # referencing step drops.
+        self._chunks: Dict[str, Tuple[tuple, bytes]] = {}
+        self._chunk_rc: Dict[str, int] = {}
 
     # -- mutation (server handler threads) ------------------------------
+
+    def _is_chunk(self, path: str) -> bool:
+        from ..cas import is_chunk_location
+
+        return is_chunk_location(path)
+
+    def _ref_chunk_locked(self, slot: _StepSlot, path: str) -> None:
+        if path not in slot.chunk_refs:
+            slot.chunk_refs.add(path)
+            self._chunk_rc[path] = self._chunk_rc.get(path, 0) + 1
+
+    def reference_chunks(
+        self, step_key: str, step: Optional[int], paths: List[str]
+    ) -> List[str]:
+        """Inventory-by-digest dedup: of ``paths`` (chunk locations),
+        reference the ones already pooled under ``step_key`` and return
+        them — the pusher then ships bytes only for the misses."""
+        with self._lock:
+            hits = [p for p in paths if p in self._chunks]
+            if hits:
+                slot = self._steps.get(step_key)
+                if slot is None:
+                    slot = _StepSlot(step)
+                    self._steps[step_key] = slot
+                for p in hits:
+                    self._ref_chunk_locked(slot, p)
+            self._publish_gauges_locked()
+            return hits
 
     def put(
         self,
@@ -180,6 +222,22 @@ class PeerCache:
             if slot is None:
                 slot = _StepSlot(step)
                 self._steps[step_key] = slot
+            if self._is_chunk(path):
+                # Content-addressed: the path IS the content, so a
+                # pooled copy serves every step — reference it (no new
+                # bytes) or insert it once.
+                if path in self._chunks:
+                    self._ref_chunk_locked(slot, path)
+                    self._publish_gauges_locked()
+                    return True, "ok"
+                while not self._budget.try_reserve(nbytes):
+                    if not self._evict_one_locked(exclude=step_key):
+                        self._publish_gauges_locked()
+                        return False, "budget"
+                self._chunks[path] = (tuple(entry), data)
+                self._ref_chunk_locked(slot, path)
+                self._publish_gauges_locked()
+                return True, "ok"
             prior = slot.blobs.pop(path, None)
             if prior is not None:
                 self._budget.release(len(prior[1]))
@@ -200,7 +258,7 @@ class PeerCache:
             if step is not None:
                 slot.step = step
             self._steps[step_key] = slot  # LRU refresh: newest position
-            if slot.blobs:
+            if slot.holds_bytes:
                 self._pinned = step_key
             # An EMPTY committed step (every push refused/raced away)
             # must not steal the pin: the previous pinned step is still
@@ -212,7 +270,7 @@ class PeerCache:
                 committed = [
                     k
                     for k, s in self._steps.items()
-                    if s.committed and s.blobs
+                    if s.committed and s.holds_bytes
                 ]
                 for old in committed[: -max(1, self.keep_last_n)]:
                     self._drop_locked(old)
@@ -232,6 +290,15 @@ class PeerCache:
             return
         for _, data in slot.blobs.values():
             self._budget.release(len(data))
+        for path in slot.chunk_refs:
+            rc = self._chunk_rc.get(path, 0) - 1
+            if rc <= 0:
+                self._chunk_rc.pop(path, None)
+                pooled = self._chunks.pop(path, None)
+                if pooled is not None:
+                    self._budget.release(len(pooled[1]))
+            else:
+                self._chunk_rc[path] = rc
         if self._pinned == step_key:
             self._pinned = None
 
@@ -247,6 +314,12 @@ class PeerCache:
 
     def get(self, step_key: str, path: str) -> Optional[Tuple[tuple, bytes]]:
         with self._lock:
+            if self._is_chunk(path):
+                # Content-addressed: a pooled chunk serves ANY step —
+                # the path names the bytes, not their provenance.
+                pooled = self._chunks.get(path)
+                if pooled is not None:
+                    return pooled
             slot = self._steps.get(step_key)
             if slot is None:
                 return None
@@ -257,13 +330,22 @@ class PeerCache:
             slot = self._steps.get(step_key)
             if slot is None:
                 return {}
-            return {p: e for p, (e, _) in slot.blobs.items()}
+            out = {p: e for p, (e, _) in slot.blobs.items()}
+            for p in slot.chunk_refs:
+                pooled = self._chunks.get(p)
+                if pooled is not None:
+                    out[p] = pooled[0]
+            return out
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "steps": len(self._steps),
                 "blobs": sum(len(s.blobs) for s in self._steps.values()),
+                "chunks": len(self._chunks),
+                "chunk_bytes": sum(
+                    len(d) for _, d in self._chunks.values()
+                ),
                 "bytes": self._budget.reserved_bytes(),
                 "budget_bytes": self._budget.total_bytes,
                 "pinned": self._pinned,
@@ -344,6 +426,11 @@ class _PeerRequestHandler(socketserver.BaseRequestHandler):
                             metric_names.PEER_PULL_MISSES_TOTAL
                         )
                     reply = found
+                elif cmd == "refchunks":
+                    step_key, step, paths = args
+                    reply = cache.reference_chunks(
+                        step_key, step, list(paths)
+                    )
                 elif cmd == "list":
                     (step_key,) = args
                     reply = cache.inventory(step_key)
@@ -429,6 +516,14 @@ class PeerClient:
     def commit(self, step_key: str, step: Optional[int]) -> None:
         self.request("commit", step_key, step)
 
+    def reference_chunks(
+        self, step_key: str, step: Optional[int], paths: List[str]
+    ) -> List[str]:
+        """Dedup probe: which of these content-addressed chunk paths the
+        peer already pools (now referenced under ``step_key``). The
+        pusher ships bytes only for the rest."""
+        return list(self.request("refchunks", step_key, step, list(paths)))
+
     def pull(
         self,
         step_key: str,
@@ -476,6 +571,10 @@ class PeerPushJob:
         self.blobs_refused = 0
         self.blobs_skipped = 0
         self.blobs_failed = 0
+        # Content-addressed chunks the peer already held (inventory-by-
+        # digest dedup): placed without crossing the wire.
+        self.blobs_deduped = 0
+        self.bytes_deduped = 0
         self.target_rank: Optional[int] = None
         self.endpoint: Optional[Tuple[str, int]] = None
 
@@ -668,7 +767,40 @@ class PeerReplicator:
         )
         loop = asyncio.get_running_loop()
         try:
+            # Inventory-by-digest dedup, one RPC: content-addressed
+            # chunk paths the neighbor already pools are *referenced*
+            # under this step (no bytes cross the wire) — a dense-
+            # retention run pushes one full step plus deltas.
+            from ..cas import is_chunk_location
+
+            deduped: set = set()
+            chunk_paths = sorted(
+                p for p in job.blobs if is_chunk_location(p)
+            )
+            if chunk_paths:
+
+                async def _ref_once():
+                    return await loop.run_in_executor(
+                        None,
+                        client.reference_chunks,
+                        job.step_key,
+                        job.step,
+                        chunk_paths,
+                    )
+
+                hits = await retry.run(
+                    _ref_once, retriable_exceptions=(PeerTransferError,)
+                )
+                for p in hits:
+                    deduped.add(p)
+                    job.blobs_deduped += 1
+                    entry = job.blobs.get(p)
+                    if entry is not None and len(entry) >= 3:
+                        job.bytes_deduped += int(entry[2])
+                    job.pushed.append(p)
             for path in sorted(job.blobs):
+                if path in deduped:
+                    continue
                 entry = job.blobs[path]
                 read_io = ReadIO(path=path)
                 try:
@@ -728,7 +860,8 @@ class PeerReplicator:
                 len(job.blobs)
                 - job.blobs_pushed
                 - job.blobs_refused
-                - job.blobs_skipped,
+                - job.blobs_skipped
+                - job.blobs_deduped,
             )
             try:
                 await self._write_placement(storage, job, error=repr(e))
@@ -765,7 +898,9 @@ class PeerReplicator:
             "blobs_refused": job.blobs_refused,
             "blobs_skipped": job.blobs_skipped,
             "blobs_failed": job.blobs_failed,
+            "blobs_deduped": job.blobs_deduped,
             "bytes_pushed": job.bytes_pushed,
+            "bytes_deduped": job.bytes_deduped,
             # Only the blobs that actually LANDED in the peer's RAM —
             # the placement claim fsck audits against requirements.
             "blobs": sorted(job.pushed),
@@ -792,6 +927,15 @@ class PeerReplicator:
             registry.counter_inc(
                 metric_names.PEER_PUSH_BYTES_TOTAL, job.bytes_pushed
             )
+            if job.blobs_deduped:
+                registry.counter_inc(
+                    metric_names.PEER_PUSH_CHUNKS_DEDUPED_TOTAL,
+                    job.blobs_deduped,
+                )
+                registry.counter_inc(
+                    metric_names.PEER_PUSH_BYTES_DEDUPED_TOTAL,
+                    job.bytes_deduped,
+                )
             failures = job.blobs_failed + job.blobs_refused
             if failures or job.error is not None:
                 registry.counter_inc(
@@ -952,10 +1096,16 @@ def maybe_enqueue_push(
     if rep is None or not rep.configured:
         return None
     try:
+        # Base-referenced (``../step_*``) locations belong to other
+        # steps and are skipped — but content-addressed chunk refs ARE
+        # this step's payload (stored once, referenced by many): they
+        # push (or dedup against the neighbor's pool) like any blob.
+        from ..cas import is_chunk_location
+
         blobs: Dict[str, Optional[tuple]] = {
             p: tuple(e)
             for p, e in written.items()
-            if not p.startswith("../")
+            if not p.startswith("../") or is_chunk_location(p)
         }
         if not blobs:
             if knobs.is_checksums_disabled():

@@ -71,6 +71,16 @@ class TieredStoragePlugin(StoragePlugin):
         await self.fast.write(write_io)
         self._written[write_io.path] = payload_nbytes(write_io.buf)
 
+    def note_written(self, path: str, nbytes: int) -> None:
+        """Record a blob for mirror enqueue without writing it — the CAS
+        wrapper's dedup hits land here: the bytes already live on the
+        fast tier, but this step's durability claim still covers them.
+        If the chunk's original writer crashed before its mirror ran,
+        nothing else would ever ship it; enqueueing it lets the mirror's
+        durable-side existence probe decide (a held chunk costs one
+        ranged byte, not a copy)."""
+        self._written[path] = int(nbytes)
+
     async def write_with_checksum(self, write_io: WriteIO):
         entry = await self.fast.write_with_checksum(write_io)
         if entry is not None:
