@@ -83,6 +83,10 @@ count. TS_BENCH_RETENTION_MIB / TS_BENCH_RETENTION_STEPS size leg 9
 (``retention_curve``): the 2-proc keep-last-N dense-retention loop
 comparing cumulative storage, mirror-shipped and peer-pushed bytes with
 the content-addressed chunk store on vs off (docs/cas.md).
+TS_BENCH_COORD_WORLDS sizes leg 10 (``coordination_scaling``): storms
+of simulated ranks through the real coordination code paths, tuned
+topology vs the linear/per-key baseline plus the tree barrier's growth
+curve (docs/scaling.md).
 ``--json-out PATH`` additionally writes the final record to a
 file (the stdout tail can be truncated by the driver's capture —
 BENCH_r04/r05 both parsed null for exactly that reason).
@@ -150,6 +154,7 @@ _OVERRIDES = [
         "TS_BENCH_STEADY_TAKES",
         "TS_BENCH_RETENTION_MIB",
         "TS_BENCH_RETENTION_STEPS",
+        "TS_BENCH_COORD_WORLDS",
     )
     if os.environ.get(k)
 ]
@@ -583,6 +588,41 @@ def run_subprocess_legs() -> None:
                 f"({rc.get('cas_storage_savings')}x total savings)"
             )
         _emit_partial("retention_curve")
+
+    if _have_budget("coordination_scaling", 150):
+        # Leg 10 — coordination-plane scaling (docs/scaling.md): full
+        # save/restore/endpoint storms through the REAL dist_store/
+        # fanout code paths at world {8, 64, 256} simulated ranks over
+        # TCP, tuned defaults (TreeBarrier + batched multi-key ops +
+        # poll backoff + 2 store shards) vs the pre-scale-model
+        # baseline (LinearBarrier, per-key wire ops, fixed 5 ms
+        # polling, one hub), plus the tree barrier's growth curve and
+        # hot-key fan-in. The acceptance instrument for the O(world)
+        # coordination-wall work: regressions in the topology show up
+        # as a speedup collapse or a super-linear slope here.
+        cs = _subprocess_json(
+            "coordination-scaling",
+            ("benchmarks", "coordination_scaling.py"),
+            ["--worlds", os.environ.get(
+                "TS_BENCH_COORD_WORLDS", "8,64,256"
+            ), "--json"],
+            timeout=420,
+        )
+        if cs is not None:
+            RESULT["coordination_scaling"] = cs
+            RESULT["coordination_speedup_256"] = cs.get(
+                "coordination_speedup_max_world"
+            )
+            RESULT["coordination_sublinear"] = cs.get("sublinear")
+            _log(
+                f"bench: coordination scaling — "
+                f"{cs.get('coordination_speedup_max_world')}x vs the "
+                f"linear/per-key baseline at world "
+                f"{(cs.get('worlds') or [None])[-1]}, tree growth slope "
+                f"{cs.get('tree_growth_slope')} "
+                f"(sublinear={cs.get('sublinear')})"
+            )
+        _emit_partial("coordination_scaling")
 
 
 def cold_start_rows() -> None:

@@ -67,6 +67,14 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_FS_DIRECT_IO", "0")
 # via knobs.enable_autotune().
 os.environ.setdefault("TORCHSNAPSHOT_TPU_AUTOTUNE", "0")
 
+# The coordination store stays a single hub in the suite (1 = no shard
+# servers; also the packaged default): tier-1 distributed tests assert
+# about exact store traffic and must not depend on key->shard spread.
+# Scale-model tests build ShardedStore members explicitly. The tree
+# barrier stays at its packaged default (ON) so the tier-1 distributed
+# lane exercises the production rendezvous topology.
+os.environ.setdefault("TORCHSNAPSHOT_TPU_STORE_SHARDS", "1")
+
 # The content-addressed chunk store is pinned off in the suite ("0" =
 # the legacy per-step layout; also the packaged default): tier-1
 # snapshot/manager tests assert about the exact per-step file sets and

@@ -401,3 +401,224 @@ def test_tcp_store_connect_timeout_is_a_clear_error() -> None:
     with pytest.raises(StoreTimeoutError, match="Timed out connecting"):
         client.try_get("anything")
     assert time.monotonic() - t0 < 10.0
+
+
+# ---------------------------------------------------------------------------
+# Batched store ops (multi_set / multi_get / multi_delete)
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_store_multi_ops_roundtrip() -> None:
+    """The batched wire commands: one frame each way per BATCH, same
+    semantics as the per-key primitives (absent keys -> None)."""
+    server = TCPStore("127.0.0.1", 0, is_server=True)
+    client = TCPStore("127.0.0.1", server.port, is_server=False)
+    try:
+        client.multi_set({"a": b"1", "b": b"2", "c": b"3"})
+        assert server.try_get("b") == b"2"
+        got = client.multi_get(["a", "b", "missing"])
+        assert got == {"a": b"1", "b": b"2", "missing": None}
+        client.multi_delete(["a", "c", "never-existed"])
+        assert client.multi_get(["a", "b", "c"]) == {
+            "a": None,
+            "b": b"2",
+            "c": None,
+        }
+    finally:
+        client.close()
+        server.close()
+
+
+def test_sharded_store_routing_and_collectives() -> None:
+    """Deterministic key->shard routing (every client agrees), per-key
+    atomicity for counters, and the base-class collectives running
+    unchanged over the sharded store."""
+    from torchsnapshot_tpu.dist_store import ShardedStore, shard_for_key
+
+    members = [InProcessStore() for _ in range(3)]
+    store = ShardedStore(members)
+    keys = [f"k{i}" for i in range(30)]
+    store.multi_set({k: k.encode() for k in keys})
+    # Every key lives on exactly its hashed member, nowhere else.
+    for k in keys:
+        shard = shard_for_key(k, 3)
+        assert members[shard].try_get(k) == k.encode()
+        for other in range(3):
+            if other != shard:
+                assert members[other].try_get(k) is None
+    assert store.multi_get(keys) == {k: k.encode() for k in keys}
+    assert store.add("ctr", 2) == 2 and store.add("ctr", 3) == 5
+    store.multi_delete(keys[:15])
+    assert store.try_get(keys[0]) is None
+    assert store.try_get(keys[20]) == keys[20].encode()
+
+    world, results = 3, {}
+
+    def worker(rank: int) -> None:
+        pg = PGWrapper(ProcessGroup(store=store, rank=rank, world_size=world))
+        results[(rank, "ag")] = pg.all_gather_object(rank)
+        pg.barrier()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results[(1, "ag")] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# TreeBarrier
+# ---------------------------------------------------------------------------
+
+
+def _run_barrier_world(make, world: int):
+    errors = {}
+
+    def worker(rank: int) -> None:
+        try:
+            b = make(rank)
+            b.arrive(timeout=10.0)
+            b.depart(timeout=10.0)
+        except Exception as e:  # noqa: BLE001 - collected for asserts
+            errors[rank] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+def test_tree_barrier_happy_path_and_cleanup() -> None:
+    from torchsnapshot_tpu.dist_store import TreeBarrier
+
+    store = InProcessStore()
+    errors = _run_barrier_world(
+        lambda r: TreeBarrier("tb", store, r, 9, fanout=2), world=9
+    )
+    assert errors == {}
+    # Transient keys cleaned up: each rank deletes its own node keys,
+    # the root the error key — a long-lived store must not accumulate.
+    assert store._kv == {}
+
+
+def test_tree_barrier_error_propagation() -> None:
+    """report_error poisons every pending wait with BarrierError — the
+    same contract LinearBarrier pins (the swap must be transparent to
+    snapshot.py/fanout.py call sites)."""
+    from torchsnapshot_tpu.dist_store import TreeBarrier
+
+    store = InProcessStore()
+    world = 7
+    errors = {}
+    release = threading.Event()
+
+    def worker(rank: int) -> None:
+        b = TreeBarrier("err", store, rank, world, fanout=2)
+        try:
+            if rank == 3:
+                release.wait(5.0)
+                b.report_error(ValueError("rank 3 exploded"))
+                return
+            b.arrive(timeout=10.0)
+            b.depart(timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            errors[rank] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    release.set()
+    for t in threads:
+        t.join()
+    assert set(errors) == set(range(world)) - {3}
+    for e in errors.values():
+        assert isinstance(e, BarrierError)
+        assert isinstance(e.__cause__, ValueError)
+
+
+def test_tree_barrier_timeout_and_depart_guard() -> None:
+    from torchsnapshot_tpu.dist_store import TreeBarrier
+
+    b = TreeBarrier("t", InProcessStore(), 0, 2, fanout=4)
+    with pytest.raises(StoreTimeoutError):
+        b.arrive(timeout=0.2)
+    b2 = TreeBarrier("t2", InProcessStore(), 0, 2, fanout=4)
+    with pytest.raises(RuntimeError, match="depart"):
+        b2.depart()
+
+
+def test_tree_barrier_world_one_is_a_noop() -> None:
+    from torchsnapshot_tpu.dist_store import TreeBarrier
+
+    b = TreeBarrier("solo", InProcessStore(), 0, 1, fanout=4)
+    b.arrive(timeout=1.0)
+    b.depart(timeout=1.0)
+
+
+def test_make_barrier_honors_kill_switch() -> None:
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.dist_store import (
+        LinearBarrier as _Linear,
+        TreeBarrier as _Tree,
+        make_barrier,
+    )
+
+    store = InProcessStore()
+    assert isinstance(make_barrier("p", store, 0, 4), _Tree)
+    with knobs.disable_tree_barrier():
+        assert isinstance(make_barrier("p", store, 0, 4), _Linear)
+    with knobs.override_barrier_fanout(5):
+        assert make_barrier("p", store, 0, 4).fanout == 5
+
+
+# ---------------------------------------------------------------------------
+# Poll backoff (satellite: request-count reduction while waiting)
+# ---------------------------------------------------------------------------
+
+
+def test_wait_loops_back_off_exponentially() -> None:
+    """A follower parked in a barrier wait must poll at backed-off
+    intervals, not a fixed 5 ms tick: ~0.6 s of waiting costs a
+    bounded handful of requests (fixed-interval polling would issue
+    ~120). Pinned through the counting store, world 256 so the scaled
+    cap is at its ceiling."""
+    from torchsnapshot_tpu.scalemodel import CountingStore
+
+    inner = InProcessStore()
+    store = CountingStore(inner)
+    barrier = LinearBarrier("bo", store, rank=1, world_size=256)
+
+    def release_late() -> None:
+        time.sleep(0.6)
+        inner.set("bo/arrive/go", b"1")
+
+    t = threading.Thread(target=release_late)
+    t.start()
+    barrier.arrive(timeout=10.0)
+    t.join()
+    # add(count) + N batched polls of [error, go]; exponential backoff
+    # capped at 100 ms bounds N to ~12 for a 0.6 s wait.
+    assert store.counts["multi_get"] <= 20
+    assert store.counts["multi_get"] >= 3
+
+
+def test_store_get_backs_off_but_stays_deadline_accurate() -> None:
+    from torchsnapshot_tpu.scalemodel import CountingStore
+
+    inner = InProcessStore()
+    store = CountingStore(inner)
+
+    def set_late() -> None:
+        time.sleep(0.4)
+        inner.set("late", b"v")
+
+    t = threading.Thread(target=set_late)
+    t.start()
+    assert store.get("late", timeout=10.0) == b"v"
+    t.join()
+    assert store.counts["try_get"] <= 15
+    with pytest.raises(StoreTimeoutError):
+        store.get("never", timeout=0.3)

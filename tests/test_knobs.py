@@ -320,3 +320,41 @@ def test_history_max_records_knob() -> None:
     finally:
         if prev is not None:
             os.environ["TORCHSNAPSHOT_TPU_HISTORY_MAX_RECORDS"] = prev
+
+
+def test_coordination_topology_knobs() -> None:
+    # Tree barrier: default ON; "0" is the LinearBarrier kill switch.
+    assert knobs.is_tree_barrier_enabled()
+    with knobs.disable_tree_barrier():
+        assert not knobs.is_tree_barrier_enabled()
+    assert knobs.is_tree_barrier_enabled()
+    with knobs.enable_tree_barrier():
+        assert knobs.is_tree_barrier_enabled()
+    # Fanout: default 16, floor of 2 (a 1-ary "tree" is a chain).
+    assert knobs.get_barrier_fanout() == 16
+    with knobs.override_barrier_fanout(4):
+        assert knobs.get_barrier_fanout() == 4
+    with knobs.override_barrier_fanout(1):
+        assert knobs.get_barrier_fanout() == 2
+    assert knobs.get_barrier_fanout() == 16
+    # Store shards: conftest pins the suite to the single-hub default.
+    assert knobs.get_store_shards() == 1
+    with knobs.override_store_shards(4):
+        assert knobs.get_store_shards() == 4
+    assert knobs.get_store_shards() == 1
+
+
+def test_coordination_knobs_are_tunables() -> None:
+    """barrier_fanout / store_shards ride the tuner override layer
+    (env always wins) and appear in every report's tunables snapshot."""
+    snap = knobs.tunable_snapshot()
+    assert snap["barrier_fanout"] == 16
+    assert snap["store_shards"] == 1
+    try:
+        knobs.set_tuner_override(knobs._BARRIER_FANOUT_ENV, 8)
+        assert knobs.get_barrier_fanout() == 8
+        with knobs.override_barrier_fanout(32):
+            assert knobs.get_barrier_fanout() == 32  # env wins
+    finally:
+        knobs.clear_tuner_override(knobs._BARRIER_FANOUT_ENV)
+    assert knobs.get_barrier_fanout() == 16

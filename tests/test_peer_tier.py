@@ -598,3 +598,28 @@ def test_preemption_recovery_served_from_peer_ram(pg) -> None:
     report2 = telemetry.last_report("restore", path=mgr.step_path(0))
     assert report2.tier_split is None  # nothing peer-resident: no ladder
     peer.reset_peer_tier()
+
+
+def test_restore_setup_endpoint_resolve_is_one_round_trip() -> None:
+    """Satellite pin: the peer registry resolve the restore setup rides
+    (``PeerReplicator.resolve_endpoints`` -> ``lookup_endpoints``)
+    costs ONE batched store round trip for the whole world — not world
+    sequential lookups — and skips unpublished/garbage entries."""
+    from torchsnapshot_tpu.dist_store import InProcessStore, publish_endpoint
+    from torchsnapshot_tpu.scalemodel import CountingStore
+    from torchsnapshot_tpu.tiered.peer import PEER_SERVICE, PeerReplicator
+
+    inner = InProcessStore()
+    world = 32
+    for rank in range(world):
+        if rank == 9:
+            continue  # never published (dead before configure)
+        publish_endpoint(inner, PEER_SERVICE, rank, "h", 7000 + rank)
+    inner.set(f"__endpoint/{PEER_SERVICE}/5", b"garbage-no-port")
+    counting = CountingStore(inner)
+    rep = PeerReplicator()
+    rep._store = counting
+    endpoints = rep.resolve_endpoints(range(world))
+    assert counting.counts == {"multi_get": 1}
+    assert set(endpoints) == set(range(world)) - {9, 5}
+    assert endpoints[0] == ("h", 7000)

@@ -15,6 +15,24 @@ module provides:
   propagation, safe to use off the main thread; the async-commit primitive
   (reference dist_store.py:91-196, used at snapshot.py:948-969 because the
   background commit thread must not issue collectives).
+- :class:`TreeBarrier` — the default production barrier (same contract,
+  built by :func:`make_barrier`): arrive/depart aggregate through a
+  fanout-``k`` rank tree, so no single key ever has more than ``k``
+  writers or readers and the critical path is O(log_k world) instead of
+  every rank rendezvousing on the leader's counter.
+- :class:`ShardedStore` — N member stores behind deterministic
+  key->shard hashing, so a thousand-rank world's key traffic spreads
+  over N server sockets instead of serializing through one hub.
+
+Scaling disciplines (docs/scaling.md; measured by
+``benchmarks/coordination_scaling.py`` over the scalemodel harness):
+every wait loop backs off exponentially (``_PollPacer``, cap ~100 ms)
+so an idle 1000-rank barrier doesn't hammer the store at O(world/5ms)
+QPS, and multi-key traffic rides the batched ``multi_set`` /
+``multi_get`` / ``multi_delete`` primitives — one wire round trip per
+*batch*, not per key. Store requests and barrier waits feed the
+coordination telemetry (``coordination_*`` counters, ``barrier:*``
+spans) that the ``coordination-bound`` doctor rule reads.
 
 Collective keys are transient: the last participant to finish an operation
 deletes its keys, so long-lived stores don't leak.
@@ -30,11 +48,116 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import knobs
 
 _DEFAULT_TIMEOUT_S = 300.0
 _POLL_INTERVAL_S = 0.005
+_POLL_CAP_S = 0.1
 _CONNECT_TIMEOUT_S = 30.0
+
+# Process-wide (initial, cap) the pacer binds per instance. Not an
+# operator knob: the one consumer is the scale-model harness's legacy
+# baseline (initial == cap reproduces the pre-backoff fixed-interval
+# polling so its O(world) QPS wall stays measurable after the fix).
+_POLL_PROFILE: Tuple[float, float] = (_POLL_INTERVAL_S, _POLL_CAP_S)
+
+
+def _set_poll_profile(initial: float, cap: float) -> Tuple[float, float]:
+    """Swap the process-wide poll profile; returns the previous one.
+    Scale-model harness use only — production always runs the backoff
+    defaults. Affects pacers constructed AFTER the call."""
+    global _POLL_PROFILE
+    prev = _POLL_PROFILE
+    _POLL_PROFILE = (float(initial), float(cap))
+    return prev
+
+
+# Aggregate idle-poll budget a wait loop sizes its backoff cap against:
+# cap ≈ world / _POLL_QPS_BUDGET, clamped to [initial, _POLL_CAP_S]. A
+# 2-proc barrier keeps ~5 ms detection latency (the cap would only cost
+# it latency — two pollers cannot hammer anything), a 256-rank one backs
+# off to ~50 ms, a 1000-rank one to the 100 ms ceiling (~10k QPS fleet-
+# wide either way). World-aware call sites (barriers, fan-out rounds)
+# pass the scaled cap; plain key waits keep the defaults.
+_POLL_QPS_BUDGET = 5000.0
+
+
+def scaled_poll_cap(world_size: int) -> float:
+    profile_initial, profile_cap = _POLL_PROFILE
+    return min(
+        profile_cap,
+        max(profile_initial, world_size / _POLL_QPS_BUDGET),
+    )
+
+
+class _PollPacer:
+    """Deadline-aware exponential poll backoff for store wait loops.
+
+    Fixed-interval polling is an O(world) QPS multiplier: a 1000-rank
+    barrier polling one key every 5 ms lands 200k requests/s on the
+    store while *nothing changes*. Backoff doubles the interval per
+    miss up to ~100 ms (late enough that a long wait costs each rank
+    ~10 QPS, early enough that release latency stays bounded by the
+    cap), never sleeping past the caller's deadline, and resets on
+    observation so a busy exchange keeps its low first-poll latency."""
+
+    def __init__(
+        self,
+        initial: Optional[float] = None,
+        cap: Optional[float] = None,
+    ) -> None:
+        self._initial = _POLL_PROFILE[0] if initial is None else initial
+        self._cap = _POLL_PROFILE[1] if cap is None else cap
+        self._delay = self._initial
+
+    def reset(self) -> None:
+        self._delay = self._initial
+
+    def sleep(self, deadline: Optional[float] = None) -> None:
+        delay = self._delay
+        if deadline is not None:
+            delay = min(delay, max(0.0, deadline - time.monotonic()))
+        if delay > 0:
+            time.sleep(delay)
+        self._delay = min(self._delay * 2.0, self._cap)
+
+
+# ---------------------------------------------------------------------------
+# Coordination telemetry (best-effort; never fails a collective)
+# ---------------------------------------------------------------------------
+
+_TELE_MODULES = None
+
+
+def _tele_modules():
+    """(telemetry pkg, names, trace) lazily resolved: dist_store sits
+    below the telemetry package in the import graph, so the binding
+    happens on first use, never at import time."""
+    global _TELE_MODULES
+    if _TELE_MODULES is None:
+        from . import telemetry as _telemetry
+        from .telemetry import names as _names
+        from .telemetry import trace as _trace
+
+        _TELE_MODULES = (_telemetry, _names, _trace)
+    return _TELE_MODULES
+
+
+def _observe_store_requests(op: str, seconds: float, requests: int = 1) -> None:
+    """One store round trip's worth of coordination accounting. The
+    per-op deltas land in SnapshotReport.coordination (report.py), which
+    is what the scale-model harness and the ``coordination-bound``
+    doctor rule attribute against wall time."""
+    try:
+        telemetry, n, _ = _tele_modules()
+        reg = telemetry.metrics()
+        reg.counter_inc(n.COORD_STORE_REQUESTS_TOTAL, float(requests), op=op)
+        reg.counter_inc(n.COORD_STORE_SECONDS_TOTAL, seconds, op=op)
+    except Exception:  # noqa: BLE001 - telemetry must never break the store
+        pass
 
 
 @dataclass
@@ -110,43 +233,75 @@ class Store(abc.ABC):
     @abc.abstractmethod
     def delete(self, key: str) -> None: ...
 
+    # -- batched primitives ----------------------------------------------
+    #
+    # Default implementations degrade to per-key loops so every Store
+    # (including the JAX coordination-service adapter) supports them;
+    # stores with a wire protocol (TCPStore, and ShardedStore per
+    # member) override with ONE round trip per batch — the difference
+    # between a fan-out round's setup costing O(world) sequential
+    # requests and O(1).
+
+    def multi_set(self, items: Dict[str, bytes]) -> None:
+        for key, value in items.items():
+            self.set(key, value)
+
+    def multi_get(self, keys: Sequence[str]) -> Dict[str, Optional[bytes]]:
+        """Value per key (None where definitively absent), same failure
+        semantics as :meth:`try_get`."""
+        return {key: self.try_get(key) for key in keys}
+
+    def multi_delete(self, keys: Iterable[str]) -> None:
+        for key in keys:
+            self.delete(key)
+
     # -- blocking helpers -------------------------------------------------
 
-    def get(self, key: str, timeout: float = _DEFAULT_TIMEOUT_S) -> bytes:
+    def get(
+        self,
+        key: str,
+        timeout: float = _DEFAULT_TIMEOUT_S,
+        poll_cap: Optional[float] = None,
+    ) -> bytes:
+        """Blocking read with exponential poll backoff. ``poll_cap``
+        bounds the backoff (callers that know the world size pass
+        :func:`scaled_poll_cap` so a 2-proc collective keeps ~5 ms
+        detection latency; the default cap is the 100 ms ceiling)."""
         deadline = time.monotonic() + timeout
         reads = _TransientReads()
+        pacer = _PollPacer(cap=poll_cap)
         while True:
             val = reads.read(lambda: self.try_get(key))
             if val is not None:
                 return val
             if time.monotonic() > deadline:
                 raise StoreTimeoutError(f"Timed out waiting for store key {key!r}")
-            time.sleep(_POLL_INTERVAL_S)
+            pacer.sleep(deadline)
 
     def wait_any(
         self, keys: Sequence[str], timeout: float = _DEFAULT_TIMEOUT_S
     ) -> Dict[str, bytes]:
-        """Block until at least one of ``keys`` exists; returns all present."""
+        """Block until at least one of ``keys`` exists; returns all present.
+        Polls the whole key set in one batched round trip per tick."""
         deadline = time.monotonic() + timeout
         reads = _TransientReads()
+        pacer = _PollPacer()
         while True:
-            present = {}
-            for k in keys:
-                v = reads.read(lambda k=k: self.try_get(k))
-                if v is not None:
-                    present[k] = v
+            got = reads.read(lambda: self.multi_get(list(keys)))
+            present = {
+                k: v for k, v in (got or {}).items() if v is not None
+            }
             if present:
                 return present
             if time.monotonic() > deadline:
                 raise StoreTimeoutError(f"Timed out waiting for any of {keys!r}")
-            time.sleep(_POLL_INTERVAL_S)
+            pacer.sleep(deadline)
 
     # -- object collectives ----------------------------------------------
 
     def _cleanup(self, prefix: str, world_size: int, keys: List[str]) -> None:
         if self.add(f"{prefix}/__done", 1) == world_size:
-            for k in keys + [f"{prefix}/__done"]:
-                self.delete(k)
+            self.multi_delete(keys + [f"{prefix}/__done"])
 
     def exchange(
         self,
@@ -165,17 +320,21 @@ class Store(abc.ABC):
         leader's socket (the bytes are inherently O(world²) for an
         all-gather; the round-trips need not be).
         """
+        cap = scaled_poll_cap(world_size)
         self.set(f"{prefix}/{rank}", pickle.dumps(obj))
         if rank == 0:
             blobs = [
-                self.get(f"{prefix}/{i}", timeout) for i in range(world_size)
+                self.get(f"{prefix}/{i}", timeout, poll_cap=cap)
+                for i in range(world_size)
             ]
             out = [pickle.loads(b) for b in blobs]
             self.set(f"{prefix}/__all", pickle.dumps(blobs))
         else:
             out = [
                 pickle.loads(b)
-                for b in pickle.loads(self.get(f"{prefix}/__all", timeout))
+                for b in pickle.loads(
+                    self.get(f"{prefix}/__all", timeout, poll_cap=cap)
+                )
             ]
         self._cleanup(
             prefix,
@@ -211,10 +370,13 @@ class Store(abc.ABC):
             # The destination's own blob never touches the store (nobody
             # else reads it); the loads() keeps all-gather's copy
             # semantics for the local entry.
+            cap = scaled_poll_cap(world_size)
             out = [
                 pickle.loads(blob)
                 if i == rank
-                else pickle.loads(self.get(f"{prefix}/{i}", timeout))
+                else pickle.loads(
+                    self.get(f"{prefix}/{i}", timeout, poll_cap=cap)
+                )
                 for i in range(world_size)
             ]
         else:
@@ -240,7 +402,13 @@ class Store(abc.ABC):
             self.set(f"{prefix}/obj", pickle.dumps(obj))
             out = obj
         else:
-            out = pickle.loads(self.get(f"{prefix}/obj", timeout))
+            out = pickle.loads(
+                self.get(
+                    f"{prefix}/obj",
+                    timeout,
+                    poll_cap=scaled_poll_cap(world_size),
+                )
+            )
         self._cleanup(prefix, world_size, [f"{prefix}/obj"])
         return out
 
@@ -257,7 +425,11 @@ class Store(abc.ABC):
             assert objs is not None and len(objs) == world_size
             for i, o in enumerate(objs):
                 self.set(f"{prefix}/{i}", pickle.dumps(o))
-        out = pickle.loads(self.get(f"{prefix}/{rank}", timeout))
+        out = pickle.loads(
+            self.get(
+                f"{prefix}/{rank}", timeout, poll_cap=scaled_poll_cap(world_size)
+            )
+        )
         self._cleanup(prefix, world_size, [f"{prefix}/{i}" for i in range(world_size)])
         return out
 
@@ -271,7 +443,9 @@ class Store(abc.ABC):
         if self.add(f"{prefix}/arrive", 1) == world_size:
             self.set(f"{prefix}/go", b"1")
         else:
-            self.get(f"{prefix}/go", timeout)
+            self.get(
+                f"{prefix}/go", timeout, poll_cap=scaled_poll_cap(world_size)
+            )
         if self.add(f"{prefix}/depart", 1) == world_size:
             for k in (f"{prefix}/arrive", f"{prefix}/go", f"{prefix}/depart"):
                 self.delete(k)
@@ -282,6 +456,20 @@ class Store(abc.ABC):
 # ---------------------------------------------------------------------------
 
 _CMD_SET, _CMD_TRY_GET, _CMD_ADD, _CMD_DELETE = 0, 1, 2, 3
+# Batched commands: one frame each way per BATCH. arg carries the
+# key->value dict (multi_set) or key list (multi_get / multi_delete);
+# the scalar ``key`` slot of the request tuple is unused ("").
+_CMD_MULTI_SET, _CMD_MULTI_GET, _CMD_MULTI_DELETE = 4, 5, 6
+
+_CMD_OP_NAMES = {
+    _CMD_SET: "set",
+    _CMD_TRY_GET: "try_get",
+    _CMD_ADD: "add",
+    _CMD_DELETE: "delete",
+    _CMD_MULTI_SET: "multi_set",
+    _CMD_MULTI_GET: "multi_get",
+    _CMD_MULTI_DELETE: "multi_delete",
+}
 
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -316,6 +504,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 class _StoreServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5: a thousand-rank world
+    # connecting at once overflows the SYN queue and rides kernel
+    # connect retries for seconds. Size the backlog for the fleet.
+    request_queue_size = 1024
 
     def __init__(self, addr) -> None:
         super().__init__(addr, _StoreRequestHandler)
@@ -342,6 +534,15 @@ class _StoreRequestHandler(socketserver.BaseRequestHandler):
                         reply = new
                     elif cmd == _CMD_DELETE:
                         server.kv.pop(key, None)
+                        reply = None
+                    elif cmd == _CMD_MULTI_SET:
+                        server.kv.update(arg)
+                        reply = None
+                    elif cmd == _CMD_MULTI_GET:
+                        reply = {k: server.kv.get(k) for k in arg}
+                    elif cmd == _CMD_MULTI_DELETE:
+                        for k in arg:
+                            server.kv.pop(k, None)
                         reply = None
                     else:  # pragma: no cover
                         raise ValueError(f"bad store command {cmd}")
@@ -421,10 +622,15 @@ class TCPStore(Store):
         return self._sock
 
     def _request(self, cmd: int, key: str, arg: Any = None) -> Any:
+        t0 = time.monotonic()
         with self._sock_lock:
             sock = self._connect()
             _send_msg(sock, pickle.dumps((cmd, key, arg)))
-            return pickle.loads(_recv_msg(sock))
+            reply = pickle.loads(_recv_msg(sock))
+        _observe_store_requests(
+            _CMD_OP_NAMES.get(cmd, "other"), time.monotonic() - t0
+        )
+        return reply
 
     def set(self, key: str, value: bytes) -> None:
         self._request(_CMD_SET, key, value)
@@ -437,6 +643,15 @@ class TCPStore(Store):
 
     def delete(self, key: str) -> None:
         self._request(_CMD_DELETE, key)
+
+    def multi_set(self, items: Dict[str, bytes]) -> None:
+        self._request(_CMD_MULTI_SET, "", dict(items))
+
+    def multi_get(self, keys: Sequence[str]) -> Dict[str, Optional[bytes]]:
+        return self._request(_CMD_MULTI_GET, "", list(keys))
+
+    def multi_delete(self, keys: Iterable[str]) -> None:
+        self._request(_CMD_MULTI_DELETE, "", list(keys))
 
     def close(self) -> None:
         with self._sock_lock:
@@ -473,6 +688,148 @@ class InProcessStore(Store):
     def delete(self, key: str) -> None:
         with self._lock:
             self._kv.pop(key, None)
+
+    def multi_set(self, items: Dict[str, bytes]) -> None:
+        with self._lock:
+            self._kv.update(items)
+
+    def multi_get(self, keys: Sequence[str]) -> Dict[str, Optional[bytes]]:
+        with self._lock:
+            return {k: self._kv.get(k) for k in keys}
+
+    def multi_delete(self, keys: Iterable[str]) -> None:
+        with self._lock:
+            for k in keys:
+                self._kv.pop(k, None)
+
+
+# ---------------------------------------------------------------------------
+# Sharded store
+# ---------------------------------------------------------------------------
+
+
+def shard_for_key(key: str, num_shards: int) -> int:
+    """Deterministic key->shard routing (crc32, like the fan-out owner
+    table — ``hash()`` is process-randomized and MUST NOT be used here:
+    every rank has to route a key to the same shard)."""
+    return zlib.crc32(key.encode("utf-8", "surrogatepass")) % num_shards
+
+
+class ShardedStore(Store):
+    """N member stores behind deterministic key->shard hashing.
+
+    A single TCPStore hub serializes world x keys traffic through one
+    socket's accept/handler path; sharding spreads the key space over N
+    independent servers so coordination throughput scales with N. Every
+    primitive routes by :func:`shard_for_key`; per-key atomicity (``add``,
+    the collectives' cleanup counters) holds because a key always lands
+    on the same member. Batched ops are grouped per shard — one round
+    trip per *touched shard*, not per key. Collectives/barriers from the
+    base class work unchanged: they are built on the primitives.
+    """
+
+    def __init__(self, stores: Sequence[Store]) -> None:
+        if not stores:
+            raise ValueError("ShardedStore needs at least one member store")
+        self._stores: List[Store] = list(stores)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._stores)
+
+    def _member(self, key: str) -> Store:
+        return self._stores[shard_for_key(key, len(self._stores))]
+
+    def _group(self, keys: Iterable[str]) -> Dict[int, List[str]]:
+        grouped: Dict[int, List[str]] = {}
+        for key in keys:
+            grouped.setdefault(
+                shard_for_key(key, len(self._stores)), []
+            ).append(key)
+        return grouped
+
+    def set(self, key: str, value: bytes) -> None:
+        self._member(key).set(key, value)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        return self._member(key).try_get(key)
+
+    def add(self, key: str, amount: int) -> int:
+        return self._member(key).add(key, amount)
+
+    def delete(self, key: str) -> None:
+        self._member(key).delete(key)
+
+    def multi_set(self, items: Dict[str, bytes]) -> None:
+        for shard, keys in self._group(items).items():
+            self._stores[shard].multi_set({k: items[k] for k in keys})
+
+    def multi_get(self, keys: Sequence[str]) -> Dict[str, Optional[bytes]]:
+        out: Dict[str, Optional[bytes]] = {}
+        for shard, shard_keys in self._group(keys).items():
+            out.update(self._stores[shard].multi_get(shard_keys))
+        return out
+
+    def multi_delete(self, keys: Iterable[str]) -> None:
+        for shard, shard_keys in self._group(keys).items():
+            self._stores[shard].multi_delete(shard_keys)
+
+    def close(self) -> None:
+        for member in self._stores:
+            close = getattr(member, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+
+
+def bootstrap_sharded_store(
+    base: Store,
+    rank: int,
+    world_size: int,
+    num_shards: Optional[int] = None,
+    prefix: str = "__ts/shard_store",
+    timeout: float = _DEFAULT_TIMEOUT_S,
+) -> Store:
+    """Stand up a :class:`ShardedStore` of TCPStore members over an
+    existing coordination store (which only needs ``set``/``get``).
+
+    Rank 0's knob reading decides the shard count for the whole job —
+    published through ``base`` exactly like the TCPStore-bootstrap
+    address (the same agreement-by-broadcast discipline as the fan-out
+    nonce): env skew across ranks can never split the key space two
+    ways. Shard ``i`` is hosted by rank ``i % world_size``, so on a
+    multi-host pod the server sockets spread across hosts instead of
+    stacking on the leader. ``num_shards <= 1`` returns ``base``
+    unchanged (the packaged default)."""
+    if rank == 0:
+        if num_shards is None:
+            num_shards = knobs.get_store_shards()
+        num_shards = max(1, min(int(num_shards), world_size * 8))
+        base.set(f"{prefix}/n", str(num_shards).encode())
+    else:
+        num_shards = int(base.get(f"{prefix}/n", timeout))
+    if num_shards <= 1:
+        return base
+    members: List[Optional[Store]] = [None] * num_shards
+    for i in range(num_shards):
+        if i % world_size != rank:
+            continue
+        # THIS rank's own interface, not _routable_host(): its first
+        # choice is the coordinator (rank 0's) address, which is the
+        # wrong advert for a shard server bound on any other host.
+        host = _local_advertise_host()
+        tcp = TCPStore(host="0.0.0.0", port=0, is_server=True)
+        tcp.host = host
+        base.set(f"{prefix}/{i}", f"{host}:{tcp.port}".encode())
+        members[i] = tcp
+    for i in range(num_shards):
+        if members[i] is not None:
+            continue
+        host, port = base.get(f"{prefix}/{i}", timeout).decode().rsplit(":", 1)
+        members[i] = TCPStore(host=host, port=int(port), is_server=False)
+    return ShardedStore([m for m in members if m is not None])
 
 
 class JaxCoordinationStore(Store):
@@ -605,14 +962,20 @@ def jax_process_group():
         import jax
 
         rank = jax.process_index()
+        world = jax.process_count()
         kv = JaxCoordinationStore()
         store: Store = kv
         if not kv.supports_add():
             store = _bootstrap_tcp_store(kv, rank)
+        # Store sharding (docs/scaling.md): rank 0's knob decides the
+        # shard count for the whole job; the members bootstrap through
+        # the KV service like the TCPStore fallback. Default 1 = no-op.
+        if world > 1:
+            store = bootstrap_sharded_store(store, rank, world)
         _JAX_PG = ProcessGroup(
             store=store,
             rank=rank,
-            world_size=jax.process_count(),
+            world_size=world,
         )
         return _JAX_PG
 
@@ -622,10 +985,12 @@ _JAX_PG_LOCK = threading.Lock()
 
 
 def _routable_host() -> str:
-    """An address peers on other hosts can dial for this machine. The jax
-    coordinator address is best (rank 0 of jax.distributed hosts the
-    coordinator, and every process demonstrably reached it); else the
-    outbound-interface IP (UDP connect sends no traffic); hostname last."""
+    """An address peers on other hosts can dial for RANK 0's machine.
+    The jax coordinator address is best (rank 0 of jax.distributed
+    hosts the coordinator, and every process demonstrably reached it);
+    else this machine's own interface. Only correct on the rank that
+    hosts the coordinator — any-rank servers advertise via
+    :func:`_local_advertise_host` instead."""
     try:
         from jax._src import distributed
 
@@ -634,6 +999,17 @@ def _routable_host() -> str:
             return addr.rsplit(":", 1)[0]
     except Exception:
         pass
+    return _local_advertise_host()
+
+
+def _local_advertise_host() -> str:
+    """An address peers on other hosts can dial for THIS machine —
+    correct on any rank. Unlike :func:`_routable_host` (whose first
+    choice is the jax coordinator address — right only for the rank
+    that HOSTS the coordinator, i.e. rank 0's TCP-store bootstrap), a
+    per-rank server (shard store member, peer-tier cache) must
+    advertise its own interface: outbound-interface IP first (the UDP
+    connect sends no traffic), hostname last."""
     try:
         probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
@@ -695,6 +1071,10 @@ def lookup_endpoint(
         return None
     if raw is None:
         return None
+    return _parse_endpoint(raw)
+
+
+def _parse_endpoint(raw: bytes) -> Optional[Tuple[str, int]]:
     try:
         host, port = raw.decode().rsplit(":", 1)
         return host, int(port)
@@ -702,21 +1082,65 @@ def lookup_endpoint(
         return None
 
 
+def lookup_endpoints(
+    store: Store, service: str, ranks: Iterable[int]
+) -> Dict[int, Tuple[str, int]]:
+    """Batched registry resolve: every advertised ``(host, port)`` for
+    ``ranks``, in ONE ``multi_get`` round trip — restore setup resolving
+    a thousand surviving peers costs one store request, not a thousand
+    sequential lookups. Ranks that never published (or whose entries are
+    garbage) are simply absent from the result; a failed store read
+    returns ``{}`` (same "no endpoint, never raise" contract as
+    :func:`lookup_endpoint`). The resolve wall time feeds the
+    ``coordination_endpoint_seconds_total`` counter."""
+    rank_list = list(ranks)
+    keys = [f"{_ENDPOINT_PREFIX}/{service}/{r}" for r in rank_list]
+    t0 = time.monotonic()
+    try:
+        got = store.multi_get(keys)
+    except Exception:
+        return {}
+    finally:
+        try:
+            telemetry, n, _ = _tele_modules()
+            telemetry.metrics().counter_inc(
+                n.COORD_ENDPOINT_SECONDS_TOTAL,
+                time.monotonic() - t0,
+                service=service,
+            )
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+    out: Dict[int, Tuple[str, int]] = {}
+    for rank, key in zip(rank_list, keys):
+        raw = got.get(key)
+        if raw is None:
+            continue
+        parsed = _parse_endpoint(raw)
+        if parsed is not None:
+            out[rank] = parsed
+    return out
+
+
 # ---------------------------------------------------------------------------
-# LinearBarrier
+# Barriers
 # ---------------------------------------------------------------------------
 
 
-class LinearBarrier:
-    """Two-phase leader-centric barrier with error propagation.
-
-    Reference parity: dist_store.py:91-196. Usable off the main thread (the
-    async-snapshot commit thread must not run collectives). Phase one
-    (``arrive``): followers deposit, the leader collects all deposits then
-    releases. Phase two (``depart``): mirrored. ``report_error`` poisons the
-    barrier: every peer's pending/future wait raises :class:`BarrierError`
-    so no rank commits.
+class StoreBarrier:
+    """Shared two-phase (arrive/depart) barrier machinery with error
+    propagation. Subclasses implement ``_phase`` (the rendezvous
+    topology) and ``_cleanup`` (post-depart key removal); the contract —
+    usable off the main thread, ``report_error`` poisons every peer's
+    pending/future wait with :class:`BarrierError`, ``depart`` before
+    ``arrive`` raises — is identical across topologies, so call sites
+    (snapshot.py's ``_nonce_barrier``, fanout rounds) swap
+    transparently via :func:`make_barrier`. Every phase is traced
+    (``barrier:arrive``/``barrier:depart`` spans) and its wall time
+    feeds ``coordination_barrier_wait_seconds_total`` — the evidence the
+    ``coordination-bound`` doctor rule cites.
     """
+
+    _IMPL = "base"
 
     def __init__(
         self, prefix: str, store: Store, rank: int, world_size: int
@@ -747,40 +1171,137 @@ class LinearBarrier:
             ) from exc
 
     def _wait_for(self, key: str, timeout: float) -> None:
+        """Deadline-aware wait with exponential poll backoff (see
+        ``_PollPacer``): a 1000-rank barrier parked here must idle at
+        ~10 QPS per rank, not 200/s."""
         deadline = time.monotonic() + timeout
         reads = _TransientReads()
+        pacer = _PollPacer(cap=scaled_poll_cap(self.world_size))
         while True:
-            self._check_error(reads)
-            if reads.read(lambda: self.store.try_get(key)) is not None:
-                return
+            got = reads.read(
+                lambda: self.store.multi_get([self._key("error"), key])
+            )
+            if got is not None:
+                err = got.get(self._key("error"))
+                if err is not None:
+                    self._raise_peer_error(err)
+                if got.get(key) is not None:
+                    return
             if time.monotonic() > deadline:
                 raise StoreTimeoutError(
                     f"Rank {self.rank} timed out in barrier {self.prefix!r} "
                     f"waiting for {key!r}"
                 )
-            time.sleep(_POLL_INTERVAL_S)
+            pacer.sleep(deadline)
+
+    def _raise_peer_error(self, payload: bytes) -> None:
+        exc = pickle.loads(payload)
+        raise BarrierError(
+            f"Rank {self.rank}: a peer reported an error into barrier "
+            f"{self.prefix!r}"
+        ) from exc
 
     def _wait_count(self, key: str, target: int, timeout: float) -> None:
-        """Poll ONE counter key until it reaches ``target``: the leader's
-        wait is O(1) store requests per poll regardless of world size
+        """Poll ONE counter key until it reaches ``target``: the waiter's
+        cost is O(1) store requests per poll regardless of world size
         (a per-rank-key scan would be world−1 sequential requests per
-        5 ms tick — minutes of pure polling on a large pod)."""
+        tick — minutes of pure polling on a large pod). Error key and
+        counter ride one batched round trip."""
         if target <= 0:
             self._check_error()
             return
         deadline = time.monotonic() + timeout
         reads = _TransientReads()
+        pacer = _PollPacer(cap=scaled_poll_cap(self.world_size))
         while True:
-            self._check_error(reads)
-            val = reads.read(lambda: self.store.try_get(key))
-            if val is not None and int(val) >= target:
-                return
+            got = reads.read(
+                lambda: self.store.multi_get([self._key("error"), key])
+            )
+            if got is not None:
+                err = got.get(self._key("error"))
+                if err is not None:
+                    self._raise_peer_error(err)
+                val = got.get(key)
+                if val is not None and int(val) >= target:
+                    return
             if time.monotonic() > deadline:
                 raise StoreTimeoutError(
                     f"Rank {self.rank} timed out in barrier {self.prefix!r} "
                     f"waiting for {key!r} to reach {target}"
                 )
-            time.sleep(_POLL_INTERVAL_S)
+            pacer.sleep(deadline)
+
+    def _phase(self, phase: str, timeout: float) -> None:
+        raise NotImplementedError
+
+    def _cleanup(self, timeout: float) -> None:
+        raise NotImplementedError
+
+    def _observed_phase(self, phase: str, timeout: float) -> None:
+        t0 = time.monotonic()
+        token = None
+        tele = n = trace = None
+        try:
+            tele, n, trace = _tele_modules()
+            token = trace.get_recorder().begin(
+                n.SPAN_BARRIER_ARRIVE
+                if phase == "arrive"
+                else n.SPAN_BARRIER_DEPART,
+                prefix=self.prefix,
+                rank=self.rank,
+                world=self.world_size,
+                impl=self._IMPL,
+            )
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            token = None
+        try:
+            self._phase(phase, timeout)
+        finally:
+            try:
+                if token is not None:
+                    trace.get_recorder().end(token)
+                if tele is not None:
+                    tele.metrics().counter_inc(
+                        n.COORD_BARRIER_WAIT_SECONDS_TOTAL,
+                        time.monotonic() - t0,
+                        phase=phase,
+                        impl=self._IMPL,
+                    )
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                pass
+
+    def arrive(self, timeout: float = _DEFAULT_TIMEOUT_S) -> None:
+        self._observed_phase("arrive", timeout)
+        self._arrived = True
+
+    def depart(self, timeout: float = _DEFAULT_TIMEOUT_S) -> None:
+        if not self._arrived:
+            raise RuntimeError("depart() called before arrive()")
+        self._observed_phase("depart", timeout)
+        self._cleanup(timeout)
+
+    def report_error(self, exc: BaseException) -> None:
+        try:
+            payload = pickle.dumps(exc)
+        except Exception:
+            payload = pickle.dumps(RuntimeError(repr(exc)))
+        self.store.set(self._key("error"), payload)
+
+
+class LinearBarrier(StoreBarrier):
+    """Two-phase leader-centric barrier with error propagation.
+
+    Reference parity: dist_store.py:91-196. Phase one (``arrive``):
+    followers deposit into one counter, the leader observes all deposits
+    then releases one ``go`` key. Phase two (``depart``): mirrored. Kept
+    behind the ``TORCHSNAPSHOT_TPU_TREE_BARRIER=0`` kill switch (see
+    :func:`make_barrier`): per-rank round trips are O(1), but every rank
+    rendezvouses on the leader's two keys, so at large world sizes the
+    hub store serializes world waiters per phase — the wall the
+    scale-model bench convicts (docs/scaling.md).
+    """
+
+    _IMPL = "linear"
 
     def _phase(self, phase: str, timeout: float) -> None:
         if self.rank == 0:
@@ -793,16 +1314,6 @@ class LinearBarrier:
             self.store.add(self._key(f"{phase}/count"), 1)
             self._wait_for(self._key(f"{phase}/go"), timeout)
 
-    def arrive(self, timeout: float = _DEFAULT_TIMEOUT_S) -> None:
-        self._phase("arrive", timeout)
-        self._arrived = True
-
-    def depart(self, timeout: float = _DEFAULT_TIMEOUT_S) -> None:
-        if not self._arrived:
-            raise RuntimeError("depart() called before arrive()")
-        self._phase("depart", timeout)
-        self._cleanup(timeout)
-
     def _cleanup(self, timeout: float) -> None:
         """Best-effort removal of this barrier's keys after a successful
         depart so a long-lived store doesn't accumulate them. Followers ack
@@ -814,16 +1325,106 @@ class LinearBarrier:
             self._wait_count(
                 self._key("done/count"), self.world_size - 1, timeout
             )
-            for phase in ("arrive", "depart", "done"):
-                self.store.delete(self._key(f"{phase}/count"))
-                self.store.delete(self._key(f"{phase}/go"))
-            self.store.delete(self._key("error"))
+            self.store.multi_delete(
+                [
+                    self._key(f"{phase}/{part}")
+                    for phase in ("arrive", "depart", "done")
+                    for part in ("count", "go")
+                ]
+                + [self._key("error")]
+            )
         except Exception:  # pragma: no cover - cleanup must never fail a commit
             pass
 
-    def report_error(self, exc: BaseException) -> None:
+
+class TreeBarrier(StoreBarrier):
+    """Tree-structured two-phase barrier: O(log_k world) critical path,
+    no key with more than ``fanout`` writers or readers.
+
+    Ranks form an implicit ``fanout``-ary tree (children of ``r`` are
+    ``r*k+1 .. r*k+k``). Per phase, a rank (1) waits for its own counter
+    to reach its child count — each child increments it only after its
+    whole subtree arrived — (2) increments its parent's counter, (3)
+    waits for its release key, then (4) releases its children with one
+    batched ``multi_set``. The aggregate store load stays O(world) per
+    phase (it must — every rank signals once), but it spreads over
+    world/k distinct keys (shardable via :class:`ShardedStore`) instead
+    of rendezvousing on the leader's one counter, and the release wave
+    is a k-way broadcast tree instead of world ranks polling one key.
+
+    Same contract as :class:`LinearBarrier` (``report_error`` poisons
+    every pending wait via the shared ``{prefix}/error`` key, which is
+    also the error channel fan-out rounds poll).
+    """
+
+    _IMPL = "tree"
+
+    def __init__(
+        self,
+        prefix: str,
+        store: Store,
+        rank: int,
+        world_size: int,
+        fanout: Optional[int] = None,
+    ) -> None:
+        super().__init__(prefix, store, rank, world_size)
+        if fanout is None:
+            fanout = knobs.get_barrier_fanout()
+        self.fanout = max(2, int(fanout))
+
+    def _children(self) -> List[int]:
+        base = self.rank * self.fanout
+        return [
+            child
+            for child in range(base + 1, base + self.fanout + 1)
+            if child < self.world_size
+        ]
+
+    def _phase(self, phase: str, timeout: float) -> None:
+        children = self._children()
+        if children:
+            self._wait_count(
+                self._key(f"{phase}/c/{self.rank}"), len(children), timeout
+            )
+        if self.rank != 0:
+            self._check_error()
+            parent = (self.rank - 1) // self.fanout
+            self.store.add(self._key(f"{phase}/c/{parent}"), 1)
+            self._wait_for(self._key(f"{phase}/go/{self.rank}"), timeout)
+        if children:
+            self.store.multi_set(
+                {self._key(f"{phase}/go/{child}"): b"1" for child in children}
+            )
+
+    def _cleanup(self, timeout: float) -> None:
+        """Each rank deletes ITS OWN keys — no done-counter rendezvous
+        needed: a rank's counter was last written before it observed the
+        target (children increment before waiting for release), and its
+        release key was last written before it returned from the wait,
+        so after this rank's depart nobody touches them again."""
         try:
-            payload = pickle.dumps(exc)
-        except Exception:
-            payload = pickle.dumps(RuntimeError(repr(exc)))
-        self.store.set(self._key("error"), payload)
+            keys = [
+                self._key(f"{phase}/{part}/{self.rank}")
+                for phase in ("arrive", "depart")
+                for part in ("c", "go")
+            ]
+            if self.rank == 0:
+                keys.append(self._key("error"))
+            self.store.multi_delete(keys)
+        except Exception:  # pragma: no cover - cleanup must never fail a commit
+            pass
+
+
+def make_barrier(
+    prefix: str, store: Store, rank: int, world_size: int
+) -> StoreBarrier:
+    """The blessed barrier constructor for every coordination phase:
+    :class:`TreeBarrier` (default; fanout from
+    ``TORCHSNAPSHOT_TPU_BARRIER_FANOUT``) unless the
+    ``TORCHSNAPSHOT_TPU_TREE_BARRIER=0`` kill switch selects the
+    leader-centric :class:`LinearBarrier`. Rank-uniform inputs only —
+    both knobs are tunables the autotuner moves through the broadcast
+    vector, so geometries can't mix mid-run."""
+    if knobs.is_tree_barrier_enabled():
+        return TreeBarrier(prefix, store, rank, world_size)
+    return LinearBarrier(prefix, store, rank, world_size)

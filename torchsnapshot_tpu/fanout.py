@@ -46,16 +46,16 @@ import pickle
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import knobs
-from .dist_store import Store, StoreTimeoutError
+from . import knobs, telemetry
+from .dist_store import Store, StoreTimeoutError, _PollPacer, scaled_poll_cap
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO
+from .telemetry.trace import get_recorder as _trace_recorder
 from .manifest import Manifest, sharded_blob_windows
 from .resharding import assign_shard_owners
 
 logger: logging.Logger = logging.getLogger(__name__)
 
 _DEFAULT_TIMEOUT_S = 300.0
-_POLL_INTERVAL_S = 0.005
 
 
 class FanoutError(RuntimeError):
@@ -129,27 +129,60 @@ class FanoutRestoreContext:
 
     def _poll(self, key: str, error_key: str, timeout: float) -> bytes:
         """Wait for ``key``, aborting fast if any peer poisons the
-        round's error key (the ``LinearBarrier.report_error`` channel
-        the enclosing ``_reporting_to`` writes on failure)."""
+        round's error key (the barrier ``report_error`` channel the
+        enclosing ``_reporting_to`` writes on failure). Error key and
+        data key ride ONE batched round trip per tick, with the shared
+        exponential poll backoff."""
+        out: Dict[str, bytes] = {}
+        self._poll_all([key], error_key, timeout, out.__setitem__)
+        return out[key]
+
+    def _poll_all(
+        self,
+        keys: List[str],
+        error_key: str,
+        timeout: float,
+        consume,
+    ) -> None:
+        """Batched wait for EVERY key in ``keys``: one ``multi_get``
+        round trip per tick over the error key plus the still-missing
+        keys — a thousand-rank needs-gather costs the leader one
+        request per tick, not world sequential scans — calling
+        ``consume(key, value)`` as each key lands (arrival order, so
+        owner-published windows are consumed while stragglers publish).
+        """
         assert self.store is not None
+        pending = list(keys)
         deadline = time.monotonic() + timeout
-        while True:
-            err = self.store.try_get(error_key)
+        pacer = _PollPacer(cap=scaled_poll_cap(self.world_size))
+        while pending:
+            got = self.store.multi_get([error_key] + pending)
+            err = got.get(error_key)
             if err is not None:
                 exc = pickle.loads(err)
                 raise FanoutError(
                     f"rank {self.rank}: a peer reported an error into the "
                     f"fan-out round ({error_key!r})"
                 ) from exc
-            val = self.store.try_get(key)
-            if val is not None:
-                return val
+            still: List[str] = []
+            for key in pending:
+                val = got.get(key)
+                if val is None:
+                    still.append(key)
+                else:
+                    consume(key, val)
+            if not still:
+                return
+            if len(still) < len(pending):
+                pacer.reset()  # progress: keep first-poll latency low
+            pending = still
             if time.monotonic() > deadline:
                 raise StoreTimeoutError(
                     f"rank {self.rank} timed out in fan-out exchange "
-                    f"waiting for {key!r}"
+                    f"waiting for {pending[:3]!r}"
+                    + (f" (+{len(pending) - 3} more)" if len(pending) > 3 else "")
                 )
-            time.sleep(_POLL_INTERVAL_S)
+            pacer.sleep(deadline)
 
     def exchange(
         self,
@@ -167,6 +200,36 @@ class FanoutRestoreContext:
         round. Returns the locations cached for this rank (for
         :meth:`drop`)."""
         assert self.store is not None
+        t0 = time.monotonic()
+        span = _trace_recorder().begin(
+            telemetry.names.SPAN_FANOUT_EXCHANGE,
+            prefix=rendezvous_prefix,
+            rank=self.rank,
+            world=self.world_size,
+            reqs=len(read_reqs),
+        )
+        try:
+            return self._exchange_impl(
+                read_reqs, storage, event_loop, rendezvous_prefix, timeout
+            )
+        finally:
+            _trace_recorder().end(span)
+            try:
+                telemetry.metrics().counter_inc(
+                    telemetry.names.COORD_EXCHANGE_SECONDS_TOTAL,
+                    time.monotonic() - t0,
+                )
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                pass
+
+    def _exchange_impl(
+        self,
+        read_reqs: List[ReadReq],
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        rendezvous_prefix: str,
+        timeout: float = _DEFAULT_TIMEOUT_S,
+    ) -> List[str]:
         p = f"{rendezvous_prefix}/fanout"
         error_key = f"{rendezvous_prefix}/error"
         needs = self._needs_for(read_reqs)
@@ -174,13 +237,27 @@ class FanoutRestoreContext:
         # Needs gather, rank 0 aggregating (the Store.exchange shape,
         # re-built here so every wait is error-aware and every key is
         # round-scoped): each rank publishes its needs; rank 0 combines
-        # and republishes; everyone reads the combined doc.
+        # the FULL table and republishes it as one doc; everyone reads
+        # the combined doc. Batched end to end: rank 0 polls every
+        # peer's needs key in one multi_get round trip per tick (not a
+        # per-peer sequential scan) and tears the per-rank keys down
+        # with one multi_delete — O(1) round trips per rank per round.
         if self.rank == 0:
-            gathered: List[Dict[str, Tuple[int, int]]] = [needs]
-            for peer in range(1, self.world_size):
-                key = f"{p}/needs/{peer}"
-                gathered.append(pickle.loads(self._poll(key, error_key, timeout)))
-                self.store.delete(key)
+            peer_keys = [
+                f"{p}/needs/{peer}" for peer in range(1, self.world_size)
+            ]
+            by_key: Dict[str, Dict[str, Tuple[int, int]]] = {}
+            self._poll_all(
+                peer_keys,
+                error_key,
+                timeout,
+                lambda k, v: by_key.__setitem__(k, pickle.loads(v)),
+            )
+            if peer_keys:
+                self.store.multi_delete(peer_keys)
+            gathered: List[Dict[str, Tuple[int, int]]] = [needs] + [
+                by_key[k] for k in peer_keys
+            ]
             self.store.set(f"{p}/needs/__all", pickle.dumps(gathered))
         else:
             self.store.set(f"{p}/needs/{self.rank}", pickle.dumps(needs))
@@ -188,8 +265,9 @@ class FanoutRestoreContext:
                 self._poll(f"{p}/needs/__all", error_key, timeout)
             )
         if self.store.add(f"{p}/needs/__all_done", 1) == self.world_size:
-            self.store.delete(f"{p}/needs/__all")
-            self.store.delete(f"{p}/needs/__all_done")
+            self.store.multi_delete(
+                [f"{p}/needs/__all", f"{p}/needs/__all_done"]
+            )
 
         union: Dict[str, Tuple[int, int]] = {}
         needy: Dict[str, List[int]] = {}
@@ -238,21 +316,28 @@ class FanoutRestoreContext:
                     # The error rides the data channel itself (on top
                     # of the barrier error key the caller will poison),
                     # so consumers already polling this blob abort now.
-                    for peer in consumers:
-                        self.store.set(
-                            f"{p}/blob/{idx}/{peer}",
-                            pickle.dumps(("error", None, repr(e))),
+                    # One batched publication for all consumers.
+                    if consumers:
+                        marker = pickle.dumps(("error", None, repr(e)))
+                        self.store.multi_set(
+                            {
+                                f"{p}/blob/{idx}/{peer}": marker
+                                for peer in consumers
+                            }
                         )
                     raise
                 self.bytes_fetched += len(data)
+                # One multi_set round trip publishes every needy peer's
+                # sub-window for this blob — per-key sets would cost the
+                # owner O(consumers) sequential round trips per blob.
+                payloads: Dict[str, bytes] = {}
                 for peer in consumers:
                     plo, phi = gathered[peer][loc]
-                    self.store.set(
-                        f"{p}/blob/{idx}/{peer}",
-                        pickle.dumps(
-                            ("ok", (plo, phi), data[plo - lo : phi - lo])
-                        ),
+                    payloads[f"{p}/blob/{idx}/{peer}"] = pickle.dumps(
+                        ("ok", (plo, phi), data[plo - lo : phi - lo])
                     )
+                if payloads:
+                    self.store.multi_set(payloads)
                 if loc in needs:
                     self.cache[loc] = ((lo, hi), data)
 
@@ -271,17 +356,23 @@ class FanoutRestoreContext:
             cached.extend(loc for _, loc in owned if loc in needs)
 
         # Phase B — consume what peers own for us. Strictly this rank's
-        # sub-windows: one key per (blob, consumer), deleted by its
-        # single reader, so nothing lingers in the store and received
-        # bytes equal this rank's actual needs.
+        # sub-windows: one key per (blob, consumer), polled as ONE
+        # batched multi_get per tick (consumed in arrival order, so a
+        # fast owner's windows land while a slow one still fetches) and
+        # torn down with one multi_delete — nothing lingers in the
+        # store and received bytes equal this rank's actual needs.
+        awaited: Dict[str, str] = {}
         for idx, loc in enumerate(locs):
             if self.owners[loc] == self.rank or loc not in needs:
                 continue
-            key = f"{p}/blob/{idx}/{self.rank}"
-            status, window, data = pickle.loads(
-                self._poll(key, error_key, timeout)
-            )
-            self.store.delete(key)
+            awaited[f"{p}/blob/{idx}/{self.rank}"] = loc
+
+        consumed: List[str] = []
+
+        def _consume(key: str, raw: bytes) -> None:
+            consumed.append(key)
+            loc = awaited[key]
+            status, window, data = pickle.loads(raw)
             if status == "error":
                 raise FanoutError(
                     f"fan-out restore owner rank {self.owners[loc]} failed "
@@ -290,6 +381,17 @@ class FanoutRestoreContext:
             self.bytes_received += len(data)
             self.cache[loc] = (tuple(window), data)
             cached.append(loc)
+
+        if awaited:
+            try:
+                self._poll_all(list(awaited), error_key, timeout, _consume)
+            finally:
+                # Tear down what we actually read, even on the error
+                # path (an owner's error marker is consumed too); keys
+                # we never saw stay for their owner — the round is
+                # nonce-scoped either way.
+                if consumed:
+                    self.store.multi_delete(consumed)
         return cached
 
     def drop(self, locations: List[str]) -> None:

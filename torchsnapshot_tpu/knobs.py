@@ -73,6 +73,9 @@ _WRITE_VECTORIZED_ENV = "TORCHSNAPSHOT_TPU_WRITE_VECTORIZED"
 _FS_DIRECT_IO_ENV = "TORCHSNAPSHOT_TPU_FS_DIRECT_IO"
 _CAS_ENV = "TORCHSNAPSHOT_TPU_CAS"
 _CAS_GC_GRACE_ENV = "TORCHSNAPSHOT_TPU_CAS_GC_GRACE_SECONDS"
+_TREE_BARRIER_ENV = "TORCHSNAPSHOT_TPU_TREE_BARRIER"
+_BARRIER_FANOUT_ENV = "TORCHSNAPSHOT_TPU_BARRIER_FANOUT"
+_STORE_SHARDS_ENV = "TORCHSNAPSHOT_TPU_STORE_SHARDS"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -80,6 +83,12 @@ _DEFAULT_WAIT_DURABLE_TIMEOUT_SECONDS: float = 1800.0
 _DEFAULT_PROGRESS_SECONDS: float = 1.0
 _DEFAULT_HISTORY_MAX_RECORDS: int = 512
 _DEFAULT_LEDGER_MAX_RECORDS: int = 4096
+
+# Fanout 16 measured best at world 256 over TCP in the scale-model
+# sweep (depth 2 up to 4096 ranks; 8 pays an extra level's release
+# latency, 32 re-concentrates arrivals) — see docs/scaling.md.
+_DEFAULT_BARRIER_FANOUT: int = 16
+_DEFAULT_STORE_SHARDS: int = 1
 
 _DEFAULT_PEER_RING_OFFSET: int = 1
 _DEFAULT_PEER_CACHE_BUDGET_BYTES: int = 1024 * 1024 * 1024
@@ -581,6 +590,41 @@ def is_fs_direct_io_enabled() -> bool:
     return _get_tunable_int(_FS_DIRECT_IO_ENV, 0) != 0
 
 
+def is_tree_barrier_enabled() -> bool:
+    """Tree-structured coordination barriers (docs/scaling.md), default
+    ON: every store barrier (``dist_store.make_barrier`` — the take
+    commit, restore key, and async plan/apply rendezvous) aggregates
+    arrive/depart through a fanout-``k`` rank tree, so no single store
+    key serializes more than ``k`` ranks and the critical path is
+    O(log_k world). Set to ``"0"`` to fall back to the leader-centric
+    :class:`~torchsnapshot_tpu.dist_store.LinearBarrier` (the
+    pre-scale-model behavior — the bisecting kill switch). Rank 0's
+    tunable broadcast keeps the choice job-uniform when the autotuner
+    is on; the error-propagation contract is identical either way."""
+    return os.environ.get(_TREE_BARRIER_ENV, "1") != "0"
+
+
+def get_barrier_fanout() -> int:
+    """Tree-barrier branching factor ``k``: per phase a rank waits on at
+    most ``k`` children and releases at most ``k`` — latency is
+    O(k·log_k world) store waits deep. Small k = deeper tree, less
+    per-key contention; large k degrades toward the linear barrier.
+    Tunable: the autotuner may move it (env always wins)."""
+    return max(2, _get_tunable_int(_BARRIER_FANOUT_ENV, _DEFAULT_BARRIER_FANOUT))
+
+
+def get_store_shards() -> int:
+    """Coordination-store shard count (docs/scaling.md): >1 bootstraps
+    that many TCPStore servers (spread across ranks) behind
+    deterministic key->shard hashing, so the hub socket stops
+    serializing world x keys traffic. Rank 0's reading decides for the
+    whole job (published through the base store at bootstrap, like the
+    fan-out nonce). Default 1 = the single-hub behavior. Tunable: the
+    autotuner may move it — it takes effect at the next store
+    bootstrap, not mid-run."""
+    return max(1, _get_tunable_int(_STORE_SHARDS_ENV, _DEFAULT_STORE_SHARDS))
+
+
 def get_memory_budget_fraction() -> float:
     """Fraction of *available* host memory the per-process staging
     budget may claim (scheduler.get_process_memory_budget_bytes; the
@@ -610,6 +654,8 @@ def tunable_snapshot() -> Dict[str, Union[int, float]]:
         "slab_size_threshold_bytes": get_slab_size_threshold_bytes(),
         "write_vectorized": int(is_write_vectorized_enabled()),
         "fs_direct_io": int(is_fs_direct_io_enabled()),
+        "barrier_fanout": get_barrier_fanout(),
+        "store_shards": get_store_shards(),
     }
 
 
@@ -970,6 +1016,32 @@ def enable_fs_direct_io() -> Generator[None, None, None]:
 @contextlib.contextmanager
 def disable_fs_direct_io() -> Generator[None, None, None]:
     with _override_env(_FS_DIRECT_IO_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def enable_tree_barrier() -> Generator[None, None, None]:
+    with _override_env(_TREE_BARRIER_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def disable_tree_barrier() -> Generator[None, None, None]:
+    """Force the leader-centric LinearBarrier for the block (the
+    kill-switch path; scale-model baselines and bisects use it)."""
+    with _override_env(_TREE_BARRIER_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_barrier_fanout(fanout: int) -> Generator[None, None, None]:
+    with _override_env(_BARRIER_FANOUT_ENV, str(fanout)):
+        yield
+
+
+@contextlib.contextmanager
+def override_store_shards(n: int) -> Generator[None, None, None]:
+    with _override_env(_STORE_SHARDS_ENV, str(n)):
         yield
 
 

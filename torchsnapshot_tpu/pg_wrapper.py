@@ -17,7 +17,7 @@ import threading
 import weakref
 from typing import Any, List, Optional, Sequence
 
-from .dist_store import Store
+from .dist_store import Store, make_barrier
 
 logger: logging.Logger = logging.getLogger(__name__)
 
@@ -80,9 +80,17 @@ class PGWrapper:
         if self.world_size == 1:
             return
         assert self.store is not None
-        self.store.barrier(
-            self._next_prefix("barrier"), self.rank, self.world_size
+        # Rides make_barrier like every snapshot-phase rendezvous: the
+        # O(log world) tree by default (no key with more than fanout
+        # waiters — at a thousand ranks the old single go-key release
+        # was a thundering herd on one hub socket), LinearBarrier
+        # behind the same kill switch.
+        b = make_barrier(
+            self._next_prefix("barrier"), self.store, self.rank,
+            self.world_size,
         )
+        b.arrive()
+        b.depart()
 
     def all_gather_object(self, obj: Any) -> List[Any]:
         """Gather one picklable object per rank, returned in rank order."""

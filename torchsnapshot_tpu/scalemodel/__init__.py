@@ -1,0 +1,24 @@
+"""Thousand-rank scale model for the coordination plane.
+
+Hundreds (tests) to a thousand (slow sweep / bench) of simulated ranks
+— threads with mocked device state — drive the REAL coordination code
+paths (``dist_store`` barriers and collectives, ``pg_wrapper``,
+``fanout`` owner-table exchange rounds, the peer tier's endpoint
+registry) through save/restore/preemption storms, attributing
+coordination wall time per structure vs world size. This is what lets
+the O(world) walls (leader-centric barriers, per-key store scans,
+single-hub sockets) be *measured* and their fixes (TreeBarrier, batched
+``multi_*`` store ops, ShardedStore) be held to curves instead of
+vibes: ``benchmarks/coordination_scaling.py`` runs the same storms as
+bench leg 10, and ``tests/test_scalemodel.py`` pins correctness under
+injected rank death. See docs/scaling.md.
+"""
+
+from .harness import (  # noqa: F401
+    CountingStore,
+    PerKeyStore,
+    SimulatedPreemption,
+    StormConfig,
+    StormResult,
+    run_storm,
+)

@@ -11,7 +11,7 @@ Reference parity: torchsnapshot/snapshot.py (991 LoC). Same protocol shape:
   consistent device snapshot is pinned (on-device clones, dispatched),
   and staging (D2H + serialization), storage I/O and the commit all run
   on a background thread coordinated by a store-based
-  :class:`LinearBarrier` (never collectives — reference
+  store barrier (never collectives — reference
   snapshot.py:948). ``wait(phase=)`` exposes the staged/committed
   boundaries; docs/async.md has the full phase model.
 - ``restore``: per-stateful memory-frugal load — current leaves are reused
@@ -46,7 +46,7 @@ from .telemetry.trace import (
     export_op_trace,
     get_recorder as _trace_recorder,
 )
-from .dist_store import LinearBarrier
+from .dist_store import StoreBarrier, make_barrier
 from .flatten import flatten, inflate
 from .io_preparer import (
     ArrayIOPreparer,
@@ -85,23 +85,29 @@ logger: logging.Logger = logging.getLogger(__name__)
 SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
 
 
-def _nonce_barrier(prefix: str, pg_wrapper: "PGWrapper") -> Optional[LinearBarrier]:
+def _nonce_barrier(prefix: str, pg_wrapper: "PGWrapper") -> Optional[StoreBarrier]:
     """The error-propagating rendezvous used by every distributed phase
     (take commit, restore keys, async plan/apply), built one way so the
-    phases can never diverge in barrier wiring. None single-process."""
+    phases can never diverge in barrier wiring. None single-process.
+    ``make_barrier`` resolves the topology: the O(log world)
+    :class:`~torchsnapshot_tpu.dist_store.TreeBarrier` by default,
+    ``LinearBarrier`` behind the ``TORCHSNAPSHOT_TPU_TREE_BARRIER=0``
+    kill switch — the contract (``report_error`` poison,
+    ``BarrierError`` on every pending wait) is identical, so the phases
+    swap topologies without caring."""
     if pg_wrapper.get_world_size() <= 1:
         return None
     assert pg_wrapper.store is not None
-    return LinearBarrier(
-        prefix=prefix,
-        store=pg_wrapper.store,
-        rank=pg_wrapper.get_rank(),
-        world_size=pg_wrapper.get_world_size(),
+    return make_barrier(
+        prefix,
+        pg_wrapper.store,
+        pg_wrapper.get_rank(),
+        pg_wrapper.get_world_size(),
     )
 
 
 @contextlib.contextmanager
-def _reporting_to(barrier: Optional["LinearBarrier"], what: str):
+def _reporting_to(barrier: Optional["StoreBarrier"], what: str):
     """Fail-fast discipline shared by every distributed phase: an error
     raised inside the block is reported into ``barrier`` (best-effort)
     before propagating, so peers waiting there abandon within seconds
@@ -915,7 +921,7 @@ class Snapshot:
         op_error: Optional[BaseException] = None
         pipeline_sink: List[dict] = []
 
-        def key_barrier(i: int) -> Optional[LinearBarrier]:
+        def key_barrier(i: int) -> Optional[StoreBarrier]:
             if restore_nonce is None:
                 return None
             return _nonce_barrier(
@@ -1109,7 +1115,7 @@ class Snapshot:
                 (uuid.uuid4().hex, knobs.is_fanout_restore_enabled())
             )
 
-        def plan_barrier(i: int) -> Optional[LinearBarrier]:
+        def plan_barrier(i: int) -> Optional[StoreBarrier]:
             if restore_nonce is None:
                 return None
             return _nonce_barrier(
@@ -1702,7 +1708,7 @@ class PendingSnapshot:
 
     A background thread drains staging (for device-snapshot takes) and
     storage I/O, synchronizes through a store-based
-    :class:`LinearBarrier` (collectives are not thread-safe to issue off
+    :class:`StoreBarrier` (collectives are not thread-safe to issue off
     the main thread — reference comment snapshot.py:948), and rank 0
     writes the commit marker only if every rank succeeded. Errors
     propagate to every rank through the barrier and re-raise in
@@ -2039,7 +2045,7 @@ class PendingRestore:
             event_loop.close()
             self._done.set()
 
-    def _key_barrier(self, i: int) -> Optional[LinearBarrier]:
+    def _key_barrier(self, i: int) -> Optional[StoreBarrier]:
         if self._restore_nonce is None:
             return None
         return _nonce_barrier(

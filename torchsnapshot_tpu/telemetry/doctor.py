@@ -98,6 +98,13 @@ RECOVERY_COST_S = 60.0
 DEDUP_WINDOW_STEPS = 3
 DEDUP_REUSE_FLOOR = 0.05
 DEDUP_UNCHANGED_FRAC = 0.5
+# coordination-bound: barrier waits + store round trips + the fan-out
+# exchange ate at least this fraction of the op's wall (pipeline wall
+# plus the coordination time itself — barriers run outside the
+# pipeline's phase spans), over an absolute floor so ms-scale test ops
+# polling a local store never flag.
+COORD_BOUND_FRACTION = 0.3
+COORD_MIN_S = 0.05
 # Bench-trial epistemics (formerly private to bench.py):
 # adjacent probes disagreeing beyond this factor = unstable link;
 # achieved/bracket below this ratio on a stable bracket = in-take stall.
@@ -613,6 +620,53 @@ def _retry_storm(report: Dict[str, Any]):
             "backoff_s": retries.get("backoff_s", 0.0),
             "exhausted": retries.get("exhausted", 0.0),
             "threshold_attempts": RETRY_STORM_ATTEMPTS,
+        },
+    }
+
+
+@doctor_rule(names.RULE_COORDINATION_BOUND)
+def _coordination_bound(report: Dict[str, Any]):
+    """Barrier waits + store round trips + the fan-out exchange ate a
+    large fraction of the op: the world size outgrew the coordination
+    topology (docs/scaling.md names the levers — tree-barrier fanout,
+    store shards, batched store ops)."""
+    coord = report.get("coordination") or {}
+    if not coord:
+        return None
+    barrier_s = float(coord.get("barrier_wait_s", 0.0))
+    store_s = float(coord.get("store_s", 0.0))
+    exchange_s = float(coord.get("exchange_s", 0.0))
+    # The exchange's own store round trips are inside exchange_s too;
+    # take the max of the two views rather than double-charging.
+    coord_s = barrier_s + max(store_s, exchange_s)
+    phases = report.get("phases") or {}
+    pipeline_wall_s = max((float(v) for v in phases.values()), default=0.0)
+    # Barriers and the exchange run OUTSIDE the pipeline's phase spans,
+    # so the op wall is at least pipeline + coordination.
+    wall_s = pipeline_wall_s + coord_s
+    if coord_s < COORD_MIN_S or wall_s <= 0.0:
+        return None
+    fraction = coord_s / wall_s
+    if fraction < COORD_BOUND_FRACTION:
+        return None
+    return {
+        "summary": (
+            "coordination (barrier waits + store round-trips + fan-out "
+            "exchange), not data movement, dominated this op: the world "
+            "size outgrew the coordination topology (see docs/scaling.md "
+            "for the barrier-fanout / store-shard levers)"
+        ),
+        "evidence": {
+            "coordination_s": round(coord_s, 3),
+            "coordination_fraction": round(fraction, 3),
+            "barrier_wait_s": round(barrier_s, 3),
+            "store_s": round(store_s, 3),
+            "exchange_s": round(exchange_s, 3),
+            "store_ops": coord.get("store_ops", 0.0),
+            "pipeline_wall_s": round(pipeline_wall_s, 3),
+            "spans": [names.SPAN_BARRIER_ARRIVE, names.SPAN_BARRIER_DEPART],
+            "threshold_fraction": COORD_BOUND_FRACTION,
+            "world_size": report.get("world_size"),
         },
     }
 

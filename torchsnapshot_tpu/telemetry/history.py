@@ -103,6 +103,25 @@ def summarize_report(
         "visible_s": (
             round(report.visible_s, 6) if report.visible_s is not None else None
         ),
+        # Cross-rank coordination cost (None for single-process ops):
+        # barrier waits plus max(store wire time, exchange wall) — the
+        # exchange's own store round trips live inside exchange_s, so
+        # summing both would double-charge them; same formula as the
+        # doctor's coordination-bound rule, whose trend companion this
+        # series is (a step whose coordination time creeps up — world
+        # grew, store degraded — flags like any other metric).
+        "coordination_s": (
+            round(
+                float(report.coordination.get("barrier_wait_s", 0.0))
+                + max(
+                    float(report.coordination.get("store_s", 0.0)),
+                    float(report.coordination.get("exchange_s", 0.0)),
+                ),
+                6,
+            )
+            if report.coordination is not None
+            else None
+        ),
         # Which write-path variant served the take's bytes (vectorized /
         # direct / fused / buffered): alongside ``tunables``, what lets
         # doctor --trend correlate a write-path knob flip with the
@@ -186,6 +205,10 @@ _TREND_METRICS = {
     # up is a deferral regression, the same defect the doctor's
     # async-visible-stall rule catches per-op.
     "visible_s": ("async visible span", 1),
+    # Coordination wall (barrier + store + exchange; None/0 for
+    # single-process ops — all-zero baselines never flag): the trend
+    # companion of the per-op coordination-bound rule.
+    "coordination_s": ("coordination time", 1),
 }
 
 

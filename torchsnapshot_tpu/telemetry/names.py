@@ -86,6 +86,22 @@ MIRROR_CHUNKS_SKIPPED_TOTAL = "mirror_chunks_skipped_total"
 PEER_PUSH_CHUNKS_DEDUPED_TOTAL = "peer_push_chunks_deduped_total"
 PEER_PUSH_BYTES_DEDUPED_TOTAL = "peer_push_bytes_deduped_total"
 
+# -- coordination (dist_store.py, fanout.py, tiered/peer.py) -----------------
+#
+# What cross-rank coordination costs, attributed per structure: store
+# wire round trips (requests + wall seconds, labeled by op), barrier
+# arrive/depart wait time (labeled by phase and impl=tree|linear), the
+# fan-out owner-table exchange, and endpoint-registry resolution. The
+# per-op deltas land in SnapshotReport.coordination; the
+# ``coordination-bound`` doctor rule and the scale-model harness
+# (torchsnapshot_tpu/scalemodel) read them against wall time.
+
+COORD_STORE_REQUESTS_TOTAL = "coordination_store_requests_total"
+COORD_STORE_SECONDS_TOTAL = "coordination_store_seconds_total"
+COORD_BARRIER_WAIT_SECONDS_TOTAL = "coordination_barrier_wait_seconds_total"
+COORD_EXCHANGE_SECONDS_TOTAL = "coordination_exchange_seconds_total"
+COORD_ENDPOINT_SECONDS_TOTAL = "coordination_endpoint_seconds_total"
+
 # -- manager (manager.py) ----------------------------------------------------
 
 MANAGER_SAVES_TOTAL = "manager_saves_total"
@@ -185,6 +201,15 @@ SPAN_PEER_JOB = "peer:job"
 SPAN_PEER_PUSH = "peer:push"
 SPAN_PEER_PULL = "peer:pull"
 
+# dist_store.py barriers: one span per arrive/depart phase (args carry
+# impl=tree|linear and the barrier prefix) — the coordination wall the
+# scale-model harness attributes vs world size.
+SPAN_BARRIER_ARRIVE = "barrier:arrive"
+SPAN_BARRIER_DEPART = "barrier:depart"
+# fanout.py: one owner-table exchange round (needs gather + window
+# publication + peer consumption) under a restore round's nonce prefix.
+SPAN_FANOUT_EXCHANGE = "fanout:exchange"
+
 # utils/rss_profiler.py: a new peak RSS delta was observed
 INSTANT_RSS_PEAK = "rss:peak"
 
@@ -268,6 +293,14 @@ RULE_RECOVERY_COST_HIGH = "recovery-cost-high"
 # paid storage latency the peer tier existed to avoid. Evidence cites
 # the peer transfer failures and the per-tier byte split.
 RULE_PEER_TIER_DEGRADED = "peer-tier-degraded"
+# Coordination (store round-trips + barrier waits + the fan-out
+# exchange), not data movement, ate a large fraction of the op's wall:
+# the world size outgrew the coordination topology. Evidence cites the
+# report's coordination split (barrier_wait_s / store_s / store_ops /
+# exchange_s from the barrier:* spans' counters); the levers are the
+# tree-barrier fanout, store shards, and batched store ops
+# (docs/scaling.md).
+RULE_COORDINATION_BOUND = "coordination-bound"
 # The content-addressed store is on but recent committed steps reused
 # ~none of their bytes even though the on-device digests say the state
 # was mostly unchanged — the dedup path is broken in practice (chunks

@@ -47,6 +47,18 @@ _RETRY_COUNTERS = {
     names.STORAGE_RETRIES_EXHAUSTED_TOTAL: "exhausted",
     names.GCS_RECOVER_ATTEMPTS_TOTAL: "gcs_recover_attempts",
 }
+# ...and into the coordination split (summed across op/phase/impl
+# labels): what the op spent on cross-rank coordination — store wire
+# round trips, barrier arrive/depart waits, the fan-out exchange, and
+# endpoint resolution. The ``coordination-bound`` doctor rule reads
+# this against the op's wall time.
+_COORD_COUNTERS = {
+    names.COORD_STORE_REQUESTS_TOTAL: "store_ops",
+    names.COORD_STORE_SECONDS_TOTAL: "store_s",
+    names.COORD_BARRIER_WAIT_SECONDS_TOTAL: "barrier_wait_s",
+    names.COORD_EXCHANGE_SECONDS_TOTAL: "exchange_s",
+    names.COORD_ENDPOINT_SECONDS_TOTAL: "endpoint_s",
+}
 
 
 @dataclasses.dataclass
@@ -130,6 +142,12 @@ class SnapshotReport:
     # autotuner is on — a history row / doctor --trend regression can
     # then always be correlated with the knob change that caused it.
     tunables: Optional[Dict[str, Any]] = None
+    # Multi-rank ops only (None when the op issued no coordination
+    # traffic): the coordination split over the op's window —
+    # ``{store_ops, store_s, barrier_wait_s, exchange_s, endpoint_s}``
+    # registry counter deltas (process-global, like the plugin table).
+    # The ``coordination-bound`` doctor rule keys off this.
+    coordination: Optional[Dict[str, float]] = None
     retries: Dict[str, float] = dataclasses.field(default_factory=dict)
     mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
     aggregated: Optional[Dict[str, Dict[str, float]]] = None
@@ -202,6 +220,25 @@ def plugins_from_deltas(
         plugin = labels.get("plugin", "unknown")
         out.setdefault(plugin, {})[field] = value
     return out
+
+
+def coordination_from_deltas(
+    deltas: Dict[str, float]
+) -> Optional[Dict[str, float]]:
+    """Coordination split from counter deltas, summed across labels
+    (op/phase/impl); None when the window saw no coordination traffic
+    at all (single-process ops stay schema-light)."""
+    out = {field: 0.0 for field in _COORD_COUNTERS.values()}
+    seen = False
+    for series, value in deltas.items():
+        name, _ = parse_series_key(series)
+        field = _COORD_COUNTERS.get(name)
+        if field is not None:
+            out[field] += value
+            seen = True
+    if not seen:
+        return None
+    return {k: round(v, 6) for k, v in out.items()}
 
 
 def retries_from_deltas(deltas: Dict[str, float]) -> Dict[str, float]:
@@ -282,6 +319,7 @@ def build_report(
         ),
         peer=dict(pipeline.get("peer") or {}),
         tunables=dict(tunables) if tunables is not None else None,
+        coordination=coordination_from_deltas(counter_deltas),
         retries=retries_from_deltas(counter_deltas),
         mirror=dict(mirror or {}),
         error=error,

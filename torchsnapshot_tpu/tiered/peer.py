@@ -67,6 +67,7 @@ from .. import knobs, telemetry
 from ..dist_store import (
     Store,
     lookup_endpoint,
+    lookup_endpoints,
     publish_endpoint,
     recv_frame,
     send_frame,
@@ -664,6 +665,14 @@ class PeerReplicator:
             return None
         return lookup_endpoint(self._store, PEER_SERVICE, rank)
 
+    def resolve_endpoints(self, ranks) -> Dict[int, Tuple[str, int]]:
+        """Every advertised endpoint for ``ranks`` in ONE batched store
+        round trip (``dist_store.lookup_endpoints``); {} before
+        configure or on a failed registry read."""
+        if self._store is None:
+            return {}
+        return lookup_endpoints(self._store, PEER_SERVICE, ranks)
+
     def target_rank(self) -> int:
         return (self._rank + knobs.get_peer_ring_offset()) % max(
             1, self._world
@@ -1022,12 +1031,14 @@ class PeerReplicator:
 
 
 def _advertise_host() -> str:
-    """The address peers dial for this process's cache server — the
-    same resolution order the TCP-store bootstrap uses."""
-    from ..dist_store import _routable_host
+    """The address peers dial for THIS process's cache server. Must be
+    rank-local: ``_routable_host``'s first choice is the jax
+    coordinator (rank 0's) address, which every non-rank-0 host would
+    wrongly advertise for a server bound on its own machine."""
+    from ..dist_store import _local_advertise_host
 
     try:
-        return _routable_host()
+        return _local_advertise_host()
     except Exception:  # noqa: BLE001 - last resort
         return socket.gethostname()
 
@@ -1163,8 +1174,11 @@ def maybe_evict_step(path: str) -> None:
     world = rep.world_size
 
     def _evict_all() -> None:
+        # One batched registry resolve for the whole ring, then the
+        # per-endpoint evict RPCs.
+        endpoints = rep.resolve_endpoints(range(world))
         for rank in range(world):
-            endpoint = rep.endpoint_for(rank)
+            endpoint = endpoints.get(rank)
             if endpoint is None:
                 continue
             client = PeerClient(endpoint[0], endpoint[1], timeout=timeout)
@@ -1484,9 +1498,13 @@ def build_restore_context(path: str) -> Optional[PeerRestoreContext]:
     """Assemble the restore-side owner table for one snapshot path by
     asking every advertised peer endpoint for its inventory of the
     step (one LIST RPC each; a dead peer is skipped with a WARN).
-    Returns None when the tier is off/inert or no peer holds anything
-    for the step — the restore then runs exactly the pre-peer path.
-    Never raises: every failure mode degrades to "no peer tier"."""
+    Endpoint resolution is ONE batched ``multi_get`` against the
+    registry (``dist_store.lookup_endpoints``) — restore setup on a
+    thousand-rank world costs one store round trip, not world
+    sequential lookups. Returns None when the tier is off/inert or no
+    peer holds anything for the step — the restore then runs exactly
+    the pre-peer path. Never raises: every failure mode degrades to
+    "no peer tier"."""
     if not knobs.is_peer_tier_enabled():
         return None
     with _replicator_lock:
@@ -1498,9 +1516,10 @@ def build_restore_context(path: str) -> Optional[PeerRestoreContext]:
 
         step_key = peer_step_key(path)
         timeout = knobs.get_peer_transfer_timeout_seconds()
+        endpoints = rep.resolve_endpoints(range(rep.world_size))
 
         def _inventory_of(rank: int):
-            endpoint = rep.endpoint_for(rank)
+            endpoint = endpoints.get(rank)
             if endpoint is None:
                 return rank, None, {}
             client = PeerClient(endpoint[0], endpoint[1], timeout=timeout)

@@ -116,6 +116,12 @@ TUNABLES: Dict[str, Tunable] = {
         # revert-on-regression gives a flip that hurt its normal undo.
         Tunable("write_vectorized", knobs._WRITE_VECTORIZED_ENV, 0, 1, 2.0),
         Tunable("fs_direct_io", knobs._FS_DIRECT_IO_ENV, 0, 1, 2.0),
+        # Coordination topology (docs/scaling.md): the tree barrier's
+        # branching factor, and the coordination-store shard count
+        # (effective at the next store bootstrap — moving it mid-run is
+        # safe but inert until a new process group forms).
+        Tunable("barrier_fanout", knobs._BARRIER_FANOUT_ENV, 2, 64, 2.0),
+        Tunable("store_shards", knobs._STORE_SHARDS_ENV, 1, 16, 2.0),
     )
 }
 
