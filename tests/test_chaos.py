@@ -1,0 +1,510 @@
+"""Chaos engine + self-healing store (docs/chaos.md): fault-plan
+replay determinism, the injection modes over real plugins and the wire,
+the restore-time corruption ladder, and ``fsck --repair``'s
+rewrite/quarantine semantics — the satellite-3 repair matrix included
+(corrupt one CAS chunk per tier: fallthrough, repair, quarantine)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.chaos import (
+    ChaosEngine,
+    FaultPlan,
+    FaultSpec,
+    SimulatedCrash,
+    arm,
+    chaotic_plugin_type,
+    corrupt_bytes,
+    crashpoint,
+    declared_crashpoints,
+    disarm,
+    install_wire_chaos,
+    uninstall_wire_chaos,
+    wrap_plugin,
+)
+from torchsnapshot_tpu.integrity import ChecksumError
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.telemetry import names
+
+
+def _flip_middle_byte(path: str) -> None:
+    """Size-preserving on-disk corruption: only a digest catches it."""
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 1]))
+
+
+# ---------------------------------------------------------------------------
+# fault plans + engine
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_json_round_trip():
+    plan = FaultPlan(
+        seed=42,
+        faults=[
+            FaultSpec(point="storage-write", mode="torn", match="m/", after=2),
+            FaultSpec(
+                point="crashpoint",
+                mode="crash",
+                match="commit-marker",
+                times=None,
+                prob=0.25,
+            ),
+        ],
+    )
+    line = plan.to_json()
+    assert "\n" not in line  # ONE line: the replay copy-paste contract
+    again = FaultPlan.from_json(line)
+    assert again.to_json() == line
+    assert again.seed == 42
+    assert [f.mode for f in again.faults] == ["torn", "crash"]
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        FaultSpec(point="storage-write", mode="explode")
+
+
+def test_same_seed_same_schedule():
+    """The acceptance pin: identical seed + fault plan over the same
+    event stream reproduces the identical fault schedule; a different
+    seed diverges (probabilistic specs)."""
+    plan_line = FaultPlan(
+        seed=7,
+        faults=[
+            FaultSpec(
+                point="storage-write", mode="fail", prob=0.3, times=None
+            )
+        ],
+    ).to_json()
+    events = [("storage-write", f"blob-{i}") for i in range(200)]
+
+    def schedule(line: str):
+        engine = ChaosEngine(FaultPlan.from_json(line))
+        for point, key in events:
+            engine.on_event(point, key)
+        return list(engine.fired)
+
+    first = schedule(plan_line)
+    assert first and first == schedule(plan_line)
+    other = FaultPlan.from_json(plan_line)
+    other.seed = 8
+    assert schedule(other.to_json()) != first
+
+
+def test_after_and_times_windows():
+    engine = ChaosEngine(
+        FaultPlan.single(point="storage-read", after=2, times=2)
+    )
+    outcomes = [
+        engine.on_event("storage-read", "b") is not None for _ in range(6)
+    ]
+    assert outcomes == [False, False, True, True, False, False]
+
+
+# ---------------------------------------------------------------------------
+# storage injection modes
+# ---------------------------------------------------------------------------
+
+
+def _mem_plugin(plan: FaultPlan):
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    inner = MemoryStoragePlugin(name=f"chaos-{id(plan)}")
+    return inner, wrap_plugin(inner, ChaosEngine(plan))
+
+
+def _run(coro):
+    from torchsnapshot_tpu.event_loop import run_in_fresh_event_loop
+
+    return run_in_fresh_event_loop(coro)
+
+
+def test_mode_fail_and_delay_and_drop():
+    inner, plugin = _mem_plugin(
+        FaultPlan(
+            seed=0,
+            faults=[
+                FaultSpec(point="storage-write", mode="fail", match="dead"),
+                FaultSpec(point="storage-write", mode="drop", match="lost"),
+                FaultSpec(
+                    point="storage-write",
+                    mode="delay",
+                    match="slow",
+                    delay_s=0.01,
+                ),
+            ],
+        )
+    )
+
+    async def body():
+        with pytest.raises(OSError, match="chaos: injected fault"):
+            await plugin.write(WriteIO(path="dead", buf=b"x"))
+        await plugin.write(WriteIO(path="lost", buf=b"x"))  # reported ok
+        await plugin.write(WriteIO(path="slow", buf=b"abc"))
+        await plugin.write(WriteIO(path="fine", buf=b"def"))
+        read = ReadIO(path="slow")
+        await plugin.read(read)
+        assert bytes(read.buf) == b"abc"
+        with pytest.raises(FileNotFoundError):
+            await plugin.read(ReadIO(path="lost"))  # the write was dropped
+
+    _run(body())
+
+
+def test_mode_corrupt_and_torn():
+    inner, plugin = _mem_plugin(
+        FaultPlan(
+            seed=0,
+            faults=[
+                FaultSpec(
+                    point="storage-write", mode="corrupt", match="bitrot"
+                ),
+                FaultSpec(point="storage-write", mode="torn", match="torn"),
+                FaultSpec(point="storage-read", mode="corrupt", match="readrot"),
+            ],
+        )
+    )
+
+    async def body():
+        payload = bytes(range(64))
+        await plugin.write(WriteIO(path="bitrot", buf=payload))
+        read = ReadIO(path="bitrot")
+        await inner.read(read)
+        stored = bytes(read.buf)
+        assert len(stored) == len(payload) and stored != payload
+
+        with pytest.raises(OSError, match="torn write"):
+            await plugin.write(WriteIO(path="torn", buf=payload))
+        read = ReadIO(path="torn")
+        await inner.read(read)
+        assert bytes(read.buf) == payload[: len(payload) // 2]
+
+        await inner.write(WriteIO(path="readrot", buf=payload))
+        read = ReadIO(path="readrot")
+        await plugin.read(read)
+        assert bytes(read.buf) != payload
+        assert len(bytes(read.buf)) == len(payload)
+
+    _run(body())
+
+
+def test_corrupt_bytes_is_size_preserving():
+    data = bytes(range(32))
+    damaged = corrupt_bytes(data)
+    assert len(damaged) == len(data) and damaged != data
+    assert corrupt_bytes(b"") == b""
+
+
+def test_faulty_fs_plugin_corrupt_mode_never_served_silently(tmp_path):
+    """The shim's new corrupt-bytes mode on a single-tier root: the
+    restore has no alternate source, so the damage surfaces as a
+    ChecksumError — never silently-wrong arrays."""
+    from torchsnapshot_tpu.test_utils import (
+        faulty_fs_plugin,
+        patch_storage_plugin,
+    )
+
+    state = {"m": ts.PyTreeState({"w": np.arange(5000, dtype=np.float32)})}
+    path = str(tmp_path / "s")
+    ts.Snapshot.take(path, state)
+    cls = faulty_fs_plugin(
+        lambda p: "/m/" in p, ops=("read",), mode="corrupt"
+    )
+    dst = {"m": ts.PyTreeState({"w": np.zeros(5000, dtype=np.float32)})}
+    with patch_storage_plugin(cls), pytest.raises(ChecksumError):
+        ts.Snapshot(path).restore(dst)
+    assert cls.chaos_engine.fired  # the corruption actually ran
+
+
+# ---------------------------------------------------------------------------
+# wire chaos (send_frame/recv_frame: TCP store + peer transport)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_chaos_fails_store_traffic_then_uninstalls():
+    from torchsnapshot_tpu.dist_store import TCPStore
+    from torchsnapshot_tpu.test_utils import get_free_port
+
+    port = get_free_port()
+    store = TCPStore("127.0.0.1", port, is_server=True)
+    try:
+        store.set("before", b"1")  # healthy baseline
+        engine = ChaosEngine(
+            FaultPlan.single(point="wire-send", mode="fail")
+        )
+        install_wire_chaos(engine)
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                store.set("during", b"2")
+            assert engine.fired and engine.fired[0][0] == "wire-send"
+        finally:
+            uninstall_wire_chaos()
+        store.set("after", b"3")
+        assert store.try_get("after") == b"3"
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# crash points
+# ---------------------------------------------------------------------------
+
+
+def test_crashpoint_arm_disarm_and_hits():
+    point = names.CRASH_COMMIT_MARKER
+    crashpoint(point)  # unarmed: no-op
+    arm(point, at=2)
+    try:
+        crashpoint(point)  # first hit: survives
+        with pytest.raises(SimulatedCrash):
+            crashpoint(point)
+        from torchsnapshot_tpu.chaos import hits
+
+        assert hits(point) == 2
+    finally:
+        disarm()
+    crashpoint(point)  # disarmed again
+
+
+def test_declared_crashpoints_enumerates_names_registry():
+    declared = declared_crashpoints()
+    assert names.CRASH_COMMIT_MARKER in declared
+    assert names.CRASH_CAS_CHUNK_WRITTEN in declared
+    assert names.CRASH_INDEX_BACKUP_WRITTEN in declared
+    assert len(declared) >= 13
+    assert declared == sorted(declared)
+    assert issubclass(SimulatedCrash, BaseException)
+    assert not issubclass(SimulatedCrash, Exception)
+
+
+# ---------------------------------------------------------------------------
+# self-healing reads (the corruption ladder)
+# ---------------------------------------------------------------------------
+
+
+def _tiered_root(tmp_path):
+    fast = str(tmp_path / "fast")
+    durable = str(tmp_path / "durable")
+    return f"tiered://{fast}|{durable}", fast, durable
+
+
+def test_tiered_restore_heals_around_corrupt_fast_copy(tmp_path):
+    """Corruption on the tier restores read FIRST falls through to the
+    other tier: restore succeeds bit-identical, tier_split carries the
+    rerouted bytes, and the storage-corruption doctor rule fires on the
+    report."""
+    from torchsnapshot_tpu.telemetry.doctor import diagnose_reports
+
+    root, fast, durable = _tiered_root(tmp_path)
+    want = np.arange(80_000, dtype=np.float32)
+    mgr = ts.CheckpointManager(root, keep_last_n=2)
+    mgr.save(0, {"m": ts.PyTreeState({"w": want.copy()})})
+    mgr.wait_durable(0)
+    blob = os.path.join(fast, "step_0000000000", "0", "m", "w")
+    _flip_middle_byte(blob)
+
+    dest = {"m": ts.PyTreeState({"w": np.zeros_like(want)})}
+    assert mgr.restore_latest(dest) == 0
+    np.testing.assert_array_equal(dest["m"].tree["w"], want)
+
+    report = telemetry.last_report("restore", path=mgr.step_path(0))
+    assert report.degraded_reads == {"blobs": 1, "bytes": want.nbytes}
+    assert report.tier_split == {"durable": want.nbytes}
+    rules = [v.rule for v in diagnose_reports([report.to_dict()])]
+    assert names.RULE_STORAGE_CORRUPTION in rules
+
+
+def test_peer_ladder_healing_does_not_double_count_tiers(tmp_path):
+    """The peer ladder's read_degraded must take back the rejected
+    serve's bytes: tier_split sums to the bytes actually restored, not
+    restored + every corrupt attempt."""
+    from torchsnapshot_tpu.event_loop import run_in_fresh_event_loop
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+    from torchsnapshot_tpu.tiered.peer import PeerRestoreContext
+    from torchsnapshot_tpu.tiered.plugin import TieredStoragePlugin
+
+    fast, durable = str(tmp_path / "f"), str(tmp_path / "d")
+    payload = bytes(range(256)) * 4
+    for tier in (fast, durable):
+        os.makedirs(tier)
+        with open(os.path.join(tier, "blob"), "wb") as f:
+            f.write(payload)
+    _flip_middle_byte(os.path.join(fast, "blob"))
+    tiered = TieredStoragePlugin(
+        fast=FSStoragePlugin(root=fast), durable=FSStoragePlugin(root=durable)
+    )
+    ctx = PeerRestoreContext(table={}, step_key="s", timeout=0.5)
+    ladder = ctx.wrap(tiered)
+
+    async def body():
+        read = ReadIO(path="blob")
+        await ladder.read(read)  # fast serves (corrupt; counted)
+        assert read.served_by == "fast"
+        assert await ladder.read_degraded(read)  # the scheduler's retry
+        assert read.served_by == "durable"
+        assert bytes(read.buf) == payload
+
+    run_in_fresh_event_loop(body())
+    split = ctx.pipeline_fields()["tier_split"]
+    assert sum(split.values()) == len(payload), split
+    assert split["durable"] == len(payload) and split["fast"] == 0
+
+
+def test_single_tier_corruption_still_raises(tmp_path):
+    """No alternate source, no silent serve: the plain-fs ladder is
+    empty and the original ChecksumError stands."""
+    path = str(tmp_path / "s")
+    want = np.arange(50_000, dtype=np.float32)
+    ts.Snapshot.take(path, {"m": ts.PyTreeState({"w": want.copy()})})
+    _flip_middle_byte(os.path.join(path, "0", "m", "w"))
+    dest = {"m": ts.PyTreeState({"w": np.zeros_like(want)})}
+    with pytest.raises(ChecksumError):
+        ts.Snapshot(path).restore(dest)
+
+
+# ---------------------------------------------------------------------------
+# CAS chunk repair (satellite 3: one corrupt chunk per tier)
+# ---------------------------------------------------------------------------
+
+
+def _cas_setup(tmp_path):
+    root, fast, durable = _tiered_root(tmp_path)
+    want = np.arange(60_000, dtype=np.float32)
+    mgr = ts.CheckpointManager(root, keep_last_n=2)
+    mgr.save(0, {"m": ts.PyTreeState({"w": want.copy()})})
+    mgr.wait_durable(0)
+    chunks = sorted(glob.glob(os.path.join(durable, "chunks", "cas-*")))
+    assert chunks, "CAS layout did not engage"
+    key = os.path.basename(chunks[0])
+    return root, fast, durable, want, mgr, key
+
+
+def test_cas_corrupt_fast_chunk_restores_via_fallthrough(tmp_path):
+    """(a) restore succeeds via tier fallthrough, tier_split shows the
+    rerouted bytes; (b) fsck --repair rewrites the chunk and a plain
+    restore afterwards is clean (no degraded reads)."""
+    from torchsnapshot_tpu.fsck import repair_cas_store, verify_cas_store
+
+    with knobs.enable_cas():
+        root, fast, durable, want, mgr, key = _cas_setup(tmp_path)
+        _flip_middle_byte(os.path.join(fast, "chunks", key))
+
+        dest = {"m": ts.PyTreeState({"w": np.zeros_like(want)})}
+        assert mgr.restore_latest(dest) == 0
+        np.testing.assert_array_equal(dest["m"].tree["w"], want)
+        report = telemetry.last_report("restore", path=mgr.step_path(0))
+        assert report.degraded_reads["blobs"] == 1
+        assert report.tier_split.get("durable", 0) == want.nbytes
+
+        pre = verify_cas_store(root, deep=True)
+        assert any(p.kind == "checksum" for p in pre.problems)
+        repair = repair_cas_store(root)
+        assert any(key in loc for loc in repair.rewritten)
+        assert not repair.quarantined
+        assert verify_cas_store(root, deep=True).ok
+
+        dest2 = {"m": ts.PyTreeState({"w": np.zeros_like(want)})}
+        assert mgr.restore_latest(dest2) == 0
+        np.testing.assert_array_equal(dest2["m"].tree["w"], want)
+        report2 = telemetry.last_report("restore", path=mgr.step_path(0))
+        assert report2.degraded_reads is None  # clean: nothing rerouted
+
+
+def test_cas_corrupt_durable_chunk_repaired_from_fast(tmp_path):
+    """The satellite's literal case: size-preserving damage on the
+    DURABLE tier's chunk. A plain restore doesn't even notice (fast
+    serves), the per-tier deep audit does, and --repair rebuilds the
+    durable copy from the fast one."""
+    from torchsnapshot_tpu.fsck import repair_cas_store, verify_cas_store
+
+    with knobs.enable_cas():
+        root, fast, durable, want, mgr, key = _cas_setup(tmp_path)
+        _flip_middle_byte(os.path.join(durable, "chunks", key))
+
+        pre = verify_cas_store(root, deep=True)
+        assert any(
+            p.kind == "checksum" and key in p.location for p in pre.problems
+        )
+        repair = repair_cas_store(root)
+        rewritten = {
+            loc: src for loc, src in repair.rewritten.items() if key in loc
+        }
+        assert rewritten and all(
+            src.startswith(fast) for src in rewritten.values()
+        )
+        assert verify_cas_store(root, deep=True).ok
+        assert _chunk_bytes(durable, key) == _chunk_bytes(fast, key)
+
+
+def _chunk_bytes(tier_dir: str, key: str) -> bytes:
+    with open(os.path.join(tier_dir, "chunks", key), "rb") as f:
+        return f.read()
+
+
+def test_cas_all_tiers_corrupt_quarantines_never_serves(tmp_path):
+    """(c) every tier's copy bad: --repair quarantines
+    (chunks/.quarantine/), the audit reports the dangling ref, and a
+    restore fails loudly — corrupt bytes are never served."""
+    from torchsnapshot_tpu.fsck import (
+        QUARANTINE_DIRNAME,
+        repair_cas_store,
+        verify_cas_store,
+    )
+
+    with knobs.enable_cas(), knobs.enable_ledger():
+        root, fast, durable, want, mgr, key = _cas_setup(tmp_path)
+        _flip_middle_byte(os.path.join(fast, "chunks", key))
+        _flip_middle_byte(os.path.join(durable, "chunks", key))
+
+        repair = repair_cas_store(root)
+        assert repair.quarantined == [key]
+        for tier in (fast, durable):
+            assert os.path.exists(
+                os.path.join(tier, "chunks", QUARANTINE_DIRNAME, key)
+            )
+            assert not os.path.exists(os.path.join(tier, "chunks", key))
+        post = verify_cas_store(root, deep=True)
+        assert any(
+            p.kind == "missing" and key in p.location for p in post.problems
+        )
+
+        dest = {"m": ts.PyTreeState({"w": np.zeros_like(want)})}
+        with pytest.raises(Exception):
+            mgr.restore_latest(dest)
+
+        # The repair is a ledger fact the doctor cites (the root opened
+        # a run, so the event landed).
+        from torchsnapshot_tpu.telemetry.ledger import (
+            ledger_path_for,
+            load_ledger,
+        )
+
+        records = load_ledger(ledger_path_for(root))
+        repairs = [
+            r
+            for r in records
+            if r.get("event") == names.EVENT_REPAIR_PERFORMED
+        ]
+        assert repairs and repairs[-1]["quarantined"] == 1
+
+        from torchsnapshot_tpu.telemetry.doctor import (
+            diagnose_snapshot,
+        )
+
+        verdicts = diagnose_snapshot(mgr.step_path(0))
+        hit = [
+            v
+            for v in verdicts
+            if v.rule == names.RULE_STORAGE_CORRUPTION
+        ]
+        assert hit and hit[0].severity == "critical"

@@ -1,0 +1,103 @@
+"""The crash matrix (chaos/harness.py): a take killed at every declared
+crash point leaves a store where fsck finds nothing critical, the
+newest committed step restores bit-identical, CAS refcounts reconcile,
+and the mirror resumes to durability.
+
+Two lanes: a fast 8-point smoke on the fullest configuration
+(tiered+CAS) rides tier-1; the slow-marked sweep runs EVERY declared
+point × {legacy, CAS} × {plain, tiered} and additionally pins that
+every declared point actually fires somewhere — an unthreaded (or
+renamed) crash point fails the sweep rather than silently shrinking
+the matrix. Any red cell's failure message carries the seed +
+fault-plan JSON line that replays it deterministically."""
+
+import json
+
+import pytest
+
+from torchsnapshot_tpu.chaos import declared_crashpoints
+from torchsnapshot_tpu.chaos.harness import (
+    CONFIGS,
+    FULL_CONFIG,
+    CrashCaseResult,
+    assert_matrix_green,
+    run_crash_case,
+    run_crash_matrix,
+)
+from torchsnapshot_tpu.telemetry import names
+
+# The tier-1 smoke: the eight windows where a kill historically hurts
+# most — data durable but control plane absent, the torn index pair,
+# the commit bracket, and the CAS pin/map/chunk states.
+SMOKE_POINTS = (
+    names.CRASH_TAKE_WRITES_DONE,
+    names.CRASH_CHECKSUM_TABLE_WRITTEN,
+    names.CRASH_CAS_CHUNK_WRITTEN,
+    names.CRASH_CAS_MAP_WRITTEN,
+    names.CRASH_PRE_COMMIT_MARKER,
+    names.CRASH_COMMIT_MARKER,
+    names.CRASH_INDEX_BACKUP_WRITTEN,
+    names.CRASH_REFCOUNT_PINNED,
+)
+
+
+def test_smoke_points_are_declared():
+    declared = set(declared_crashpoints())
+    assert set(SMOKE_POINTS) <= declared
+    assert len(SMOKE_POINTS) == 8
+
+
+@pytest.mark.parametrize("point", SMOKE_POINTS)
+def test_crash_matrix_smoke(tmp_path, point):
+    """8-point smoke on tiered+CAS: every point fires and every
+    invariant holds."""
+    result = run_crash_case(str(tmp_path), point, FULL_CONFIG, seed=0)
+    assert_matrix_green([result])
+    assert result.fired, f"{point} did not fire under {FULL_CONFIG.name}"
+
+
+def test_red_cell_prints_replayable_fault_plan(tmp_path):
+    """A failing cell's message must carry the one JSON line that
+    replays its fault schedule (the red-run workflow docs/chaos.md
+    documents)."""
+    bad = CrashCaseResult(
+        point=names.CRASH_COMMIT_MARKER,
+        config="tiered-cas",
+        seed=17,
+        fired=True,
+        applicable=True,
+        failures=["synthetic violation"],
+    )
+    with pytest.raises(AssertionError) as exc:
+        assert_matrix_green([bad])
+    message = str(exc.value)
+    assert "replay:" in message
+    line = next(
+        l.split("replay:", 1)[1].strip()
+        for l in message.splitlines()
+        if "replay:" in l
+    )
+    plan = json.loads(line)
+    assert plan["seed"] == 17
+    assert plan["faults"][0]["match"] == names.CRASH_COMMIT_MARKER
+
+
+@pytest.mark.slow
+def test_crash_matrix_full(tmp_path):
+    """Every declared crash point × {legacy, CAS} × {plain, tiered}:
+    green across the board, and every point fires in the fullest
+    configuration (so the declared registry can never drift from the
+    threaded reality)."""
+    results = run_crash_matrix(str(tmp_path))
+    assert_matrix_green(results)
+    assert len(results) == len(declared_crashpoints()) * len(CONFIGS)
+    fired_in_full = {
+        r.point
+        for r in results
+        if r.config == FULL_CONFIG.name and r.fired
+    }
+    missing = set(declared_crashpoints()) - fired_in_full
+    assert not missing, (
+        f"declared crash points never fired under {FULL_CONFIG.name}: "
+        f"{sorted(missing)} — the point is declared but not threaded"
+    )

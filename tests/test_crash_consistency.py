@@ -7,6 +7,13 @@ Property widening of test_async_take's fixed-point failure injection
 (reference analog: the no-commit-marker-on-failure invariant,
 snapshot.py commit-after-barrier). A 60-case sweep of this generator
 passed during round 4; these 8 deterministic seeds pin it.
+
+Since the chaos engine landed, each case is driven by a declarative
+:class:`~torchsnapshot_tpu.chaos.FaultPlan` (fail the ``fail_at+1``-th
+matching storage op) wrapped over the fs plugin — the same mechanism
+the crash matrix and the distributed sweep replay through — and every
+case asserts its plan round-trips through the one-line JSON form that a
+red run would print.
 """
 
 import os
@@ -15,7 +22,19 @@ import numpy as np
 import pytest
 
 import torchsnapshot_tpu as ts
-from torchsnapshot_tpu.test_utils import faulty_fs_plugin, patch_storage_plugin
+from torchsnapshot_tpu.chaos import ChaosEngine, FaultPlan, chaotic_plugin_type
+from torchsnapshot_tpu.chaos.plan import seeded_failure_plan
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.test_utils import patch_storage_plugin
+
+
+def _chaotic_fs(plan: FaultPlan):
+    """The fault-plan analog of the legacy faulty_fs_plugin shim: a
+    class for patch_storage_plugin, plus the engine whose ``fired`` log
+    pins the schedule."""
+    engine = ChaosEngine(plan)
+    cls = chaotic_plugin_type(FSStoragePlugin, engine)
+    return cls, engine
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -37,22 +56,23 @@ def test_read_failure_raises_then_clean_retry_succeeds(tmp_path, seed) -> None:
     path = str(tmp_path / "s")
     ts.Snapshot.take(path, {"m": ts.PyTreeState(dict(state))})
     fail_at = int(rng.integers(0, n_leaves))
-    counter = {"n": 0}
-
-    def _crash_after(_path: str) -> bool:
-        counter["n"] += 1
-        return counter["n"] > fail_at
-
-    patch = patch_storage_plugin(
-        faulty_fs_plugin(
-            _crash_after, ops=("read",), exc_msg="injected read failure"
-        )
+    plan = seeded_failure_plan(
+        seed, "storage-read", fail_at, exc_msg="injected read failure"
     )
+    # The plan IS the adversary: it must survive the replay round-trip.
+    assert FaultPlan.from_json(plan.to_json()).to_json() == plan.to_json()
+    cls, engine = _chaotic_fs(plan)
+
     dst = ts.PyTreeState(
         {f"l{i}": np.zeros_like(state[f"l{i}"]) for i in range(n_leaves)}
     )
-    with patch, pytest.raises(OSError, match="injected read failure"):
+    with patch_storage_plugin(cls), pytest.raises(
+        OSError, match="injected read failure"
+    ):
         ts.Snapshot(path).restore({"m": dst})
+    assert engine.fired and all(
+        point == "storage-read" for point, _, _ in engine.fired
+    )
 
     dst2 = ts.PyTreeState(
         {f"l{i}": np.zeros_like(state[f"l{i}"]) for i in range(n_leaves)}
@@ -73,24 +93,34 @@ def test_crash_at_random_write_index(tmp_path, seed) -> None:
         for i in range(n_leaves)
     }
     fail_at = int(rng.integers(0, n_leaves + 2))
-    counter = {"n": 0}
-
-    def _crash_after(_path: str) -> bool:
-        counter["n"] += 1
-        return counter["n"] > fail_at
-
-    patch = patch_storage_plugin(
-        faulty_fs_plugin(_crash_after, exc_msg="injected failure")
+    plan = seeded_failure_plan(
+        seed, "storage-write", fail_at, exc_msg="injected failure"
     )
+    cls, engine = _chaotic_fs(plan)
     path = str(tmp_path / "s")
     crashed = False
     try:
-        with patch:
+        with patch_storage_plugin(cls):
             ts.Snapshot.take(path, {"m": ts.PyTreeState(dict(state))})
     except OSError:
         crashed = True
     if crashed:
         assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+    # Replay: a fresh engine over the SAME plan JSON fired at the same
+    # op index, so a run that crashed crashes again (the trigger-
+    # identity unit pin lives in test_chaos.py — concurrent pipelines
+    # may cancel a different suffix of ops after the shared trigger).
+    if crashed:
+        replay_cls, replay_engine = _chaotic_fs(
+            FaultPlan.from_json(plan.to_json())
+        )
+        with patch_storage_plugin(replay_cls), pytest.raises(OSError):
+            ts.Snapshot.take(
+                str(tmp_path / "replay"),
+                {"m": ts.PyTreeState(dict(state))},
+            )
+        assert replay_engine.fired[0][2] == engine.fired[0][2] == "fail"
 
     # Clean retake over whatever partial state the crash left behind.
     ts.Snapshot.take(path, {"m": ts.PyTreeState(dict(state))})

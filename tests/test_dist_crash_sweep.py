@@ -24,10 +24,11 @@ import numpy as np
 import pytest
 
 import torchsnapshot_tpu as ts
+from torchsnapshot_tpu.chaos import ChaosEngine, chaotic_plugin_type
+from torchsnapshot_tpu.chaos.plan import FaultPlan, FaultSpec
 from torchsnapshot_tpu.pg_wrapper import PGWrapper
 from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME, Snapshot
 from torchsnapshot_tpu.test_utils import (
-    faulty_fs_plugin,
     multiprocess_test,
     patch_storage_plugin,
 )
@@ -35,6 +36,32 @@ from torchsnapshot_tpu.test_utils import (
 
 def _data_blob(path: str) -> bool:
     return "/m/" in path or "batched" in path
+
+
+def _chaotic_fs_patch(plan: FaultPlan):
+    """Fault-plan injection through the one chaos mechanism (the
+    migration of this sweep's legacy faulty_fs_plugin closures): the
+    plan line is what a red case prints to replay."""
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    return patch_storage_plugin(
+        chaotic_plugin_type(FSStoragePlugin, ChaosEngine(plan))
+    )
+
+
+def _data_blob_fault(seed: int, point: str, exc_msg: str) -> FaultPlan:
+    return FaultPlan(
+        seed=seed,
+        faults=[
+            FaultSpec(
+                point=point,
+                mode="fail",
+                times=None,
+                predicate=_data_blob,
+                exc_msg=exc_msg,
+            )
+        ],
+    )
 
 
 def _rand_state(rng, n_leaves: int, rank: int) -> dict:
@@ -64,9 +91,9 @@ def _take_case(pg, seed: int) -> None:
 
     ctx = contextlib.nullcontext()
     if fail_point == "write" and pg.rank == fail_rank:
-        ctx = patch_storage_plugin(
-            faulty_fs_plugin(
-                _data_blob, exc_msg=f"injected write failure ({seed})"
+        ctx = _chaotic_fs_patch(
+            _data_blob_fault(
+                seed, "storage-write", f"injected write failure ({seed})"
             )
         )
     elif fail_point == "metadata" and pg.rank == 0:
@@ -127,11 +154,9 @@ def _restore_case(pg, seed: int) -> None:
                 side_effect=OSError(f"injected setup failure ({seed})"),
             )
         elif fail_point == "read":
-            ctx = patch_storage_plugin(
-                faulty_fs_plugin(
-                    _data_blob,
-                    ops=("read",),
-                    exc_msg=f"injected read failure ({seed})",
+            ctx = _chaotic_fs_patch(
+                _data_blob_fault(
+                    seed, "storage-read", f"injected read failure ({seed})"
                 )
             )
         else:

@@ -637,6 +637,72 @@ def test_ledger_event_ids_repo_clean_on_head():
     assert result.new_findings == []
 
 
+# ---------------------------------------------------------------------------
+# crashpoint-ids
+# ---------------------------------------------------------------------------
+
+_CRASH_NAMES_BAD = """
+CRASH_FOO = "Not_Kebab"
+CRASH_FOO_AGAIN = "Not_Kebab"
+"""
+
+_CRASH_NAMES_FIXED = """
+CRASH_FOO = "foo-durable"
+"""
+
+_CRASH_EMIT_BAD = """
+from torchsnapshot_tpu.chaos import arm, crashpoint
+
+def take():
+    crashpoint("literal-point")
+
+def matrix():
+    arm(name="another-literal")
+"""
+
+_CRASH_EMIT_FIXED = """
+from torchsnapshot_tpu.chaos import arm, crashpoint
+from torchsnapshot_tpu.telemetry import names
+
+def take():
+    crashpoint(names.CRASH_FOO)
+
+def matrix():
+    arm(name=names.CRASH_FOO)
+"""
+
+
+def test_crashpoint_ids_detects_and_accepts_fix(tmp_path):
+    emitter = _doctor_layout(tmp_path, _CRASH_NAMES_BAD, _CRASH_EMIT_BAD)
+    analyzer = Analyzer(root=tmp_path, select=["crashpoint-ids"])
+    bad = analyzer.run([emitter], baseline=None)
+    msgs = _messages(bad)
+    assert any("not kebab-case" in m for m in msgs)
+    assert any("registered twice" in m for m in msgs)
+    assert any("'literal-point'" in m and "crashpoint" in m for m in msgs)
+    assert any("'another-literal'" in m and "arm" in m for m in msgs)
+
+    emitter = _doctor_layout(tmp_path, _CRASH_NAMES_FIXED, _CRASH_EMIT_FIXED)
+    analyzer = Analyzer(root=tmp_path, select=["crashpoint-ids"])
+    fixed = analyzer.run([emitter], baseline=None)
+    assert fixed.new_findings == []
+
+
+def test_crashpoint_ids_requires_declarations(tmp_path):
+    emitter = _doctor_layout(tmp_path, "X = 1\n", "def noop():\n    pass\n")
+    analyzer = Analyzer(root=tmp_path, select=["crashpoint-ids"])
+    result = analyzer.run([emitter], baseline=None)
+    assert any(
+        "no crash point ids declared" in m for m in _messages(result)
+    )
+
+
+def test_crashpoint_ids_repo_clean_on_head():
+    analyzer = Analyzer(root=REPO, select=["crashpoint-ids"])
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == []
+
+
 def test_inline_suppression_silences_one_rule(tmp_path):
     source = """
 import time
@@ -896,6 +962,7 @@ def test_cli_json_output_and_rule_listing():
         "span-name-literal",
         "doctor-rule-ids",
         "ledger-event-ids",
+        "crashpoint-ids",
         "tiered-test-markers",
         "native-decl-sync",
     ):

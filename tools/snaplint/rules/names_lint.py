@@ -36,6 +36,7 @@ _KEBAB_CASE = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)*$")
 _SPAN_PREFIXES = ("SPAN_", "INSTANT_")
 _RULE_PREFIX = "RULE_"
 _EVENT_PREFIX = "EVENT_"
+_CRASH_PREFIX = "CRASH_"
 _REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
 _TRACE_CALLABLES = {"trace_annotation", "span", "instant", "begin"}
 # Doctor emit surfaces: the rule-registration decorator and the verdict
@@ -46,6 +47,14 @@ _DOCTOR_CALLABLES = {"doctor_rule", "Verdict"}
 # id as their SECOND positional argument (the root/snapshot path comes
 # first) or as the ``event=`` keyword.
 _LEDGER_CALLABLES = {"post_event", "post_event_for_snapshot"}
+# Crash-point surfaces (chaos/crashpoints.py): the kill-point hook and
+# the single-point arming helper both take the declared id first — the
+# ``_crashpoint`` spelling covers the lazy-import aliases the
+# production call sites use (snapshot.py's local helper, manager.py's
+# ``crashpoint as _crashpoint`` import). A literal id at any of them
+# means the crash-matrix registry (the CRASH_ constants the harness
+# enumerates) can drift from the threaded points.
+_CRASHPOINT_CALLABLES = {"crashpoint", "_crashpoint", "arm"}
 
 NAMES_RELPATH = "torchsnapshot_tpu/telemetry/names.py"
 TRACE_EXEMPT_RELPATH = "torchsnapshot_tpu/telemetry/trace.py"
@@ -63,16 +72,17 @@ def check_metric_names_file(
     include_span_decls: bool = True,
     include_rule_decls: bool = True,
     include_event_decls: bool = True,
+    include_crash_decls: bool = True,
 ) -> List[str]:
     """Errors in the declaration file: malformed values (snake_case for
     metrics, colon-case for SPAN_/INSTANT_ trace names, kebab-case for
-    RULE_ doctor-verdict ids and EVENT_ ledger events), duplicate
-    constants, duplicate values. ``include_span_decls=False`` /
-    ``include_rule_decls=False`` / ``include_event_decls=False`` leave
-    the SPAN_/INSTANT_, RULE_ and EVENT_ checks to the span / doctor /
-    ledger rules (the unified registry runs all four; each defect
-    should report once — with the flag off, those constants are
-    skipped here entirely)."""
+    RULE_ doctor-verdict ids, EVENT_ ledger events and CRASH_ crash
+    points), duplicate constants, duplicate values. The
+    ``include_*_decls=False`` flags leave the SPAN_/INSTANT_, RULE_,
+    EVENT_ and CRASH_ checks to the span / doctor / ledger / crashpoint
+    rules (the unified registry runs all five; each defect should
+    report once — with the flag off, those constants are skipped here
+    entirely)."""
     errors = []
     if not path.exists():
         return [f"{path.name}: missing (metric names must be declared here)"]
@@ -89,6 +99,10 @@ def check_metric_names_file(
                 continue
             if not include_event_decls and target.id.startswith(
                 _EVENT_PREFIX
+            ):
+                continue
+            if not include_crash_decls and target.id.startswith(
+                _CRASH_PREFIX
             ):
                 continue
             if not include_span_decls and target.id.startswith(
@@ -124,6 +138,13 @@ def check_metric_names_file(
                         f"{path.name}:{node.lineno}: {value!r} is not "
                         f"kebab-case (ledger event ids look like "
                         f"'what-happened')"
+                    )
+            elif target.id.startswith(_CRASH_PREFIX):
+                if not _KEBAB_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"kebab-case (crash-point ids look like "
+                        f"'what-just-became-durable')"
                     )
             elif not _SNAKE_CASE.match(value):
                 errors.append(
@@ -252,6 +273,21 @@ def check_ledger_event_ids_file(path: Path) -> List[str]:
     )
 
 
+def check_crashpoint_ids_file(path: Path) -> List[str]:
+    """Errors in the declaration file's crash-point registry: no CRASH_
+    constants at all, non-kebab-case values, duplicate
+    constants/values."""
+    return _scan_prefixed_decls(
+        path,
+        (_CRASH_PREFIX,),
+        _KEBAB_CASE,
+        "kebab-case ('what-just-became-durable')",
+        "crash point",
+        "crash point ids",
+        "no crash point ids declared",
+    )
+
+
 # ---------------------------------------------------------------------------
 # call-site checks: ONE tree-level implementation
 # ---------------------------------------------------------------------------
@@ -341,6 +377,31 @@ def _iter_ledger_event_literal_sites(
             candidates.append(node.args[1])
         for kw in node.keywords:
             if kw.arg == "event":
+                candidates.append(kw.value)
+        for cand in candidates:
+            if isinstance(cand, ast.Constant) and isinstance(
+                cand.value, str
+            ):
+                yield node.lineno, called, cand.value
+
+
+def _iter_crashpoint_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, callable, literal) for string-literal crash-point ids
+    at kill-point sites: the first positional arg of ``crashpoint(...)``
+    / ``arm(...)`` or their ``name=`` keyword."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        called = _called_name(node.func)
+        if called not in _CRASHPOINT_CALLABLES:
+            continue
+        candidates = []
+        if node.args:
+            candidates.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "name":
                 candidates.append(kw.value)
         for cand in candidates:
             if isinstance(cand, ast.Constant) and isinstance(
@@ -476,6 +537,7 @@ class MetricNameLiteral(Rule):
                 include_span_decls=False,
                 include_rule_decls=False,
                 include_event_decls=False,
+                include_crash_decls=False,
             ),
             project,
         )
@@ -554,6 +616,40 @@ class LedgerEventIds(Rule):
                     message=(
                         f"literal event id {literal!r} in {called}() — "
                         f"use a telemetry/names.py EVENT_ constant"
+                    ),
+                )
+
+
+@register
+class CrashpointIds(Rule):
+    name = "crashpoint-ids"
+    description = (
+        "crash-point ids: kebab-case, declared exactly once in "
+        "telemetry/names.py (CRASH_ constants), no literal ids at "
+        "crashpoint()/arm() kill-point sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return
+        yield from _decl_findings(
+            self.name, check_crashpoint_ids_file(names_file), project
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath == NAMES_RELPATH:
+                continue
+            for lineno, called, literal in _iter_crashpoint_literal_sites(
+                tree
+            ):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal crash-point id {literal!r} in "
+                        f"{called}() — use a telemetry/names.py CRASH_ "
+                        f"constant"
                     ),
                 )
 

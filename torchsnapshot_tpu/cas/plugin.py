@@ -151,6 +151,12 @@ class CASStoragePlugin(StoragePlugin):
         self.records[write_io.path] = (key, nbytes, True)
         registry.counter_inc(metric_names.CAS_CHUNKS_WRITTEN_TOTAL)
         registry.counter_inc(metric_names.CAS_BYTES_WRITTEN_TOTAL, nbytes)
+        # Kill point: the chunk's bytes exist, but no map/manifest/pin
+        # references them yet — the stray-sweep + grace-window case the
+        # crash matrix must prove safe.
+        from ..chaos import crashpoint
+
+        crashpoint(metric_names.CRASH_CAS_CHUNK_WRITTEN)
 
     async def write(self, write_io: WriteIO) -> None:
         if not is_data_path(write_io.path):
@@ -180,6 +186,9 @@ class CASStoragePlugin(StoragePlugin):
 
     async def read_with_checksum(self, read_io: ReadIO):
         return await self.inner.read_with_checksum(read_io)
+
+    async def read_degraded(self, read_io: ReadIO) -> bool:
+        return await self.inner.read_degraded(read_io)
 
     async def delete(self, path: str) -> None:
         await self.inner.delete(path)

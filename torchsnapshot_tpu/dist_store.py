@@ -472,17 +472,33 @@ _CMD_OP_NAMES = {
 }
 
 
+# Chaos-engineering seam (chaos/engine.py install_wire_chaos): when
+# set, every frame in BOTH directions passes through the hook — fail /
+# delay / corrupt injection over the one framing the TCP store and the
+# peer transport share. None in production; reads cost one global load.
+_WIRE_CHAOS = None
+
+
 def send_frame(sock: socket.socket, payload: bytes) -> None:
     """Length-prefixed frame write — the one wire framing shared by the
     TCP store and the peer-tier transport (tiered/peer.py), so the two
     socket protocols cannot drift in how they delimit messages."""
+    hook = _WIRE_CHAOS
+    if hook is not None:
+        payload = hook("wire-send", payload)
+        if payload is None:
+            return  # dropped frame: the receiver waits it out
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
 
 def recv_frame(sock: socket.socket) -> bytes:
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack("<I", header)
-    return _recv_exact(sock, length)
+    payload = _recv_exact(sock, length)
+    hook = _WIRE_CHAOS
+    if hook is not None:
+        payload = hook("wire-recv", payload)
+    return payload
 
 
 # Internal aliases kept for the store's own call sites.

@@ -452,6 +452,20 @@ class _FanoutStoragePlugin(StoragePlugin):
             read_io.buf = read_io.dest
         else:
             read_io.buf = memoryview(chunk)
+        read_io.served_by = "fanout-cache"
+
+    async def read_degraded(self, read_io: ReadIO) -> bool:
+        """Corruption fallthrough: a cache-served blob whose exchanged
+        bytes fail verification re-reads from real storage directly
+        (the owner's fetch — or the wire — damaged them); everything
+        else walks the wrapped plugin's own ladder."""
+        if read_io.served_by == "fanout-cache":
+            read_io.served_by = None
+            await self.inner.read(read_io)
+            if read_io.served_by is None:
+                read_io.served_by = "storage"
+            return True
+        return await self.inner.read_degraded(read_io)
 
     async def write(self, write_io: WriteIO) -> None:  # pragma: no cover
         await self.inner.write(write_io)

@@ -33,7 +33,7 @@ import logging
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import knobs
-from .io_types import ReadIO, StoragePlugin
+from .io_types import ReadIO, StoragePlugin, WriteIO
 from .manifest import (
     ArrayEntry,
     ChunkedArrayEntry,
@@ -563,7 +563,6 @@ def verify_cas_store(root: str, deep: bool = False) -> CasStoreReport:
                     )
                 )
             crcs_verified = 0
-            slots = asyncio.Semaphore(knobs.get_per_rank_io_concurrency())
             checks: List[Tuple[str, int]] = []
             for key in sorted(set(referenced) & set(present)):
                 want = nbytes_of_key(key)
@@ -590,32 +589,34 @@ def verify_cas_store(root: str, deep: bool = False) -> CasStoreReport:
                 checks.append((key, want if want is not None else 0))
 
             if deep and checks:
+                # Per-TIER verification against the self-describing key
+                # (not a read through the composed fast-first view,
+                # which would let a good fast copy mask size-preserving
+                # corruption in the durable copy — exactly the damage
+                # ``--repair`` exists to fix).
+                import os as _os
 
-                async def _deep_one(key: str, nbytes: int) -> bool:
-                    # The key IS the expected entry: self-verifying.
-                    parsed = parse_key(key)
-                    if parsed is None:
-                        return False
-                    alg, want_n, want_crc = parsed
-                    location = f"{CHUNKS_DIRNAME}/{key}"
-                    _, ok = await _check_blob(
-                        storage,
-                        location,
-                        nbytes,
-                        True,
-                        {location: (alg, want_crc, want_n)},
-                        problems,
-                        slots,
-                    )
-                    return ok
-
-                async def _run_deep() -> List[bool]:
-                    return await asyncio.gather(
-                        *(_deep_one(k, n) for k, n in checks)
-                    )
-
-                results = event_loop.run_until_complete(_run_deep())
-                crcs_verified = sum(1 for ok in results if ok)
+                for key, _nbytes in checks:
+                    if parse_key(key) is None:
+                        continue
+                    all_ok = True
+                    for tier_dir in sorted(present[key]):
+                        if not _chunk_copy_ok(
+                            _os.path.join(tier_dir, key), key
+                        ):
+                            all_ok = False
+                            problems.append(
+                                FsckProblem(
+                                    f"{CHUNKS_DIRNAME}/{key}",
+                                    "checksum",
+                                    f"bytes do not match the digest "
+                                    f"key in {tier_dir} (fsck "
+                                    f"--repair rebuilds from a "
+                                    f"verifying tier)",
+                                )
+                            )
+                    if all_ok:
+                        crcs_verified += 1
 
             unreferenced = {
                 k: max(copies.values())
@@ -760,7 +761,323 @@ def verify_snapshot(
         event_loop.close()
 
 
-def _cas_main(root: str, deep: bool) -> int:
+QUARANTINE_DIRNAME = ".quarantine"
+
+
+@dataclasses.dataclass
+class RepairReport:
+    """What ``fsck --repair`` did (docs/chaos.md): ``rewritten`` maps a
+    damaged location to the tier directory (or tier name) whose copy
+    verified and re-sourced it; ``quarantined`` lists locations no tier
+    could vouch for — their copies moved to ``chunks/.quarantine/``
+    (chunks) or were left in place but reported (legacy blobs), so a
+    later restore fails loudly instead of serving rot; ``unrepairable``
+    lists damage with no alternate source at all (non-tiered roots,
+    dangling refs)."""
+
+    target: str
+    rewritten: Dict[str, str] = dataclasses.field(default_factory=dict)
+    quarantined: List[str] = dataclasses.field(default_factory=list)
+    unrepairable: List[FsckProblem] = dataclasses.field(
+        default_factory=list
+    )
+    checked: int = 0
+
+    @property
+    def acted(self) -> bool:
+        return bool(self.rewritten or self.quarantined)
+
+
+def _post_repair_event(root: str, report: RepairReport) -> None:
+    """Record the repair in the root's run ledger (only roots a manager
+    opened a run for carry one — ``create=False``); the
+    ``storage-corruption`` doctor rule cites these records."""
+    try:
+        from .telemetry import ledger as run_ledger
+        from .telemetry import names as event_names
+
+        run_ledger.post_event(
+            root,
+            event_names.EVENT_REPAIR_PERFORMED,
+            target=report.target,
+            rewritten=len(report.rewritten),
+            quarantined=len(report.quarantined),
+            unrepairable=len(report.unrepairable),
+            locations=sorted(
+                list(report.rewritten) + report.quarantined
+            )[:20],
+        )
+    except Exception as e:  # noqa: BLE001 - repair must not fail on telemetry
+        logger.warning("could not post repair-performed event: %r", e)
+
+
+def _chunk_copy_ok(path: str, key: str) -> bool:
+    """Verify one on-disk chunk copy against its self-describing key
+    (size + whole-blob CRC, streamed in bounded chunks)."""
+    import os as _os
+
+    from .cas import parse_key
+    from .integrity import _alg_available, _crc_of
+
+    parsed = parse_key(key)
+    if parsed is None:
+        return False
+    alg, want_n, want_crc = parsed
+    try:
+        if _os.path.getsize(path) != want_n:
+            return False
+    except OSError:
+        return False
+    if not _alg_available(alg):
+        return True  # cannot judge the bytes; size is all we have
+    crc = 0
+    try:
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(_DEEP_CHUNK_BYTES)
+                if not block:
+                    break
+                crc = _crc_of(memoryview(block), alg, seed=crc)
+    except OSError:
+        return False
+    return crc == want_crc
+
+
+def repair_cas_store(root: str) -> RepairReport:
+    """Cross-tier chunk repair: every chunk a committed manifest
+    references is verified per tier copy against its digest key; a
+    damaged copy is rewritten from whichever tier's copy verifies, and
+    a chunk with NO verifying copy has every copy moved to
+    ``chunks/.quarantine/<key>`` — a dangling ref a later restore fails
+    on loudly, never bytes served silently corrupt. Posts one
+    ``repair-performed`` ledger event when anything was done."""
+    import os as _os
+
+    from .cas import chunk_refs
+
+    report = RepairReport(target=root)
+    referenced: Set[str] = set()
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin(root)
+        try:
+            from . import manager as manager_mod
+
+            try:
+                index = event_loop.run_until_complete(
+                    manager_mod.read_index_full_async(storage)
+                )
+                steps = sorted(set(index["steps"]) | set(index["pinned"]))
+            except Exception as e:  # noqa: BLE001
+                report.unrepairable.append(
+                    FsckProblem(manager_mod.INDEX_BLOB, "unreadable", repr(e))
+                )
+                steps = []
+            for step in steps:
+                meta_path = (
+                    f"{manager_mod._step_dirname(step)}/"
+                    f"{SNAPSHOT_METADATA_FNAME}"
+                )
+                read_io = ReadIO(path=meta_path)
+                try:
+                    event_loop.run_until_complete(storage.read(read_io))
+                    metadata = SnapshotMetadata.from_yaml(
+                        bytes(read_io.buf).decode("utf-8")
+                    )
+                except Exception:  # noqa: BLE001 - verify reports these
+                    continue
+                referenced.update(chunk_refs(metadata.manifest))
+        finally:
+            event_loop.run_until_complete(storage.close())
+    finally:
+        event_loop.close()
+
+    present = _present_chunks(root)
+    for key in sorted(referenced & set(present)):
+        report.checked += 1
+        copies = present[key]
+        status = {
+            tier_dir: _chunk_copy_ok(_os.path.join(tier_dir, key), key)
+            for tier_dir in sorted(copies)
+        }
+        good = [t for t, ok in status.items() if ok]
+        bad = [t for t, ok in status.items() if not ok]
+        if not bad:
+            continue
+        if good:
+            src = _os.path.join(good[0], key)
+            with open(src, "rb") as f:
+                data = f.read()
+            for tier_dir in bad:
+                dst = _os.path.join(tier_dir, key)
+                tmp = dst + ".repair-tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                _os.replace(tmp, dst)
+                report.rewritten[f"{tier_dir}/{key}"] = good[0]
+        else:
+            for tier_dir in bad:
+                qdir = _os.path.join(tier_dir, QUARANTINE_DIRNAME)
+                _os.makedirs(qdir, exist_ok=True)
+                _os.replace(
+                    _os.path.join(tier_dir, key),
+                    _os.path.join(qdir, key),
+                )
+            report.quarantined.append(key)
+            report.unrepairable.append(
+                FsckProblem(
+                    f"chunks/{key}",
+                    "checksum",
+                    "no tier holds a verifying copy; all copies "
+                    "quarantined (chunks/.quarantine/)",
+                )
+            )
+    for key in sorted(referenced - set(present)):
+        report.unrepairable.append(
+            FsckProblem(
+                f"chunks/{key}",
+                "missing",
+                "referenced chunk absent from every tier (dangling "
+                "ref); nothing to rebuild from",
+            )
+        )
+    if report.acted:
+        _post_repair_event(root, report)
+    return report
+
+
+def repair_snapshot(path: str) -> RepairReport:
+    """Cross-tier repair of one committed snapshot's step-local blobs
+    (tiered:// paths): every blob with a recorded digest is verified
+    per tier, and a damaged copy is rewritten from the tier whose copy
+    verifies. Parent-relative locations are skipped — incremental refs
+    belong to their origin step, chunk refs to ``--cas --repair``.
+    Non-tiered paths have no alternate source: damage is reported
+    unrepairable (restores already fail loudly on it)."""
+    from .cas import root_url_of_snapshot
+    from .integrity import load_checksum_tables, verify_checksum
+
+    report = RepairReport(target=path)
+    tiers = split_tiered_url(path)
+    event_loop = asyncio.new_event_loop()
+    try:
+        storage = url_to_storage_plugin(path)
+        try:
+            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+            try:
+                event_loop.run_until_complete(storage.read(read_io))
+                metadata = SnapshotMetadata.from_yaml(
+                    bytes(read_io.buf).decode("utf-8")
+                )
+            except Exception as e:  # noqa: BLE001
+                report.unrepairable.append(
+                    FsckProblem(SNAPSHOT_METADATA_FNAME, "unreadable", repr(e))
+                )
+                return report
+            table = load_checksum_tables(
+                metadata.world_size, storage, event_loop
+            )
+        finally:
+            event_loop.run_until_complete(storage.close())
+        if not table:
+            report.unrepairable.append(
+                FsckProblem(
+                    SNAPSHOT_METADATA_FNAME,
+                    "unreadable",
+                    "no checksum tables: repair cannot judge which "
+                    "copy is sound",
+                )
+            )
+            return report
+        need = blob_requirements(metadata.manifest)
+        locations = sorted(
+            loc
+            for loc in need
+            if not loc.startswith("../") and loc in table
+        )
+        if tiers is None:
+            return report  # single tier: nothing to rebuild from
+        tier_plugins = []
+        for tier_name, tier_url in zip(("fast", "durable"), tiers):
+            tier_plugins.append(
+                (tier_name, url_to_storage_plugin(tier_url))
+            )
+        try:
+            for loc in locations:
+                report.checked += 1
+                entry = table[loc]
+                copies: Dict[str, Optional[bytes]] = {}
+                for tier_name, plugin in tier_plugins:
+                    tier_io = ReadIO(path=loc)
+                    try:
+                        event_loop.run_until_complete(plugin.read(tier_io))
+                        copies[tier_name] = bytes(tier_io.buf)
+                    except FileNotFoundError:
+                        continue  # absent here (evicted/unmirrored): fine
+                    except Exception:  # noqa: BLE001
+                        copies[tier_name] = None
+                good: Optional[bytes] = None
+                bad: List[str] = []
+                for tier_name, data in copies.items():
+                    ok = False
+                    if data is not None:
+                        try:
+                            verify_checksum(data, entry, loc)
+                            ok = True
+                        except Exception:  # noqa: BLE001 - damage
+                            ok = False
+                    if ok and good is None:
+                        good = data
+                    elif not ok:
+                        bad.append(tier_name)
+                if not bad:
+                    continue
+                if good is None:
+                    report.unrepairable.append(
+                        FsckProblem(
+                            loc,
+                            "checksum",
+                            f"no tier holds a verifying copy "
+                            f"(damaged: {sorted(bad)})",
+                        )
+                    )
+                    continue
+                for tier_name in bad:
+                    plugin = dict(tier_plugins)[tier_name]
+                    event_loop.run_until_complete(
+                        plugin.write(WriteIO(path=loc, buf=good))
+                    )
+                    report.rewritten[f"{tier_name}:{loc}"] = "cross-tier"
+        finally:
+            for _, plugin in tier_plugins:
+                event_loop.run_until_complete(plugin.close())
+    finally:
+        event_loop.close()
+    if report.acted:
+        try:
+            _post_repair_event(root_url_of_snapshot(path), report)
+        except ValueError:
+            pass  # rootless path shapes carry no ledger
+    return report
+
+
+def _print_repair(report: RepairReport) -> None:
+    for loc, src in sorted(report.rewritten.items()):
+        print(f"FSCK repaired: {loc}: rewritten from {src}")
+    for key in report.quarantined:
+        print(
+            f"FSCK quarantined: chunks/{key}: no tier verified; moved "
+            f"to chunks/{QUARANTINE_DIRNAME}/"
+        )
+    for prob in report.unrepairable:
+        print(f"FSCK unrepairable: {prob.location}: {prob.detail}")
+    if not report.acted and not report.unrepairable:
+        print(f"repair: nothing to do ({report.checked} location(s) sound)")
+
+
+def _cas_main(root: str, deep: bool, repair: bool = False) -> int:
+    if repair:
+        _print_repair(repair_cas_store(root))
     report = verify_cas_store(root, deep=deep)
     for prob in report.problems:
         print(f"FSCK {prob.kind}: {prob.location}: {prob.detail}")
@@ -839,9 +1156,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "leftovers are listed, and the dedup ratio / bytes per "
         "retained step are reported",
     )
+    p.add_argument(
+        "--repair",
+        action="store_true",
+        help="before the audit, rebuild damaged copies from whichever "
+        "tier verifies: with --cas, per-tier chunk repair against the "
+        "self-describing digest keys (unrepairable chunks move to "
+        "chunks/.quarantine/ and their refs dangle loudly); without, "
+        "cross-tier rewrite of a tiered snapshot's step-local blobs "
+        "against the checksum tables. Posts repair-performed ledger "
+        "events the storage-corruption doctor rule cites "
+        "(docs/chaos.md)",
+    )
     args = p.parse_args(argv)
     if args.cas:
-        return _cas_main(args.path, deep=args.deep)
+        return _cas_main(args.path, deep=args.deep, repair=args.repair)
+    if args.repair:
+        _print_repair(repair_snapshot(args.path))
     report = verify_snapshot(args.path, deep=args.deep, tier=args.tier)
     if args.stats:
         # One artifact sweep: the same Evidence bundle drives the

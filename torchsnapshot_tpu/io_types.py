@@ -110,12 +110,18 @@ class ReadIO:
     (exactly the requested length). Plugins MAY read straight into it and
     set ``buf = dest`` — skipping the intermediate allocation and the
     consumer's copy — or ignore it and fill ``buf`` as usual.
+
+    ``served_by`` is stamped by multi-source plugins (tiered, the peer
+    ladder) with the tier that produced ``buf`` — the state
+    :meth:`StoragePlugin.read_degraded` needs to try the *other*
+    sources when verification rejects these bytes.
     """
 
     path: str
     byte_range: Optional[Tuple[int, int]] = None
     buf: Optional[memoryview] = None
     dest: Optional[memoryview] = None
+    served_by: Optional[str] = field(default=None, compare=False)
 
 
 class BufferStager(abc.ABC):
@@ -216,6 +222,18 @@ class StoragePlugin(abc.ABC):
         for the rest of the pipeline run (a capability signal, not a
         per-request choice); ranged reads never reach this hook."""
         return None
+
+    async def read_degraded(self, read_io: ReadIO) -> bool:
+        """Self-healing hook: the bytes a prior :meth:`read` of
+        ``read_io`` produced failed digest verification — re-serve the
+        request from an alternate source (another tier's copy) if one
+        remains untried. Returns True when an alternate produced bytes
+        (``buf`` refilled, ``served_by`` restamped; the caller
+        re-verifies and may call again on another mismatch), False when
+        no alternates remain — the caller then raises the original
+        ``ChecksumError``. Single-source plugins keep this default:
+        there is nowhere else to turn."""
+        return False
 
     @abc.abstractmethod
     async def delete(self, path: str) -> None: ...

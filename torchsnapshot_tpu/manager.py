@@ -41,6 +41,7 @@ import threading
 from typing import Any, Dict, List, Optional, Set
 
 from . import knobs, telemetry
+from .chaos import crashpoint as _crashpoint
 from .event_loop import run_in_fresh_event_loop
 from .telemetry import names as metric_names
 from .io_types import ReadIO, StoragePlugin, WriteIO
@@ -1012,6 +1013,9 @@ class CheckpointManager:
             k: n for k, n in chunk_refs.items() if k not in pinned_before
         }
         store.pin(step, chunk_refs)
+        # Kill point: pinned-but-unindexed (the index write is still
+        # pending) — construction-time reconcile must unpin on reload.
+        _crashpoint(metric_names.CRASH_REFCOUNT_PINNED)
         # Chunks resurrected from the orphan (grace-deferred) list are
         # live again: drop them from it so GC stops considering them.
         revived = set(chunk_refs) & set(orphans)
@@ -1052,6 +1056,11 @@ class CheckpointManager:
                 store.unpin(old)
                 unpinned = True
                 candidates.update(chunks)
+        if unpinned:
+            # Kill point: steps unpinned, reclaim deletes still pending
+            # (dead chunks must age out via grace/stray sweeps, never
+            # dangle).
+            _crashpoint(metric_names.CRASH_GC_UNPINNED)
         live = store.live_chunks(pins)
         # Stray sweep: on-disk chunks in NO pin and NO orphan record —
         # a take that crashed before its commit pinned them, or pins
@@ -1230,7 +1239,11 @@ class CheckpointManager:
         # impossible except for a torn first-ever index write, which is
         # what _read_index_async's recovery rule assumes.
         await storage.write(WriteIO(path=INDEX_BACKUP_BLOB, buf=payload))
+        # Kill point: the torn pair — a valid NEW backup behind a stale
+        # primary, the exact state the read-side recovery rule assumes.
+        _crashpoint(metric_names.CRASH_INDEX_BACKUP_WRITTEN)
         await storage.write(WriteIO(path=INDEX_BLOB, buf=payload))
+        _crashpoint(metric_names.CRASH_INDEX_WRITTEN)
 
     def _read_index(self) -> List[int]:
         return self._with_root_storage(self._read_index_async)
@@ -1429,6 +1442,9 @@ class CheckpointManager:
                 return  # never committed; nothing authoritative to walk
             metadata = SnapshotMetadata.from_yaml(bytes(read_io.buf).decode())
             await storage.delete(SNAPSHOT_METADATA_FNAME)
+            # Kill point: the dropped step is uncommitted but its data
+            # blobs remain — garbage, never a valid-looking snapshot.
+            _crashpoint(metric_names.CRASH_GC_MARKER_DELETED)
             if isinstance(storage, TieredStoragePlugin):
                 from .tiered.journal import MirrorJournal
 

@@ -242,7 +242,9 @@ def _flash_attention_sharded(
     has_dp = "dp" in mesh.axis_names
     has_tp = "tp" in mesh.axis_names
     spec = P("dp" if has_dp else None, None, "tp" if has_tp else None, None)
-    fn = jax.shard_map(
+    from ..utils import shard_map_compat
+
+    fn = shard_map_compat(
         functools.partial(flash_causal_attention, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -148,7 +148,9 @@ def pipelined_apply(
     spec_params = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stage_params
     )
-    out = jax.shard_map(
+    from ..utils import shard_map_compat
+
+    out = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(spec_params, P()),

@@ -42,6 +42,7 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 from . import knobs, telemetry
 from .telemetry.trace import get_recorder as _trace_recorder
 from .integrity import (
+    ChecksumError,
     ChecksumTable,
     compute_checksum_entry,
     verify_checksum,
@@ -288,6 +289,13 @@ class _PipelineStats:
         # accounted as fetched/received by the exchange that shipped
         # them, not here). bytes_moved - bytes_fetched = locally-served.
         self.bytes_fetched = 0
+        # Self-healing reads (docs/chaos.md): requests whose first copy
+        # failed digest verification and were re-served from an
+        # alternate tier — count/bytes totals plus bytes by the tier
+        # that finally vouched (folded into the report's tier_split).
+        self.degraded_reads = 0
+        self.degraded_bytes = 0
+        self.degraded_tier_bytes: dict = {}
 
 
 # report_phase_done -> the phase the op is IN once that one completed,
@@ -878,48 +886,105 @@ async def execute_read_reqs(
             # land in framework-owned buffers only).
             if entry is not None:
                 loop_ = asyncio.get_running_loop()
-                verified_from_pages = False
-                if fused_pages is not None:
-                    # Pure GF(2) fold over the pages read — O(pages),
-                    # no second pass over the bytes, no executor hop.
-                    # False = this entry needs the bytes (foreign alg /
-                    # mismatched interim granularity): verify below.
-                    verified_from_pages = verify_page_crcs(
-                        fused_pages, memoryview(buf).nbytes, entry, req.path
+
+                async def _verify_current(
+                    cur_buf, use_fused_pages=None
+                ) -> None:
+                    verified_from_pages = False
+                    if use_fused_pages is not None:
+                        # Pure GF(2) fold over the pages read — O(pages),
+                        # no second pass over the bytes, no executor hop.
+                        # False = this entry needs the bytes (foreign alg
+                        # / mismatched interim granularity): verify below.
+                        verified_from_pages = verify_page_crcs(
+                            use_fused_pages,
+                            memoryview(cur_buf).nbytes,
+                            entry,
+                            req.path,
+                        )
+                    # Small buffers verify inline: the executor
+                    # round-trip costs ~0.1 ms against sub-microsecond
+                    # hashing (same rationale as checksum_off_slot).
+                    small = (
+                        memoryview(cur_buf).nbytes <= _INLINE_CHECKSUM_BYTES
                     )
-                # Small buffers verify inline: the executor round-trip
-                # costs ~0.1 ms against sub-microsecond hashing (same
-                # rationale as the write pipeline's checksum_off_slot).
-                small = memoryview(buf).nbytes <= _INLINE_CHECKSUM_BYTES
-                if verified_from_pages:
-                    pass
-                elif req.byte_range is None:
-                    if small:
-                        verify_checksum(buf, entry, req.path)
+                    if verified_from_pages:
+                        pass
+                    elif req.byte_range is None:
+                        if small:
+                            verify_checksum(cur_buf, entry, req.path)
+                        else:
+                            await loop_.run_in_executor(
+                                executor,
+                                verify_checksum,
+                                cur_buf,
+                                entry,
+                                req.path,
+                            )
                     else:
-                        await loop_.run_in_executor(
-                            executor,
-                            verify_checksum,
-                            buf,
-                            entry,
-                            req.path,
-                        )
-                else:
-                    if small:
-                        page_verified = verify_range_checksum(
-                            buf, entry, req.byte_range, req.path
-                        )
-                    else:
-                        page_verified = await loop_.run_in_executor(
-                            executor,
-                            verify_range_checksum,
-                            buf,
-                            entry,
-                            req.byte_range,
-                            req.path,
-                        )
-                    if not page_verified:
-                        verify_skipped[0] += 1
+                        if small:
+                            page_verified = verify_range_checksum(
+                                cur_buf, entry, req.byte_range, req.path
+                            )
+                        else:
+                            page_verified = await loop_.run_in_executor(
+                                executor,
+                                verify_range_checksum,
+                                cur_buf,
+                                entry,
+                                req.byte_range,
+                                req.path,
+                            )
+                        if not page_verified:
+                            verify_skipped[0] += 1
+
+                try:
+                    await _verify_current(buf, use_fused_pages=fused_pages)
+                except ChecksumError as first_err:
+                    # Self-healing ladder (docs/chaos.md): a corrupt
+                    # tier copy must not fail a restore the OTHER tiers
+                    # could serve. Multi-source plugins re-read from
+                    # alternates (tiered: the other tier; the peer
+                    # ladder: durable/fast) until one verifies;
+                    # single-source plugins have none and the original
+                    # error stands — corruption is never served
+                    # silently either way.
+                    healed = False
+                    async with io_slots:
+                        while await storage.read_degraded(read_io):
+                            buf = read_io.buf
+                            try:
+                                await _verify_current(buf)
+                            except ChecksumError:
+                                continue
+                            healed = True
+                            break
+                    if not healed:
+                        raise
+                    tier = read_io.served_by or "unknown"
+                    nbytes = memoryview(buf).nbytes
+                    stats.degraded_reads += 1
+                    stats.degraded_bytes += nbytes
+                    stats.degraded_tier_bytes[tier] = (
+                        stats.degraded_tier_bytes.get(tier, 0) + nbytes
+                    )
+                    registry = telemetry.metrics()
+                    registry.counter_inc(
+                        telemetry.names.STORAGE_DEGRADED_READS_TOTAL,
+                        tier=tier,
+                    )
+                    registry.counter_inc(
+                        telemetry.names.STORAGE_DEGRADED_READ_BYTES_TOTAL,
+                        nbytes,
+                        tier=tier,
+                    )
+                    logger.warning(
+                        "read of %s failed verification (%s); healed "
+                        "from the %r tier copy",
+                        req.path,
+                        first_err,
+                        tier,
+                    )
             if read_io.dest is not None and buf is read_io.dest:
                 # The plugin read straight into the destination; nothing
                 # left to deserialize or copy.
@@ -974,6 +1039,16 @@ async def execute_read_reqs(
     # bytes_fetched equals bytes_moved and the read-amplification math
     # works whether or not fan-out ran.
     out["bytes_fetched"] = stats.bytes_fetched
+    if stats.degraded_reads:
+        # Corruption-rerouted reads: the count/bytes summary the
+        # storage-corruption doctor rule cites, plus the serving tiers
+        # folded into the report's tier_split so the reroute is visible
+        # in the same split the peer ladder reports.
+        out["degraded_reads"] = {
+            "blobs": stats.degraded_reads,
+            "bytes": stats.degraded_bytes,
+        }
+        out["tier_split"] = dict(stats.degraded_tier_bytes)
     return out
 
 

@@ -161,10 +161,20 @@ def _merge_peer_telemetry(pipeline: Optional[dict], peer_ctx) -> None:
     the restore's merged pipeline telemetry: ``tier_split`` (bytes
     served per tier of the peer RAM -> fast -> durable ladder) and the
     ``peer`` degradation evidence the ``peer-tier-degraded`` doctor
-    rule cites."""
+    rule cites. The ladder's split supersedes any scheduler-recorded
+    one (its ``read_degraded`` already counted corruption reroutes into
+    ``tier_bytes`` — summing would double-count); the scheduler's
+    ``degraded_reads`` summary rides alongside untouched."""
     if peer_ctx is None or pipeline is None:
         return
     pipeline.update(peer_ctx.pipeline_fields())
+
+
+def _crashpoint(name: str) -> None:
+    """Chaos kill point (chaos/crashpoints.py): production no-op."""
+    from .chaos import crashpoint
+
+    crashpoint(name)
 
 
 def _maybe_push_to_peer(path: str, pending_io_work) -> None:
@@ -179,6 +189,8 @@ def _maybe_push_to_peer(path: str, pending_io_work) -> None:
         peer_tier.maybe_enqueue_push(path, pending_io_work.checksums)
     except Exception as e:  # noqa: BLE001 - the peer tier must never fail a take
         logger.warning("peer tier: post-commit push hook failed: %r", e)
+    # Kill point: the post-commit peer hook ran (enqueue, not settle).
+    _crashpoint(telemetry.names.CRASH_PEER_ENQUEUED)
 
 
 def _maybe_cas_storage(
@@ -441,13 +453,16 @@ class Snapshot:
                     progress_tracker=tracker,
                 )
                 pending_io_work.sync_complete(event_loop)
+                _crashpoint(telemetry.names.CRASH_TAKE_WRITES_DONE)
                 pending_io_work.finalize_checksums()
                 _maybe_write_checksum_table(
                     pending_io_work, pg_wrapper.get_rank(), storage, event_loop
                 )
+                _crashpoint(telemetry.names.CRASH_CHECKSUM_TABLE_WRITTEN)
                 _maybe_write_cas_map(
                     storage, pg_wrapper.get_rank(), event_loop
                 )
+                _crashpoint(telemetry.names.CRASH_CAS_MAP_WRITTEN)
 
             # All writes are durable on every rank before the commit marker
             # exists anywhere (commit-after-barrier invariant). The commit
@@ -836,6 +851,10 @@ class Snapshot:
         event_loop.run_until_complete(
             maybe_rewrite_manifest(metadata, storage)
         )
+        # Kill points bracketing the commit write: before, the step
+        # must read as never-happened; after, as committed (whether or
+        # not anything downstream — index, mirror, peer — ever ran).
+        _crashpoint(telemetry.names.CRASH_PRE_COMMIT_MARKER)
         # Committed as JSON — a YAML subset (reference manifest.py:19-22
         # invariant), so any YAML tooling still reads it, and loading takes
         # the fast json.loads path instead of a YAML parse.
@@ -843,6 +862,7 @@ class Snapshot:
         event_loop.run_until_complete(
             storage.write(WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=metadata_bytes))
         )
+        _crashpoint(telemetry.names.CRASH_COMMIT_MARKER)
 
     # ------------------------------------------------------------------
     # metadata / manifest
@@ -1796,6 +1816,7 @@ class PendingSnapshot:
                 f"__snapshot_commit/{self.commit_nonce}", self.pg
             )
             self._pending_io_work.sync_complete(self._event_loop)
+            _crashpoint(telemetry.names.CRASH_TAKE_WRITES_DONE)
             self._pending_io_work.finalize_checksums()
             _maybe_write_checksum_table(
                 self._pending_io_work,
@@ -1803,9 +1824,11 @@ class PendingSnapshot:
                 self._storage,
                 self._event_loop,
             )
+            _crashpoint(telemetry.names.CRASH_CHECKSUM_TABLE_WRITTEN)
             _maybe_write_cas_map(
                 self._storage, self.pg.get_rank(), self._event_loop
             )
+            _crashpoint(telemetry.names.CRASH_CAS_MAP_WRITTEN)
             if barrier is not None:
                 barrier.arrive()
             if self.pg.get_rank() == 0:

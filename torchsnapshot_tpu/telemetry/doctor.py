@@ -602,6 +602,68 @@ def _peer_tier_degraded(report: Dict[str, Any]):
     }
 
 
+@doctor_rule(names.RULE_STORAGE_CORRUPTION)
+def _storage_corruption_report(report: Dict[str, Any]):
+    """A restore's bytes failed digest verification on their first tier
+    and were re-served through the healing ladder (docs/chaos.md): the
+    op succeeded, but a stored copy is rotting. Evidence cites the
+    rerouted blob/byte counts and the tiers that finally vouched."""
+    degraded = report.get("degraded_reads") or {}
+    if not int(degraded.get("blobs", 0)):
+        return None
+    tier_split = report.get("tier_split") or {}
+    return {
+        "summary": (
+            "stored bytes failed checksum verification and restore "
+            "rerouted around the corrupt copies — the data survived, "
+            "the medium did not; run fsck --repair on the root and "
+            "audit the tier the reroutes avoided"
+        ),
+        "evidence": {
+            "degraded_blobs": int(degraded.get("blobs", 0)),
+            "degraded_bytes": int(degraded.get("bytes", 0)),
+            **{
+                f"{tier}_bytes": int(nbytes)
+                for tier, nbytes in sorted(tier_split.items())
+            },
+        },
+    }
+
+
+@doctor_rule(names.RULE_STORAGE_CORRUPTION, scope="evidence")
+def _storage_corruption_repairs(ev: Evidence):
+    """``fsck --repair`` recorded repair-performed ledger events for
+    this root: chunks/blobs were rewritten from a verifying tier, or
+    quarantined when no tier verified. Quarantines are critical — a
+    referenced blob is now unrestorable by design (never served
+    corrupt); rewrites are the medium-rot warning."""
+    repairs = [
+        r
+        for r in ev.ledger_records
+        if r.get("event") == names.EVENT_REPAIR_PERFORMED
+    ]
+    if not repairs:
+        return None
+    rewritten = sum(int(r.get("rewritten", 0)) for r in repairs)
+    quarantined = sum(int(r.get("quarantined", 0)) for r in repairs)
+    return {
+        "summary": (
+            "fsck --repair acted on corrupt stored bytes: "
+            f"{rewritten} location(s) rewritten from a verifying tier, "
+            f"{quarantined} quarantined (no tier verified — restores "
+            "of those blobs now fail loudly instead of serving rot)"
+        ),
+        "severity": "critical" if quarantined else "warning",
+        "evidence": {
+            "repair_events": len(repairs),
+            "rewritten": rewritten,
+            "quarantined": quarantined,
+            "last_unix_ts": repairs[-1].get("unix_ts"),
+        },
+        "source": ev.ledger_file,
+    }
+
+
 @doctor_rule(names.RULE_RETRY_STORM)
 def _retry_storm(report: Dict[str, Any]):
     retries = report.get("retries") or {}

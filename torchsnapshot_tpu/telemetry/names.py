@@ -102,6 +102,17 @@ COORD_BARRIER_WAIT_SECONDS_TOTAL = "coordination_barrier_wait_seconds_total"
 COORD_EXCHANGE_SECONDS_TOTAL = "coordination_exchange_seconds_total"
 COORD_ENDPOINT_SECONDS_TOTAL = "coordination_endpoint_seconds_total"
 
+# -- self-healing reads (scheduler.py) ---------------------------------------
+#
+# A restore read whose bytes failed checksum verification was re-read
+# from an alternate tier (the corruption ladder, docs/chaos.md): how
+# many blobs were rerouted and how many bytes the reroutes served,
+# labeled by the tier that finally vouched for the bytes. The
+# ``storage-corruption`` doctor rule cites these.
+
+STORAGE_DEGRADED_READS_TOTAL = "storage_degraded_reads_total"
+STORAGE_DEGRADED_READ_BYTES_TOTAL = "storage_degraded_read_bytes_total"
+
 # -- manager (manager.py) ----------------------------------------------------
 
 MANAGER_SAVES_TOTAL = "manager_saves_total"
@@ -308,6 +319,13 @@ RULE_COORDINATION_BOUND = "coordination-bound"
 # root silently running the legacy layout). Evidence cites the ledger's
 # step-committed storage records.
 RULE_DEDUP_INEFFECTIVE = "dedup-ineffective"
+# Stored bytes failed digest verification: a restore rerouted reads
+# around a corrupt tier copy (report ``degraded_reads``/``tier_split``
+# evidence), or ``fsck --repair`` rewrote/quarantined damaged chunks
+# (``repair-performed`` ledger events). The store healed — or could
+# not — but the medium is rotting either way; audit the tier named by
+# the evidence (docs/chaos.md).
+RULE_STORAGE_CORRUPTION = "storage-corruption"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).
@@ -345,3 +363,58 @@ EVENT_PREEMPTION = "preemption"
 # Retention GC deleted a step's blobs; its step-committed storage
 # records are pruned from the ledger in the same pass.
 EVENT_GC_RECLAIMED = "gc-reclaimed"
+# ``fsck --repair`` acted on a damaged blob/chunk: rewrote it from a
+# tier whose copy verified, or quarantined it (no tier verified —
+# ``chunks/.quarantine/``). The ``storage-corruption`` doctor rule
+# cites these records; fields carry the location, action and tiers.
+EVENT_REPAIR_PERFORMED = "repair-performed"
+
+# ---------------------------------------------------------------------------
+# Crash-point ids (chaos/crashpoints.py).
+#
+# Same single-registration rule as the families above, with the doctor
+# rules' kebab-case convention. ``CRASH_``-prefixed constants name the
+# kill points threaded through the take/commit/GC/mirror paths —
+# ``crashpoint(names.CRASH_...)`` is a no-op in production and raises
+# ``SimulatedCrash`` when the chaos engine armed that point, so the
+# crash-matrix harness (chaos/harness.py) can kill an op at every
+# declared point and assert the store's global invariants. snaplint's
+# ``crashpoint-ids`` rule lints both halves: declared exactly once
+# here, kebab-case values, no literal ids at ``crashpoint()`` sites.
+# The harness enumerates this registry — adding a constant here IS
+# adding the point to the matrix.
+# ---------------------------------------------------------------------------
+
+# Every rank's data writes drained durably (sync_complete returned);
+# nothing control-plane exists yet.
+CRASH_TAKE_WRITES_DONE = "take-writes-done"
+# This rank's checksum table is durable (always before the barrier).
+CRASH_CHECKSUM_TABLE_WRITTEN = "checksum-table-written"
+# A CAS chunk's bytes just landed in ``chunks/`` — no map, no manifest,
+# no pin references it yet (the stray-sweep + grace-window case).
+CRASH_CAS_CHUNK_WRITTEN = "cas-chunk-written"
+# This rank's ``cas/{rank}`` path->digest map committed.
+CRASH_CAS_MAP_WRITTEN = "cas-map-written"
+# Rank 0, inside the commit window: the manifest rewrite ran but the
+# ``.snapshot_metadata`` marker does NOT exist yet (the step must read
+# as never-happened).
+CRASH_PRE_COMMIT_MARKER = "pre-commit-marker"
+# The commit marker is durable; the manager index does not name the
+# step yet (committed-but-unindexed).
+CRASH_COMMIT_MARKER = "commit-marker"
+# The tiered take handed its blob inventory to the background mirror.
+CRASH_MIRROR_ENQUEUED = "mirror-enqueued"
+# The post-commit peer-tier push hook ran (enqueue, not settle).
+CRASH_PEER_ENQUEUED = "peer-enqueued"
+# Rank 0 pinned the committing step's chunks in the refcount journal;
+# the index write has not happened (pinned-but-uncommitted).
+CRASH_REFCOUNT_PINNED = "refcount-pinned"
+# The index backup slot is written, the primary is not (torn pair).
+CRASH_INDEX_BACKUP_WRITTEN = "index-backup-written"
+# Both index slots name the new step; retention deletes still pending.
+CRASH_INDEX_WRITTEN = "index-written"
+# Chunk GC unpinned the dropped steps; reclaim deletes still pending.
+CRASH_GC_UNPINNED = "gc-unpinned"
+# Step GC deleted a dropped step's commit marker; its data blobs (and
+# telemetry leftovers) are still on disk.
+CRASH_GC_MARKER_DELETED = "gc-marker-deleted"

@@ -129,6 +129,12 @@ class SnapshotReport:
     # copy. The ``peer-tier-degraded`` doctor rule keys off these.
     tier_split: Optional[Dict[str, int]] = None
     peer: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Self-healing restores only (None elsewhere): reads whose first
+    # copy failed digest verification and were re-served from an
+    # alternate tier (``{"blobs": n, "bytes": n}``; the serving tiers
+    # land in ``tier_split``). The ``storage-corruption`` doctor rule
+    # keys off this — a restore that healed still rode rotting media.
+    degraded_reads: Optional[Dict[str, int]] = None
     # Write pipelines only (None elsewhere): bytes served per write-path
     # variant (``{"vectorized": b, "direct": b, "fused": b,
     # "buffered": b}``), as stamped by the storage plugin per write —
@@ -203,6 +209,17 @@ def merge_pipeline_telemetry(
             wp = out.setdefault("write_path", {})
             for variant, nbytes in p["write_path"].items():
                 wp[variant] = wp.get(variant, 0) + int(nbytes)
+        # Self-healing accounting (read pipelines with corruption
+        # reroutes only): per-tier rerouted bytes and the blob/byte
+        # summary both sum across pipelines.
+        if p.get("tier_split"):
+            ts = out.setdefault("tier_split", {})
+            for tier, nbytes in p["tier_split"].items():
+                ts[tier] = ts.get(tier, 0) + int(nbytes)
+        if p.get("degraded_reads"):
+            dr = out.setdefault("degraded_reads", {})
+            for key, n in p["degraded_reads"].items():
+                dr[key] = dr.get(key, 0) + int(n)
     out["budget_wait_s"] = round(out["budget_wait_s"], 6)
     return out
 
@@ -318,6 +335,11 @@ def build_report(
             else None
         ),
         peer=dict(pipeline.get("peer") or {}),
+        degraded_reads=(
+            {k: int(v) for k, v in pipeline["degraded_reads"].items()}
+            if pipeline.get("degraded_reads")
+            else None
+        ),
         tunables=dict(tunables) if tunables is not None else None,
         coordination=coordination_from_deltas(counter_deltas),
         retries=retries_from_deltas(counter_deltas),

@@ -39,43 +39,64 @@ def faulty_fs_plugin(
     ops: Sequence[str] = ("write",),
     exc_msg: str = "injected storage failure",
     delay_s: float = 0.0,
+    mode: str = "fail",
+    seed: int = 0,
 ):
     """An ``FSStoragePlugin`` subclass whose listed ``ops`` ("write",
-    "read" — each covering its fused ``*_with_checksum`` variant too)
-    raise ``OSError(exc_msg)`` when ``should_fail(io.path)`` is truthy.
+    "read", "delete" — "write"/"read" covering their fused
+    ``*_with_checksum`` variants too, which the chaos wrapper declines
+    so every op funnels through the injected path) misbehave when
+    ``should_fail(io.path)`` is truthy.
 
-    The one fault-injection seam for the crash/fail-fast tests:
-    ``should_fail`` may filter by path (data blobs only) or close over a
-    counter (crash at the N-th storage op). Pair with
-    :func:`patch_storage_plugin`."""
-    import asyncio
+    Since the chaos engine landed this is a thin shim over ONE fault
+    plan (chaos/plan.py): each listed op becomes a predicate-triggered
+    :class:`~torchsnapshot_tpu.chaos.FaultSpec`, so the crash tests and
+    the declarative fault plans replay through the same mechanism.
+    ``mode`` extends the legacy raise-only behavior:
 
+    - ``"fail"`` (default): raise ``OSError(exc_msg)``, after
+      ``delay_s`` if set — byte-compatible with the legacy shim.
+    - ``"corrupt"``: size-preserving bit damage (written bytes or read
+      buffer) — only digest verification catches it.
+    - ``"delay"``: sleep ``delay_s``, then proceed normally.
+    - plus any other chaos mode (``"torn"``, ``"drop"``, ``"crash"``).
+
+    ``should_fail`` may filter by path (data blobs only) or close over
+    a counter (fault at the N-th storage op). Pair with
+    :func:`patch_storage_plugin`. Returns the subclass; its
+    ``chaos_engine`` attribute exposes the backing engine (the
+    ``fired`` log pins replay determinism)."""
+    from .chaos import ChaosEngine, FaultPlan, FaultSpec, chaotic_plugin_type
     from .storage_plugins.fs import FSStoragePlugin
 
-    async def _maybe_fail(path: str, op: str) -> None:
-        if op in ops and should_fail(path):
-            if delay_s:
-                await asyncio.sleep(delay_s)
-            raise OSError(exc_msg)
-
-    class _Faulty(FSStoragePlugin):
-        async def write(self, write_io):
-            await _maybe_fail(write_io.path, "write")
-            await super().write(write_io)
-
-        async def write_with_checksum(self, write_io):
-            await _maybe_fail(write_io.path, "write")
-            return await super().write_with_checksum(write_io)
-
-        async def read(self, read_io):
-            await _maybe_fail(read_io.path, "read")
-            await super().read(read_io)
-
-        async def read_with_checksum(self, read_io):
-            await _maybe_fail(read_io.path, "read")
-            return await super().read_with_checksum(read_io)
-
-    return _Faulty
+    point_of = {
+        "write": "storage-write",
+        "read": "storage-read",
+        "delete": "storage-delete",
+    }
+    # "fail" keeps the legacy shape: an optional sleep and then the
+    # raise. Chaos-wise that is mode="delay"+raise, which plain "fail"
+    # specs don't model — so a failing spec with delay keeps delay_s
+    # and the engine path sleeps before raising via the "fail" arm
+    # below (asyncio.sleep lives in the injectors).
+    plan = FaultPlan(
+        seed=seed,
+        faults=[
+            FaultSpec(
+                point=point_of[op],
+                mode=mode,
+                times=None,
+                predicate=should_fail,
+                exc_msg=exc_msg,
+                delay_s=delay_s,
+            )
+            for op in ops
+        ],
+    )
+    engine = ChaosEngine(plan)
+    cls = chaotic_plugin_type(FSStoragePlugin, engine)
+    cls.chaos_engine = engine
+    return cls
 
 
 def patch_storage_plugin(cls):

@@ -1374,6 +1374,16 @@ class PeerRestoreContext:
             if tier == "peer":
                 self.served_blobs += 1
 
+    def discount(self, tier: str, nbytes: int) -> None:
+        """Take back a serve that verification later rejected (the
+        corruption ladder re-served the blob from another tier): the
+        split must sum to the bytes actually restored, not restored
+        plus every corrupt attempt."""
+        with self._lock:
+            self.tier_bytes[tier] = max(
+                0, self.tier_bytes.get(tier, 0) - int(nbytes)
+            )
+
     def note_fallthrough(self, nbytes: int) -> None:
         with self._lock:
             self.fallthrough_bytes += int(nbytes)
@@ -1435,6 +1445,7 @@ class _PeerLadderPlugin(StoragePlugin):
         if self._tiered is not None:
             try:
                 await self._tiered.fast.read(read_io)
+                read_io.served_by = "fast"
                 self.ctx.count(
                     "fast",
                     memoryview(read_io.buf).nbytes
@@ -1458,6 +1469,7 @@ class _PeerLadderPlugin(StoragePlugin):
                     read_io.buf = read_io.dest
                 else:
                     read_io.buf = memoryview(bytes(chunk))
+                read_io.served_by = "peer"
                 self.ctx.count("peer", len(chunk))
                 return
         # Bottom of the ladder: durable storage (a non-tiered inner
@@ -1466,6 +1478,7 @@ class _PeerLadderPlugin(StoragePlugin):
             await self._tiered.durable.read(read_io)
         else:
             await self.inner.read(read_io)
+        read_io.served_by = "durable"
         nbytes = (
             memoryview(read_io.buf).nbytes if read_io.buf is not None else 0
         )
@@ -1474,6 +1487,49 @@ class _PeerLadderPlugin(StoragePlugin):
             # A peer copy existed for this blob but durable storage
             # served it: the degradation the doctor rule cites.
             self.ctx.note_fallthrough(nbytes)
+
+    async def read_degraded(self, read_io: ReadIO) -> bool:
+        """Corruption fallthrough, ladder flavor: peer pulls are
+        digest-verified inside :meth:`PeerRestoreContext.pull` (corrupt
+        peer bytes never escape it), so the storage tiers are the only
+        sources whose bytes can reach verification corrupt — retry
+        whichever of durable/fast has not served this request yet."""
+        tried = getattr(read_io, "_tiers_tried", None)
+        if tried is None:
+            tried = {read_io.served_by} if read_io.served_by else set()
+            read_io._tiers_tried = tried
+        # The rejected serve was already counted by read() (or by a
+        # previous healing round): take it back so tier_split sums to
+        # the bytes actually restored.
+        if read_io.served_by and read_io.buf is not None:
+            self.ctx.discount(
+                read_io.served_by, memoryview(read_io.buf).nbytes
+            )
+        tiers = []
+        if self._tiered is not None:
+            tiers = [
+                ("durable", self._tiered.durable),
+                ("fast", self._tiered.fast),
+            ]
+        else:
+            tiers = [("durable", self.inner)]
+        for tier, plugin in tiers:
+            if tier in tried:
+                continue
+            tried.add(tier)
+            try:
+                await plugin.read(read_io)
+            except (FileNotFoundError, OSError):
+                continue
+            read_io.served_by = tier
+            self.ctx.count(
+                tier,
+                memoryview(read_io.buf).nbytes
+                if read_io.buf is not None
+                else 0,
+            )
+            return True
+        return False
 
     async def read_with_checksum(self, read_io: ReadIO):
         # Decline (sticky, per the interface contract): the ladder must

@@ -92,8 +92,34 @@ class TieredStoragePlugin(StoragePlugin):
     async def read(self, read_io: ReadIO) -> None:
         try:
             await self.fast.read(read_io)
+            read_io.served_by = "fast"
         except FileNotFoundError:
             await self.durable.read(read_io)
+            read_io.served_by = "durable"
+
+    async def read_degraded(self, read_io: ReadIO) -> bool:
+        """Corruption fallthrough (docs/chaos.md): the tier that served
+        ``read_io`` produced bytes that failed digest verification —
+        re-read from the tier(s) not yet tried. The caller re-verifies;
+        a mismatch there comes back here until both tiers are exhausted."""
+        tried = getattr(read_io, "_tiers_tried", None)
+        if tried is None:
+            tried = {read_io.served_by} if read_io.served_by else set()
+            read_io._tiers_tried = tried
+        for tier, plugin in (
+            ("durable", self.durable),
+            ("fast", self.fast),
+        ):
+            if tier in tried:
+                continue
+            tried.add(tier)
+            try:
+                await plugin.read(read_io)
+            except (FileNotFoundError, OSError):
+                continue  # absent/torn here: keep walking the ladder
+            read_io.served_by = tier
+            return True
+        return False
 
     async def read_with_checksum(self, read_io: ReadIO):
         try:
@@ -139,5 +165,9 @@ class TieredStoragePlugin(StoragePlugin):
                 metadata_path=metadata_path,
             )
             self._written.clear()
+            from ..chaos import crashpoint
+            from ..telemetry import names as _names
+
+            crashpoint(_names.CRASH_MIRROR_ENQUEUED)
         await self.fast.close()
         await self.durable.close()
