@@ -87,6 +87,10 @@ TS_BENCH_COORD_WORLDS sizes leg 10 (``coordination_scaling``): storms
 of simulated ranks through the real coordination code paths, tuned
 topology vs the linear/per-key baseline plus the tree barrier's growth
 curve (docs/scaling.md).
+TS_BENCH_CDN_SUBSCRIBERS sizes leg 11 (``cdn_streaming``): the serving
+fleet tracking a publishing trainer through a rolling update — median
+publish-to-swap staleness, ~1x durable read amplification, and the
+rolling-update dedup ratio (docs/cdn.md).
 ``--json-out PATH`` additionally writes the final record to a
 file (the stdout tail can be truncated by the driver's capture —
 BENCH_r04/r05 both parsed null for exactly that reason).
@@ -623,6 +627,40 @@ def run_subprocess_legs() -> None:
                 f"(sublinear={cs.get('sublinear')})"
             )
         _emit_partial("coordination_scaling")
+
+    if _have_budget("cdn_streaming", 150):
+        # Leg 11 — checkpoint-CDN weight streaming (docs/cdn.md): a
+        # 100+ subscriber serving fleet (TS_BENCH_CDN_SUBSCRIBERS)
+        # tracks a publishing trainer through a rolling update. The
+        # pins: sub-second median publish-to-swap staleness, ~1x
+        # durable read amplification (owner election: each unique
+        # chunk leaves storage once, fleet-size-independent), and a
+        # dedup ratio well under 1 (only churned chunks on the wire).
+        cdn = _subprocess_json(
+            "cdn-streaming",
+            ("benchmarks", "cdn_streaming.py"),
+            ["--subscribers", os.environ.get(
+                "TS_BENCH_CDN_SUBSCRIBERS", "100"
+            ), "--json"],
+            timeout=420,
+        )
+        if cdn is not None:
+            RESULT["cdn_streaming"] = cdn
+            RESULT["cdn_staleness_median_s"] = cdn.get(
+                "staleness_median_s"
+            )
+            RESULT["cdn_read_amplification"] = cdn.get(
+                "read_amplification"
+            )
+            RESULT["cdn_dedup_ratio"] = cdn.get("dedup_ratio")
+            _log(
+                f"bench: cdn streaming — "
+                f"{cdn.get('converged_subscribers')} subscribers, "
+                f"staleness median {cdn.get('staleness_median_s')}s, "
+                f"read amplification {cdn.get('read_amplification')}x, "
+                f"dedup {cdn.get('dedup_ratio')}"
+            )
+        _emit_partial("cdn_streaming")
 
 
 def cold_start_rows() -> None:

@@ -1,0 +1,541 @@
+"""Checkpoint CDN (docs/cdn.md): topic codec, publisher ordering,
+subscriber diff/owner-election/pull tiers, hot swap, the manager's
+publish hook, and the CAS lease pins that keep fleet-held chunks out
+of the training job's GC."""
+
+import os
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.cas import CASStore, digest_key
+from torchsnapshot_tpu.cdn import (
+    Announce,
+    CdnPublisher,
+    CdnSubscriber,
+    CdnSyncError,
+    WeightSwapper,
+    announce_key,
+    concat_assembler,
+    durable_chunk_reader,
+    head_key,
+    manifest_digest,
+    read_announce,
+    read_head,
+    verify_chunk_bytes,
+)
+from torchsnapshot_tpu.dist_store import InProcessStore
+
+
+def _chunk(seed: int, nbytes: int = 512):
+    data = (seed.to_bytes(8, "little") * (nbytes // 8 + 1))[:nbytes]
+    return digest_key(("crc32", zlib.crc32(data), len(data))), data
+
+
+def _announce(seq=1, step=10, nchunks=3):
+    chunks = {}
+    blobs = {}
+    for i in range(nchunks):
+        key, data = _chunk(i)
+        chunks[key] = len(data)
+        blobs[key] = data
+    return (
+        Announce(
+            topic="t",
+            seq=seq,
+            step=step,
+            digest=manifest_digest(step, chunks),
+            chunks=chunks,
+            published_ts=time.time(),
+        ),
+        blobs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# topic codec
+# ---------------------------------------------------------------------------
+
+
+def test_topic_keys_are_store_routable():
+    assert head_key("t") == "__cdn/t/head"
+    assert announce_key("t", 7) == "__cdn/t/announce/7"
+
+
+def test_announce_round_trip():
+    ann, _ = _announce()
+    again = Announce.decode(ann.encode())
+    assert again is not None
+    assert again.seq == ann.seq and again.step == ann.step
+    assert again.chunks == ann.chunks
+    assert again.bytes_in_step == sum(ann.chunks.values())
+
+
+def test_announce_decode_rejects_damage():
+    ann, _ = _announce()
+    raw = ann.encode()
+    assert Announce.decode(b"not json") is None
+    assert Announce.decode(b"{}") is None
+    # A tampered chunk set no longer matches the embedded digest.
+    tampered = raw.replace(b'"step": 10', b'"step": 11')
+    assert Announce.decode(tampered) is None
+
+
+def test_read_head_tolerates_missing_and_garbage():
+    store = InProcessStore()
+    assert read_head(store, "t") == 0
+    store.set(head_key("t"), b"not-a-number")
+    assert read_head(store, "t") == 0
+    store.set(head_key("t"), b"3")
+    assert read_head(store, "t") == 3
+
+
+def test_verify_chunk_bytes():
+    key, data = _chunk(1)
+    assert verify_chunk_bytes(key, data)
+    assert not verify_chunk_bytes(key, data[:-1])  # size mismatch
+    flipped = bytes([data[0] ^ 1]) + data[1:]
+    assert not verify_chunk_bytes(key, flipped)  # digest mismatch
+    # Non-CAS keys are rejected outright.
+    assert not verify_chunk_bytes("not-a-chunk", data)
+
+
+# ---------------------------------------------------------------------------
+# publisher
+# ---------------------------------------------------------------------------
+
+
+def test_publisher_bumps_head_and_announces():
+    store = InProcessStore()
+    pub = CdnPublisher(store, "t", publisher_id="trainer")
+    key, data = _chunk(1)
+    ann = pub.publish(100, {key: len(data)})
+    assert ann is not None and ann.seq == 1
+    assert read_head(store, "t") == 1
+    got = read_announce(store, "t", 1)
+    assert got is not None
+    assert got.step == 100 and got.publisher == "trainer"
+    # Seq is monotonic per topic.
+    ann2 = pub.publish(200, {key: len(data)})
+    assert ann2.seq == 2 and read_head(store, "t") == 2
+
+
+def test_publisher_resumes_seq_from_store():
+    store = InProcessStore()
+    key, data = _chunk(1)
+    CdnPublisher(store, "t").publish(1, {key: len(data)})
+    # A restarted trainer picks up after the published head.
+    ann = CdnPublisher(store, "t").publish(2, {key: len(data)})
+    assert ann.seq == 2
+
+
+# ---------------------------------------------------------------------------
+# subscriber
+# ---------------------------------------------------------------------------
+
+
+def test_subscriber_syncs_only_novel_chunks():
+    store = InProcessStore()
+    pub = CdnPublisher(store, "t")
+    reads = []
+
+    def durable_fetch(key):
+        reads.append(key)
+        return blobs[key]
+
+    ann, blobs = _announce(nchunks=3)
+    sub = CdnSubscriber(store, "t", 0, 1, durable_fetch=durable_fetch)
+    try:
+        pub.publish(ann.step, ann.chunks)
+        got = sub.track_once()
+        assert got is not None and sub.applied_seq == 1
+        assert sorted(reads) == sorted(ann.chunks)
+        assert sub.stats.chunks_from_durable == 3
+
+        # Rolling update: one churned chunk, two kept — only the novel
+        # chunk is fetched, the rest re-serve from the held pool.
+        new_key, new_data = _chunk(99)
+        blobs[new_key] = new_data
+        kept = dict(ann.chunks)
+        kept.pop(sorted(kept)[0])
+        kept[new_key] = len(new_data)
+        reads.clear()
+        pub.publish(ann.step + 1, kept)
+        assert sub.track_once(timeout=5.0) is not None
+        assert reads == [new_key]
+        assert sub.stats.chunks_held == 2
+    finally:
+        sub.close()
+
+
+def test_subscriber_fleet_amplification_and_tiers():
+    """3 subscribers, 3 chunks: every chunk leaves durable storage
+    exactly once (its elected owner), everyone else pulls peer-to-peer."""
+    store = InProcessStore()
+    ann, blobs = _announce(nchunks=3)
+    lock = threading.Lock()
+    reads = []
+
+    def durable_fetch(key):
+        with lock:
+            reads.append(key)
+        return blobs[key]
+
+    os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"] = "10"
+    subs = [
+        CdnSubscriber(store, "t", i, 3, durable_fetch=durable_fetch)
+        for i in range(3)
+    ]
+    try:
+        CdnPublisher(store, "t").publish(ann.step, ann.chunks)
+        threads = [
+            threading.Thread(target=s.track_once, kwargs={"timeout": 10.0})
+            for s in subs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert all(s.applied_seq == 1 for s in subs)
+        # The ~1x pin: 3 durable reads for 3 chunks, fleet of 3.
+        assert sorted(reads) == sorted(ann.chunks)
+        assert sum(s.stats.chunks_from_peer for s in subs) == 6
+        assert sum(s.stats.peer_fallbacks for s in subs) == 0
+        for s in subs:
+            assert s.stats.staleness_s and s.stats.staleness_s[0] >= 0.0
+    finally:
+        for s in subs:
+            s.close()
+        os.environ.pop("TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS", None)
+
+
+def test_subscriber_falls_back_to_durable_on_dead_owner():
+    """fleet_size=2 but rank 1 never exists: pulls aimed at the absent
+    owner time out and degrade to durable reads, never to a stall."""
+    store = InProcessStore()
+    ann, blobs = _announce(nchunks=2)
+    os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"] = "0.2"
+    sub = CdnSubscriber(store, "t", 0, 2, durable_fetch=blobs.__getitem__)
+    try:
+        CdnPublisher(store, "t").publish(ann.step, ann.chunks)
+        assert sub.track_once(timeout=5.0) is not None
+        assert sub.stats.chunks_from_durable == 2
+        assert sub.stats.peer_fallbacks >= 1
+    finally:
+        sub.close()
+        os.environ.pop("TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS", None)
+
+
+def test_subscriber_without_durable_fetch_raises():
+    store = InProcessStore()
+    ann, _ = _announce(nchunks=1)
+    os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"] = "0.1"
+    sub = CdnSubscriber(store, "t", 0, 1)
+    try:
+        CdnPublisher(store, "t").publish(ann.step, ann.chunks)
+        with pytest.raises(CdnSyncError):
+            sub.track_once(timeout=5.0)
+        assert sub.applied_seq == 0  # nothing half-applied
+    finally:
+        sub.close()
+        os.environ.pop("TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS", None)
+
+
+def test_wait_for_update_times_out_quietly():
+    store = InProcessStore()
+    sub = CdnSubscriber(store, "t", 0, 1)
+    try:
+        assert sub.wait_for_update(timeout=0.05) is None
+    finally:
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# swap
+# ---------------------------------------------------------------------------
+
+
+def _template_and_chunks(leaves):
+    """Build chunk blobs whose sorted-key concatenation equals the
+    sorted-leaf concatenation of ``leaves``."""
+    payload = b"".join(
+        np.ascontiguousarray(leaves[name]).tobytes()
+        for name in sorted(leaves)
+    )
+    mid = len(payload) // 2
+    chunks = {}
+    for part in (payload[:mid], payload[mid:]):
+        chunks[digest_key(("crc32", zlib.crc32(part), len(part)))] = part
+    return chunks
+
+
+def test_concat_assembler_reshapes_leaves():
+    leaves = {
+        "a": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": np.arange(4, dtype=np.int32),
+    }
+    chunks = _template_and_chunks(leaves)
+    ann = Announce(
+        topic="t",
+        seq=1,
+        step=1,
+        digest="",
+        chunks={k: len(v) for k, v in chunks.items()},
+        published_ts=time.time(),
+    )
+    out = concat_assembler(leaves)(ann, chunks)
+    np.testing.assert_array_equal(out["a"], leaves["a"])
+    np.testing.assert_array_equal(out["b"], leaves["b"])
+
+
+def test_weight_swapper_hot_swap():
+    weights = {"w": np.zeros(8, dtype=np.float32)}
+    swapper = WeightSwapper(weights)
+    fresh = {"w": np.arange(8, dtype=np.float32)}
+    chunks = _template_and_chunks(fresh)
+    ann = Announce(
+        topic="t",
+        seq=1,
+        step=42,
+        digest="",
+        chunks={k: len(v) for k, v in chunks.items()},
+        published_ts=time.time(),
+    )
+    staged = swapper.stage(ann, chunks)
+    # Staging alone must not move the served weights.
+    np.testing.assert_array_equal(swapper.weights["w"], 0.0)
+    swapper.swap(staged)
+    np.testing.assert_array_equal(swapper.weights["w"], fresh["w"])
+    assert swapper.swapped_step == 42
+
+
+def test_weight_swapper_swaps_jax_arrays_with_donation():
+    import jax
+    import jax.numpy as jnp
+
+    weights = {"w": jnp.zeros(16, dtype=jnp.float32)}
+    swapper = WeightSwapper(weights)
+    fresh = {"w": np.arange(16, dtype=np.float32)}
+    chunks = _template_and_chunks(fresh)
+    ann = Announce(
+        topic="t",
+        seq=1,
+        step=7,
+        digest="",
+        chunks={k: len(v) for k, v in chunks.items()},
+        published_ts=time.time(),
+    )
+    old = weights["w"]
+    swapper.swap(swapper.stage(ann, chunks))
+    got = swapper.weights["w"]
+    assert isinstance(got, jax.Array)
+    np.testing.assert_array_equal(np.asarray(got), fresh["w"])
+    assert old.is_deleted()  # the stale buffer was donated back
+
+
+def test_weight_swapper_survives_successive_jax_swaps():
+    """The default assembler must not touch template leaves after the
+    first swap donates (deletes) them — every later update would crash."""
+    import jax.numpy as jnp
+
+    swapper = WeightSwapper({"w": jnp.zeros(16, dtype=jnp.float32)})
+    for seq, offset in enumerate([1.0, 2.0], start=1):
+        chunks = _template_and_chunks(
+            {"w": np.arange(16, dtype=np.float32) + offset}
+        )
+        ann = Announce(
+            topic="t",
+            seq=seq,
+            step=seq,
+            digest="",
+            chunks={k: len(v) for k, v in chunks.items()},
+            published_ts=time.time(),
+        )
+        swapper.swap(swapper.stage(ann, chunks))
+        # The assembler's layout contract: sorted-key concatenation.
+        expected = np.frombuffer(
+            b"".join(chunks[k] for k in sorted(chunks)), np.float32
+        )
+        np.testing.assert_array_equal(
+            np.asarray(swapper.weights["w"]), expected
+        )
+    assert swapper.swapped_step == 2
+
+
+def test_weight_swapper_rejects_short_payload():
+    swapper = WeightSwapper({"w": np.zeros(64, dtype=np.float32)})
+    key, data = _chunk(1, nbytes=8)
+    ann = Announce(
+        topic="t",
+        seq=1,
+        step=1,
+        digest="",
+        chunks={key: len(data)},
+        published_ts=time.time(),
+    )
+    with pytest.raises(Exception):
+        swapper.stage(ann, {key: data})
+
+
+# ---------------------------------------------------------------------------
+# manager publish hook + end-to-end through a real snapshot root
+# ---------------------------------------------------------------------------
+
+
+def _state(n=1024, offset=0.0):
+    return {"m": ts.PyTreeState({"w": np.arange(n, dtype=np.float32) + offset})}
+
+
+def test_manager_publishes_committed_steps(tmp_path):
+    root = str(tmp_path / "ckpt")
+    store = InProcessStore()
+    with knobs.enable_cas(), knobs.enable_cdn():
+        mgr = ts.CheckpointManager(
+            root, cdn_topic="run1", cdn_store=store
+        )
+        mgr.save(0, _state(offset=0.0))
+        mgr.save(1, _state(offset=1.0))
+    assert read_head(store, "run1") == 2
+    ann = read_announce(store, "run1", 2)
+    assert ann is not None and ann.step == 1
+    # Every announced chunk exists under the root with matching bytes.
+    fetch = durable_chunk_reader(root)
+    for key in ann.chunks:
+        assert verify_chunk_bytes(key, fetch(key))
+
+
+def test_manager_hook_off_without_knob(tmp_path):
+    store = InProcessStore()
+    with knobs.enable_cas():  # CDN knob stays pinned off
+        mgr = ts.CheckpointManager(
+            str(tmp_path / "ckpt"), cdn_topic="run1", cdn_store=store
+        )
+        mgr.save(0, _state())
+    assert read_head(store, "run1") == 0
+
+
+def test_manager_without_cas_never_half_announces(tmp_path):
+    """CAS off means no chunk refs — the manager must skip the publish
+    rather than announce an empty chunk set subscribers can't serve."""
+    store = InProcessStore()
+    with knobs.enable_cdn():
+        mgr = ts.CheckpointManager(
+            str(tmp_path / "ckpt"), cdn_topic="run1", cdn_store=store
+        )
+        mgr.save(0, _state())
+    assert read_head(store, "run1") == 0
+
+
+def test_end_to_end_train_to_serve(tmp_path):
+    """Trainer saves through the manager; a subscriber streams the
+    chunks from the real root and hot-swaps a same-shape template."""
+    root = str(tmp_path / "ckpt")
+    store = InProcessStore()
+    with knobs.enable_cas(), knobs.enable_cdn():
+        mgr = ts.CheckpointManager(root, cdn_topic="run1", cdn_store=store)
+        mgr.save(0, _state(offset=3.0))
+    sub = CdnSubscriber(
+        store, "run1", 0, 1, durable_fetch=durable_chunk_reader(root)
+    )
+    try:
+        ann = sub.wait_for_update(timeout=5.0)
+        assert ann is not None
+        chunk_bytes = sub.sync(ann)
+        assert set(chunk_bytes) == set(ann.chunks)
+        payload = b"".join(chunk_bytes[k] for k in sorted(chunk_bytes))
+        got = np.frombuffer(payload, dtype=np.float32)
+        np.testing.assert_array_equal(
+            got, np.arange(1024, dtype=np.float32) + 3.0
+        )
+    finally:
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# CAS leases (the fleet's GC pin)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_round_trip_and_live_union(tmp_path):
+    store = CASStore(str(tmp_path / "ckpt"))
+    store.pin(1, {"cas-a": 10})
+    store.lease("cdn/t/0", {"cas-b": 20})
+    pins, orphans, leases = store.load_full()
+    assert sorted(pins) == [1]
+    assert leases == {"cdn/t/0": {"cas-b": 20}}
+    live = store.live_chunks(pins, leases)
+    assert live == {"cas-a", "cas-b"}
+    # Re-lease replaces (drops cas-b, adds cas-c); unlease removes.
+    store.lease("cdn/t/0", {"cas-c": 30})
+    _, _, leases = store.load_full()
+    assert leases == {"cdn/t/0": {"cas-c": 30}}
+    store.unlease("cdn/t/0")
+    _, _, leases = store.load_full()
+    assert leases == {}
+    # Legacy two-tuple load still works for existing callers.
+    pins, orphans = store.load()
+    assert sorted(pins) == [1] and not orphans
+
+
+def test_compact_preserves_outstanding_leases(tmp_path):
+    store = CASStore(str(tmp_path / "ckpt"))
+    store.pin(1, {"cas-a": 10})
+    store.lease("cdn/t/0", {"cas-b": 20})
+    pins, orphans = store.load()
+    store.compact(pins, orphans)  # lease-unaware caller
+    _, _, leases = store.load_full()
+    assert leases == {"cdn/t/0": {"cas-b": 20}}
+
+
+def test_subscriber_leases_held_chunks(tmp_path):
+    """A subscriber with a cas_store records its held set as a lease
+    after each apply and releases it on close."""
+    cas_store = CASStore(str(tmp_path / "ckpt"))
+    store = InProcessStore()
+    ann, blobs = _announce(nchunks=2)
+    sub = CdnSubscriber(
+        store,
+        "t",
+        0,
+        1,
+        durable_fetch=blobs.__getitem__,
+        cas_store=cas_store,
+    )
+    try:
+        CdnPublisher(store, "t").publish(ann.step, ann.chunks)
+        assert sub.track_once(timeout=5.0) is not None
+        _, _, leases = cas_store.load_full()
+        assert leases == {sub.lease_id: dict(ann.chunks)}
+    finally:
+        sub.close()
+    _, _, leases = cas_store.load_full()
+    assert leases == {}
+
+
+def test_manager_gc_spares_fleet_leased_chunks(tmp_path):
+    """Retention drops a step whose unique chunk a subscriber still
+    serves: the lease keeps the chunk file on disk through GC."""
+    root = str(tmp_path / "ckpt")
+    with knobs.enable_cas(), knobs.override_cas_gc_grace_seconds(0):
+        mgr = ts.CheckpointManager(root, keep_last_n=1)
+        mgr.save(0, _state(offset=0.0))
+        store = CASStore(root)
+        pins, _, _ = store.load_full()
+        step0_chunks = pins[0]
+        store.lease("cdn/t/0", dict(step0_chunks))
+        mgr.save(1, _state(offset=1.0))  # retention drops step 0
+        chunks_dir = os.path.join(root, "chunks")
+        for key in step0_chunks:
+            assert os.path.exists(os.path.join(chunks_dir, key)), key
+        # Lease released -> the next GC pass reclaims.
+        store.unlease("cdn/t/0")
+        mgr.save(2, _state(offset=2.0))
+        for key in step0_chunks:
+            if key in store.live_chunks(store.load()[0]):
+                continue
+            assert not os.path.exists(os.path.join(chunks_dir, key)), key

@@ -1,0 +1,205 @@
+"""CDN under chaos (docs/cdn.md, docs/chaos.md): publisher killed
+mid-announce, subscriber killed mid-swap, corrupted peer frames, and
+``fsck --cas`` cleanliness with fleet leases outstanding. The
+invariants: subscribers converge to the last FULLY published step, a
+torn announce is never swapped in, and a fleet-held chunk never reads
+as store damage."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.cas import CASStore, digest_key
+from torchsnapshot_tpu.cdn import (
+    CdnPublisher,
+    CdnSubscriber,
+    WeightSwapper,
+    read_announce,
+    read_head,
+)
+from torchsnapshot_tpu.chaos import (
+    ChaosEngine,
+    FaultPlan,
+    SimulatedCrash,
+    arm,
+    declared_crashpoints,
+    disarm,
+    install_wire_chaos,
+    uninstall_wire_chaos,
+)
+from torchsnapshot_tpu.dist_store import InProcessStore
+from torchsnapshot_tpu.fsck import verify_cas_store
+from torchsnapshot_tpu.telemetry import names
+
+
+def _chunk(seed: int, nbytes: int = 256):
+    data = (seed.to_bytes(8, "little") * (nbytes // 8 + 1))[:nbytes]
+    return digest_key(("crc32", zlib.crc32(data), len(data))), data
+
+
+def _blobs(*seeds):
+    out = {}
+    for s in seeds:
+        key, data = _chunk(s)
+        out[key] = data
+    return out
+
+
+def test_cdn_crash_points_join_the_matrix():
+    declared = declared_crashpoints()
+    assert names.CRASH_CDN_PUBLISH_ANNOUNCED in declared
+    assert names.CRASH_CDN_SWAP_STAGED in declared
+
+
+def test_publisher_killed_mid_announce_leaves_head_unmoved():
+    """The announce record lands BEFORE the head bump: a publisher
+    killed between the two leaves an unobservable record, never a torn
+    announce. A restarted trainer re-publishes over it and the fleet
+    converges to the re-published step only."""
+    store = InProcessStore()
+    blobs = _blobs(1, 2)
+    chunks = {k: len(v) for k, v in blobs.items()}
+
+    pub = CdnPublisher(store, "t")
+    arm(names.CRASH_CDN_PUBLISH_ANNOUNCED)
+    try:
+        with pytest.raises(SimulatedCrash):
+            pub.publish(100, chunks)
+    finally:
+        disarm()
+    # Head never moved; the half-written announce is invisible.
+    assert read_head(store, "t") == 0
+    sub = CdnSubscriber(store, "t", 0, 1, durable_fetch=blobs.__getitem__)
+    try:
+        assert sub.wait_for_update(timeout=0.1) is None
+
+        # Trainer restart: a fresh publisher resumes from the durable
+        # head and re-announces (possibly a LATER step — the crashed
+        # one is gone for good, which is the contract).
+        pub2 = CdnPublisher(store, "t")
+        ann = pub2.publish(101, chunks)
+        assert ann is not None and ann.seq == 1
+        got = sub.track_once(timeout=5.0)
+        assert got is not None and got.step == 101
+        assert sub.applied_seq == 1
+    finally:
+        sub.close()
+
+
+def test_subscriber_killed_mid_swap_serves_previous_step():
+    """The crash point sits between stage and swap: a subscriber killed
+    there still serves the previous fully-applied step, and a restart
+    of its tracking loop applies the update cleanly."""
+    store = InProcessStore()
+    blobs1 = _blobs(1)
+    blobs2 = _blobs(2)
+    blobs = dict(blobs1, **blobs2)
+    payload1 = b"".join(blobs1[k] for k in sorted(blobs1))
+    template = {"w": np.zeros(len(payload1), dtype=np.uint8)}
+
+    pub = CdnPublisher(store, "t")
+    sub = CdnSubscriber(store, "t", 0, 1, durable_fetch=blobs.__getitem__)
+    swapper = WeightSwapper(template)
+    try:
+        pub.publish(1, {k: len(v) for k, v in blobs1.items()})
+        assert sub.track_once(swapper, timeout=5.0) is not None
+        assert sub.applied_seq == 1
+        served_before = np.array(swapper.weights["w"], copy=True)
+
+        pub.publish(2, {k: len(v) for k, v in blobs2.items()})
+        arm(names.CRASH_CDN_SWAP_STAGED)
+        try:
+            with pytest.raises(SimulatedCrash):
+                sub.track_once(swapper, timeout=5.0)
+        finally:
+            disarm()
+        # Torn announce never swapped in: applied seq and the served
+        # bytes are still step 1's.
+        assert sub.applied_seq == 1
+        assert swapper.swapped_step == 1
+        np.testing.assert_array_equal(swapper.weights["w"], served_before)
+
+        # Restarted tracking loop converges to step 2.
+        assert sub.track_once(swapper, timeout=5.0) is not None
+        assert sub.applied_seq == 2 and swapper.swapped_step == 2
+        payload2 = b"".join(blobs2[k] for k in sorted(blobs2))
+        np.testing.assert_array_equal(
+            swapper.weights["w"],
+            np.frombuffer(payload2, dtype=np.uint8),
+        )
+    finally:
+        sub.close()
+
+
+def test_corrupt_peer_frames_never_poison_the_swap():
+    """Wire chaos corrupts peer-transport frames: the digest check
+    rejects the damaged bytes and the subscriber retries/falls back —
+    the swapped-in weights are always the announced bytes."""
+    store = InProcessStore()
+    blobs = _blobs(1, 2, 3)
+    chunks = {k: len(v) for k, v in blobs.items()}
+    os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"] = "1.0"
+
+    # Owner (rank 1) syncs first so the victim's pulls have a live
+    # peer to hit; every frame the victim receives is corrupted.
+    subs = [
+        CdnSubscriber(store, "t", i, 2, durable_fetch=blobs.__getitem__)
+        for i in range(2)
+    ]
+    try:
+        CdnPublisher(store, "t").publish(5, chunks)
+        assert subs[1].track_once(timeout=5.0) is not None
+
+        engine = ChaosEngine(
+            FaultPlan.single(point="wire-recv", mode="corrupt", times=3)
+        )
+        install_wire_chaos(engine)
+        try:
+            assert subs[0].track_once(timeout=5.0) is not None
+        finally:
+            uninstall_wire_chaos()
+        assert engine.fired  # the cell actually injected
+        assert subs[0].applied_seq == 1
+        # Whatever mix of peer retries and durable fallbacks happened,
+        # the synced bytes match the announced digests.
+        for key, data in subs[0].sync(
+            read_announce(store, "t", 1)
+        ).items():
+            assert data == blobs[key]
+    finally:
+        for s in subs:
+            s.close()
+        os.environ.pop("TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS", None)
+
+
+def test_fsck_cas_clean_with_fleet_lease_outstanding(tmp_path):
+    """Retention dropped a step the fleet still serves: the leased
+    chunks survive GC as UNREFERENCED entries — informational, never
+    problems — so ``fsck --cas`` stays clean."""
+    root = str(tmp_path / "ckpt")
+    with knobs.enable_cas(), knobs.override_cas_gc_grace_seconds(0):
+        mgr = ts.CheckpointManager(root, keep_last_n=1)
+        mgr.save(
+            0, {"m": ts.PyTreeState({"w": np.arange(512, dtype=np.float32)})}
+        )
+        store = CASStore(root)
+        pins, _, _ = store.load_full()
+        step0_chunks = dict(pins[0])
+        store.lease("cdn/t/0", step0_chunks)
+        mgr.save(
+            1,
+            {"m": ts.PyTreeState({"w": np.arange(512, dtype=np.float32) + 9.0})},
+        )
+    report = verify_cas_store(root, deep=True)
+    assert report.ok, [str(p) for p in report.problems]
+    # The fleet-held chunks are present and accounted as unreferenced.
+    for key in step0_chunks:
+        if key not in report.unreferenced:
+            # Shared with the live step — also fine, also clean.
+            assert key in {
+                k for k in os.listdir(os.path.join(root, "chunks"))
+            }

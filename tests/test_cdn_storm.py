@@ -1,0 +1,191 @@
+"""CDN subscriber storm (bench leg 11's harness) + the staleness
+doctor rule: convergence, the ~1x read-amplification pin, the
+rolling-update dedup pin, and warmup exclusion from the staleness
+distribution."""
+
+import os
+
+from torchsnapshot_tpu.scalemodel import (
+    CdnStormConfig,
+    build_step_chunks,
+    run_cdn_storm,
+)
+from torchsnapshot_tpu.telemetry import doctor, names
+
+
+def test_schedule_is_deterministic_with_churn():
+    cfg = CdnStormConfig(
+        fleet_size=4, steps=3, chunks_per_step=8, churn_fraction=0.25
+    )
+    schedule, blobs = build_step_chunks(cfg)
+    again, _ = build_step_chunks(cfg)
+    assert schedule == again
+    assert len(schedule) == cfg.warmup_steps + cfg.steps
+    # Step 0 is all-new; later steps churn exactly 2 of 8 chunks.
+    assert len(schedule[0]) == 8
+    for prev, cur in zip(schedule, schedule[1:]):
+        assert len(set(cur) - set(prev)) == 2
+    for key, data in blobs.items():
+        assert len(data) == cfg.chunk_bytes
+
+
+def test_storm_converges_at_one_x_amplification():
+    r = run_cdn_storm(
+        CdnStormConfig(
+            fleet_size=6,
+            steps=2,
+            chunks_per_step=6,
+            chunk_bytes=2048,
+            timeout_s=60.0,
+        )
+    )
+    assert r.converged(), (r.converged_subscribers, r.errors)
+    assert not r.errors
+    # The pin: each unique chunk left durable storage exactly once,
+    # regardless of fleet size.
+    assert r.durable_reads == r.unique_chunks_published
+    assert r.read_amplification == 1.0
+    # Rolling update shipped only churned chunks: fleet wire bytes are
+    # well under the fleet's logical step bytes.
+    assert 0.0 < r.dedup_ratio < 1.0
+    assert r.bytes_on_wire < r.bytes_in_steps
+    assert r.peer_fallbacks == 0
+    # Staleness covers measured (post-warmup) steps for every sub.
+    assert r.staleness_samples == 6 * 2
+    assert r.staleness_max_s >= r.staleness_median_s >= 0.0
+
+
+def test_storm_without_swapper_still_tracks():
+    r = run_cdn_storm(
+        CdnStormConfig(
+            fleet_size=3,
+            steps=1,
+            chunks_per_step=4,
+            chunk_bytes=1024,
+            swap=False,
+            timeout_s=30.0,
+        )
+    )
+    assert r.converged() and not r.errors
+    assert r.read_amplification == 1.0
+
+
+def test_storm_restores_pull_timeout_env():
+    prior = os.environ.get("TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS")
+    run_cdn_storm(
+        CdnStormConfig(
+            fleet_size=2,
+            steps=1,
+            chunks_per_step=2,
+            chunk_bytes=512,
+            timeout_s=30.0,
+        )
+    )
+    assert (
+        os.environ.get("TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS")
+        == prior
+    )
+
+
+# ---------------------------------------------------------------------------
+# cdn-staleness-high doctor rule
+# ---------------------------------------------------------------------------
+
+
+def _swap_record(staleness, seq=1, sub=0):
+    return {
+        "event": names.EVENT_CDN_SWAPPED,
+        "topic": "t",
+        "seq": seq,
+        "step": seq,
+        "subscriber": sub,
+        "staleness_s": staleness,
+    }
+
+
+def _verdicts(records):
+    ev = doctor.Evidence(
+        path="x",
+        ledger_records=records,
+        ledger_file="/run/.ledger.jsonl",
+    )
+    return [
+        v
+        for v in doctor.diagnose_evidence(ev)
+        if v.rule == names.RULE_CDN_STALENESS_HIGH
+    ]
+
+
+def test_staleness_rule_fires_over_budget(monkeypatch):
+    monkeypatch.setenv(
+        "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS", "1.0"
+    )
+    records = [{"event": names.EVENT_CDN_PUBLISHED, "seq": 1}]
+    records += [_swap_record(5.0, sub=i) for i in range(6)]
+    verdicts = _verdicts(records)
+    assert len(verdicts) == 1
+    ev = verdicts[0].evidence
+    assert ev["median_staleness_s"] == 5.0
+    assert ev["budget_s"] == 1.0
+    assert ev["swaps_observed"] == 6
+    assert ev["publishes_observed"] == 1
+    assert verdicts[0].source == ".ledger.jsonl"
+
+
+def test_staleness_rule_quiet_within_budget(monkeypatch):
+    monkeypatch.setenv(
+        "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS", "1.0"
+    )
+    assert _verdicts([_swap_record(0.2, sub=i) for i in range(6)]) == []
+
+
+def test_staleness_rule_needs_min_samples(monkeypatch):
+    monkeypatch.setenv(
+        "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS", "1.0"
+    )
+    # 4 samples < the 5-sample floor: one slow swap is an anecdote.
+    assert _verdicts([_swap_record(9.0, sub=i) for i in range(4)]) == []
+
+
+def test_staleness_rule_disabled_by_zero_budget(monkeypatch):
+    monkeypatch.setenv(
+        "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS", "0"
+    )
+    assert _verdicts([_swap_record(9.0, sub=i) for i in range(8)]) == []
+
+
+def test_staleness_rule_end_to_end_through_a_real_ledger(tmp_path):
+    """Post real ledger events through the subscriber's path (root=),
+    then diagnose the directory like the CLI would."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.telemetry import ledger
+
+    root = str(tmp_path)
+    with knobs.enable_ledger():
+        ledger.open_run(root)
+        for i in range(6):
+            ledger.post_event(
+                root,
+                names.EVENT_CDN_SWAPPED,
+                topic="t",
+                seq=1,
+                step=1,
+                subscriber=i,
+                staleness_s=9.5,
+            )
+        os.environ[
+            "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS"
+        ] = "1.0"
+        try:
+            ev = doctor.gather_evidence(root)
+            verdicts = [
+                v
+                for v in doctor.diagnose_evidence(ev)
+                if v.rule == names.RULE_CDN_STALENESS_HIGH
+            ]
+        finally:
+            os.environ.pop(
+                "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS", None
+            )
+    assert len(verdicts) == 1
+    assert verdicts[0].evidence["median_staleness_s"] == 9.5

@@ -309,6 +309,39 @@ def test_ledger_knobs() -> None:
             os.environ["TORCHSNAPSHOT_TPU_LEDGER"] = prev
 
 
+def test_cdn_knobs() -> None:
+    """Suite default (conftest) AND packaged default are off: the CDN
+    publish hook must be an explicit opt-in on the training side. The
+    pull timeout inherits the peer transfer timeout unless pinned."""
+    assert not knobs.is_cdn_enabled()  # conftest pin
+    with knobs.enable_cdn():
+        assert knobs.is_cdn_enabled()
+    assert not knobs.is_cdn_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_CDN", None)
+    try:
+        assert not knobs.is_cdn_enabled()  # packaged default: off
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_CDN"] = prev
+
+    assert knobs.get_cdn_staleness_budget_seconds() == 5.0
+    os.environ["TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS"] = "0.5"
+    try:
+        assert knobs.get_cdn_staleness_budget_seconds() == 0.5
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS"]
+
+    assert (
+        knobs.get_cdn_pull_timeout_seconds()
+        == knobs.get_peer_transfer_timeout_seconds()
+    )
+    os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"] = "2.5"
+    try:
+        assert knobs.get_cdn_pull_timeout_seconds() == 2.5
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"]
+
+
 def test_history_max_records_knob() -> None:
     assert knobs.get_history_max_records() == 0  # conftest zeroes it
     with knobs.override_history_max_records(7):

@@ -323,6 +323,20 @@ def test_restore_report_emitted(tmp_path):
     assert "loading" in restore["phases"]
     assert restore["plugins"]["fs"]["read_bytes"] > 0
     assert restore["bytes_moved"] > 0
+    # The restore envelope (serving cold-start soft spot): plugin
+    # open, event-loop spin-up, and native-lib load are itemized so a
+    # slow restore can be blamed on setup vs. byte movement.
+    assert restore["cold_start_s"] >= 0.0
+    assert set(restore["cold_start"]) == {
+        "event_loop_s",
+        "plugin_open_s",
+        "native_load_s",
+    }
+    assert restore["cold_start_s"] == round(
+        sum(restore["cold_start"].values()), 6
+    )
+    # Take reports carry no restore envelope.
+    assert events[0].get("cold_start_s") is None
 
 
 def test_async_take_report_emitted(tmp_path):

@@ -247,13 +247,26 @@ class CASStore:
     def load(self) -> Tuple[Dict[int, Dict[str, int]], Dict[str, int]]:
         """``(pins, orphans)`` from the journal; a torn tail line (kill
         mid-append) is skipped — the next append heals it."""
+        pins, orphans, _ = self.load_full()
+        return pins, orphans
+
+    def load_full(
+        self,
+    ) -> Tuple[
+        Dict[int, Dict[str, int]], Dict[str, int], Dict[str, Dict[str, int]]
+    ]:
+        """``(pins, orphans, leases)``. Leases are non-step pins — a
+        CDN subscriber's (or any external reader's) held chunk set,
+        keyed by lease id; the newest lease record per id wins (a
+        re-lease IS the release of the chunks the new set dropped)."""
         pins: Dict[int, Dict[str, int]] = {}
         orphans: Dict[str, int] = {}
+        leases: Dict[str, Dict[str, int]] = {}
         try:
             with open(self.journal_path, "r", encoding="utf-8") as f:
                 raw = f.read()
         except OSError:
-            return pins, orphans
+            return pins, orphans, leases
         for line in raw.splitlines():
             line = line.strip()
             if not line:
@@ -275,7 +288,13 @@ class CASStore:
             elif op == "unorphan":
                 for k in rec.get("chunks", []):
                     orphans.pop(str(k), None)
-        return pins, orphans
+            elif op == "lease":
+                leases[str(rec["id"])] = {
+                    str(k): int(v) for k, v in rec.get("chunks", {}).items()
+                }
+            elif op == "unlease":
+                leases.pop(str(rec["id"]), None)
+        return pins, orphans, leases
 
     def _append(self, record: Dict) -> None:
         with _JOURNAL_LOCK:
@@ -298,6 +317,18 @@ class CASStore:
     def unpin(self, step: int) -> None:
         self._append({"op": "unpin", "step": int(step)})
 
+    def lease(self, lease_id: str, chunks: Dict[str, int]) -> None:
+        """Pin ``chunks`` outside step retention under ``lease_id`` (a
+        CDN subscriber's held set, an external reader's working set).
+        Replaces this id's previous lease — callers re-lease their full
+        current set, they never diff."""
+        self._append(
+            {"op": "lease", "id": str(lease_id), "chunks": chunks}
+        )
+
+    def unlease(self, lease_id: str) -> None:
+        self._append({"op": "unlease", "id": str(lease_id)})
+
     def record_orphans(self, chunks: Dict[str, int]) -> None:
         if chunks:
             self._append({"op": "orphan", "chunks": chunks})
@@ -319,10 +350,20 @@ class CASStore:
         pins, orphans = self.load()
         self.compact(pins, orphans)
 
-    def compact(self, pins: Dict[int, Dict[str, int]], orphans: Dict[str, int]) -> None:
+    def compact(
+        self,
+        pins: Dict[int, Dict[str, int]],
+        orphans: Dict[str, int],
+        leases: Optional[Dict[str, Dict[str, int]]] = None,
+    ) -> None:
         """Atomic rewrite to the canonical state (bounds journal growth
-        over long runs; called opportunistically by the manager's GC)."""
+        over long runs; called opportunistically by the manager's GC).
+        Leases default to whatever the journal currently holds — a
+        compaction driven by step state must never drop a subscriber's
+        outstanding pin."""
         with _JOURNAL_LOCK:
+            if leases is None:
+                _, _, leases = self.load_full()
             os.makedirs(self.local_dir, exist_ok=True)
             tmp = self.journal_path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
@@ -330,6 +371,18 @@ class CASStore:
                     f.write(
                         json.dumps(
                             {"op": "pin", "step": step, "chunks": pins[step]},
+                            sort_keys=True,
+                        )
+                        + "\n"
+                    )
+                for lease_id in sorted(leases):
+                    f.write(
+                        json.dumps(
+                            {
+                                "op": "lease",
+                                "id": lease_id,
+                                "chunks": leases[lease_id],
+                            },
                             sort_keys=True,
                         )
                         + "\n"
@@ -347,9 +400,17 @@ class CASStore:
     # -- inventory -------------------------------------------------------
 
     @staticmethod
-    def live_chunks(pins: Dict[int, Dict[str, int]]) -> Set[str]:
+    def live_chunks(
+        pins: Dict[int, Dict[str, int]],
+        leases: Optional[Dict[str, Dict[str, int]]] = None,
+    ) -> Set[str]:
+        """Chunks GC must not delete: every step-pinned chunk, plus —
+        when ``leases`` is given — every chunk a lease still holds
+        (a serving fleet's copy source outlives step retention)."""
         live: Set[str] = set()
         for chunks in pins.values():
+            live.update(chunks)
+        for chunks in (leases or {}).values():
             live.update(chunks)
         return live
 

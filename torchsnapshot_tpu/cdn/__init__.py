@@ -1,0 +1,50 @@
+"""Checkpoint CDN (docs/cdn.md): pub/sub weight streaming.
+
+The training job's CheckpointManager *publishes* each committed step —
+manifest digest plus CAS chunk keys — to a topic riding the
+coordination store; a serving fleet *subscribes*, pulls only novel
+chunks peer-to-peer with a one-durable-read-per-chunk owner election,
+and hot-swaps them in behind a pointer flip. Default OFF
+(``TORCHSNAPSHOT_TPU_CDN=1`` + a manager ``cdn_topic`` turns the
+publish side on; subscribers are explicit objects, no knob needed).
+"""
+
+from .publisher import CdnPublisher
+from .subscriber import (
+    CdnSubscriber,
+    CdnSyncError,
+    SubscriberStats,
+    durable_chunk_reader,
+)
+from .swap import SwapError, WeightSwapper, concat_assembler
+from .topic import (
+    CDN_SERVICE,
+    TOPIC_PREFIX,
+    Announce,
+    announce_key,
+    head_key,
+    manifest_digest,
+    read_announce,
+    read_head,
+    verify_chunk_bytes,
+)
+
+__all__ = [
+    "Announce",
+    "CDN_SERVICE",
+    "CdnPublisher",
+    "CdnSubscriber",
+    "CdnSyncError",
+    "SubscriberStats",
+    "SwapError",
+    "TOPIC_PREFIX",
+    "WeightSwapper",
+    "announce_key",
+    "concat_assembler",
+    "durable_chunk_reader",
+    "head_key",
+    "manifest_digest",
+    "read_announce",
+    "read_head",
+    "verify_chunk_bytes",
+]

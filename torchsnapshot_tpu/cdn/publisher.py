@@ -1,0 +1,112 @@
+"""The training-job side of the CDN: announce committed steps.
+
+One :class:`CdnPublisher` per manager root per topic, driven from rank
+0's post-commit hook (manager.py). Publishing is two store writes —
+announce record, then head bump — with the crash point between them:
+the only torn state a mid-publish kill can leave is an announce record
+no subscriber will ever read (head still names the previous seq), which
+the next publish simply overwrites. No barrier, no ack wait: the
+training job never blocks on the serving fleet.
+
+Publishing is strictly additive metadata — the chunks themselves were
+already made durable by the commit the announce describes. Best-effort
+by design: a publish failure degrades the serving fleet's freshness,
+never the training job's checkpoint."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from .. import telemetry
+from ..chaos import crashpoint
+from ..dist_store import Store
+from ..telemetry import ledger
+from ..telemetry import names as metric_names
+from ..telemetry.trace import get_recorder as _trace_recorder
+from .topic import Announce, announce_key, head_key, manifest_digest, read_head
+
+logger = logging.getLogger(__name__)
+
+
+class CdnPublisher:
+    """Publish committed steps' chunk sets to one topic.
+
+    ``root`` (the manager root URL) routes typed ledger events through
+    the owned-root gate; omit it for store-only publishing (tests,
+    external publishers)."""
+
+    def __init__(
+        self,
+        store: Store,
+        topic: str,
+        publisher_id: str = "",
+        root: Optional[str] = None,
+    ) -> None:
+        self._store = store
+        self.topic = topic
+        self.publisher_id = publisher_id
+        self._root = root
+        # Cache the head locally: the publisher is the topic's single
+        # writer, so after the first read it alone knows the tip.
+        self._seq: Optional[int] = None
+
+    @property
+    def last_seq(self) -> int:
+        if self._seq is None:
+            self._seq = read_head(self._store, self.topic)
+        return self._seq
+
+    def publish(self, step: int, chunks: Dict[str, int]) -> Optional[Announce]:
+        """Announce one committed step. Returns the announce, or None
+        when the store rejected the writes (logged, never raised —
+        freshness degrades, training does not)."""
+        seq = self.last_seq + 1
+        ann = Announce(
+            topic=self.topic,
+            seq=seq,
+            step=int(step),
+            digest=manifest_digest(step, chunks),
+            chunks=dict(chunks),
+            published_ts=time.time(),
+            publisher=self.publisher_id,
+        )
+        encoded = ann.encode()
+        try:
+            with _trace_recorder().span(
+                metric_names.SPAN_CDN_PUBLISH, topic=self.topic, step=int(step)
+            ):
+                # Announce-record-first, head-bump-second: the head is
+                # the commit marker, so a kill between the writes tears
+                # nothing a subscriber can observe.
+                self._store.set(announce_key(self.topic, seq), encoded)
+                crashpoint(metric_names.CRASH_CDN_PUBLISH_ANNOUNCED)
+                self._store.set(head_key(self.topic), str(seq).encode())
+        except Exception as e:  # noqa: BLE001 - never fail the training job
+            logger.warning(
+                "cdn: publish of step %d to topic %r failed: %r",
+                step,
+                self.topic,
+                e,
+            )
+            self._seq = None  # head state unknown: re-read next publish
+            return None
+        self._seq = seq
+        registry = telemetry.metrics()
+        registry.counter_inc(metric_names.CDN_PUBLISHES_TOTAL)
+        registry.counter_inc(
+            metric_names.CDN_ANNOUNCE_BYTES_TOTAL, float(len(encoded))
+        )
+        if self._root is not None:
+            ledger.post_event(
+                self._root,
+                metric_names.EVENT_CDN_PUBLISHED,
+                topic=self.topic,
+                seq=seq,
+                step=int(step),
+                chunks=len(chunks),
+                bytes_in_step=ann.bytes_in_step,
+                published_ts=round(ann.published_ts, 6),
+            )
+        return ann

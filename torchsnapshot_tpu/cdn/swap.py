@@ -1,0 +1,172 @@
+"""Hot weight swap: shadow-stage an announced step, flip pointers.
+
+The restore path's lesson (``snapshot._PlacementBatch``) applied to
+serving: never mutate the arrays a model is serving from. ``stage``
+assembles the announce's chunk bytes into a complete *shadow* set of
+host buffers — the served weights are untouched, so a subscriber killed
+mid-stage (the ``cdn-swap-staged`` crash point) still serves the
+previous fully-applied step. ``swap`` then moves the whole shadow set
+device-side in ONE batched ``jax.device_put`` (per-leaf puts pay
+dispatch latency once per leaf; the batch pays it once per step) onto
+each old array's own sharding, flips the pointers, and ``delete()``s
+the old device buffers — the donation discipline: the pause inference
+observes is a pointer swap, and peak device memory is old + new for
+only the instant between placement and delete.
+
+The chunk-bytes-to-leaves mapping is the serving binary's knowledge,
+injected as ``assemble(announce, chunk_bytes) -> {leaf: host_array}``;
+:func:`concat_assembler` covers the common dense layout (chunks
+concatenated in sorted-key order, sliced per template leaf) used by the
+storm/bench harnesses."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..telemetry import names as metric_names
+from ..telemetry.trace import get_recorder as _trace_recorder
+from .topic import Announce
+
+
+class SwapError(RuntimeError):
+    """The assembled update does not cover the serving template."""
+
+
+def concat_assembler(
+    template: Dict[str, Any],
+) -> Callable[[Announce, Dict[str, bytes]], Dict[str, Any]]:
+    """Assembler for the dense concat layout: the announce's chunks,
+    concatenated in sorted-key order, are the template's leaves
+    flattened in sorted-name order. Exact-size checked — a short or
+    long byte stream is a torn update and must never stage."""
+    import numpy as np
+
+    # Snapshot shapes/dtypes NOW: after a donation swap the template's
+    # jax leaves are deleted buffers, so touching them at assemble time
+    # would crash the second update of every serving run.
+    spec = []
+    for name in sorted(template):
+        leaf = template[name]
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            shape, dtype = tuple(leaf.shape), np.dtype(leaf.dtype)
+        else:
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        spec.append((name, shape, dtype, nbytes))
+
+    def assemble(
+        ann: Announce, chunk_bytes: Dict[str, bytes]
+    ) -> Dict[str, Any]:
+        stream = b"".join(chunk_bytes[k] for k in sorted(chunk_bytes))
+        out: Dict[str, Any] = {}
+        offset = 0
+        for name, shape, dtype, nbytes in spec:
+            window = stream[offset : offset + nbytes]
+            if len(window) != nbytes:
+                raise SwapError(
+                    f"announced step covers {len(stream)} bytes; leaf "
+                    f"{name!r} needs [{offset}, {offset + nbytes})"
+                )
+            out[name] = np.frombuffer(window, dtype=dtype).reshape(shape)
+            offset += nbytes
+        if offset != len(stream):
+            raise SwapError(
+                f"announced step has {len(stream) - offset} bytes past "
+                "the template's layout"
+            )
+        return out
+
+    return assemble
+
+
+class _StagedUpdate:
+    """A fully assembled shadow set, not yet visible to serving."""
+
+    __slots__ = ("announce", "host_arrays")
+
+    def __init__(
+        self, announce: Announce, host_arrays: Dict[str, Any]
+    ) -> None:
+        self.announce = announce
+        self.host_arrays = host_arrays
+
+
+class WeightSwapper:
+    """Serve one weight set; atomically replace it per announce.
+
+    ``weights`` is the served leaf map (jax arrays on an accelerator,
+    plain numpy in host-only tests — both flavors swap; only jax
+    leaves take the batched device placement)."""
+
+    def __init__(
+        self,
+        weights: Dict[str, Any],
+        assemble: Optional[
+            Callable[[Announce, Dict[str, bytes]], Dict[str, Any]]
+        ] = None,
+    ) -> None:
+        self._weights = dict(weights)
+        self._assemble = (
+            assemble if assemble is not None else concat_assembler(weights)
+        )
+        self.swapped_step: Optional[int] = None
+
+    @property
+    def weights(self) -> Dict[str, Any]:
+        """The currently served leaf map (post last completed swap)."""
+        return self._weights
+
+    def stage(
+        self, ann: Announce, chunk_bytes: Dict[str, bytes]
+    ) -> _StagedUpdate:
+        host = self._assemble(ann, chunk_bytes)
+        missing = set(self._weights) - set(host)
+        if missing:
+            raise SwapError(
+                f"assembled update misses leaves: {sorted(missing)[:5]}"
+            )
+        return _StagedUpdate(ann, host)
+
+    def swap(self, staged: _StagedUpdate) -> None:
+        with _trace_recorder().span(
+            metric_names.SPAN_CDN_SWAP,
+            topic=staged.announce.topic,
+            step=staged.announce.step,
+        ):
+            old = self._weights
+            jax_names = [
+                n
+                for n in sorted(staged.host_arrays)
+                if _is_jax_array(old.get(n))
+            ]
+            fresh: Dict[str, Any] = dict(staged.host_arrays)
+            if jax_names:
+                import jax
+
+                placed = jax.device_put(
+                    [staged.host_arrays[n] for n in jax_names],
+                    [old[n].sharding for n in jax_names],
+                )
+                for name, arr in zip(jax_names, placed):
+                    fresh[name] = arr
+            # The pointer swap IS the cutover; everything before this
+            # line left the served set untouched.
+            self._weights = fresh
+            self.swapped_step = staged.announce.step
+            for name in jax_names:
+                try:
+                    old[name].delete()  # donation: free the old buffers
+                except Exception:  # noqa: BLE001 - already-donated is fine
+                    pass
+
+
+def _is_jax_array(value: Any) -> bool:
+    if value is None:
+        return False
+    try:
+        import jax
+
+        return isinstance(value, jax.Array)
+    except Exception:  # noqa: BLE001 - jax-less host is a valid server
+        return False

@@ -1,0 +1,165 @@
+"""CDN topic registry: the announce/head key schema on the store.
+
+A topic is a tiny single-writer log riding the coordination store's
+plain KV primitives — no barriers, no collectives, nothing that couples
+the serving fleet to the training job's schedule:
+
+- ``__cdn/<topic>/announce/<seq>`` — one immutable announce record per
+  published step: the step number, a manifest digest, and the full CAS
+  chunk set (``digest key -> nbytes``) the step's manifest references.
+- ``__cdn/<topic>/head`` — the highest *fully published* sequence
+  number. Written strictly AFTER the announce record (the commit-
+  marker-last discipline every layer of this stack uses): a publisher
+  killed between the two writes leaves a record no subscriber will
+  ever observe, never a torn announce that one will.
+
+Subscribers poll the single head key with the world-scaled
+:class:`~torchsnapshot_tpu.dist_store._PollPacer` backoff, so an idle
+fleet of N subscribers costs O(N) low-QPS polls, not a collective. All
+keys are ordinary store keys — ``ShardedStore`` routes them by crc32
+like any other, so topic traffic spreads across store shards.
+
+The announce codec is JSON (not pickle): a serving fleet on a different
+package version must be able to read a training job's announces, and a
+damaged record must decode to ``None``, never to arbitrary objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, Optional
+
+from ..dist_store import Store
+
+# Key namespace on the coordination store (alongside __endpoint etc.).
+TOPIC_PREFIX = "__cdn"
+# Endpoint-registry service namespace for subscriber chunk servers
+# (dist_store.publish_endpoint / lookup_endpoints).
+CDN_SERVICE = "cdn-fleet"
+
+
+def head_key(topic: str) -> str:
+    return f"{TOPIC_PREFIX}/{topic}/head"
+
+
+def announce_key(topic: str, seq: int) -> str:
+    return f"{TOPIC_PREFIX}/{topic}/announce/{int(seq)}"
+
+
+def manifest_digest(step: int, chunks: Dict[str, int]) -> str:
+    """Deterministic digest of one announced step's chunk set. The
+    chunk keys already embed per-chunk digests, so hashing the sorted
+    key set (plus the step) commits to the full content; subscribers
+    re-derive it from the decoded record to detect field-level damage
+    a well-formed JSON parse would let through."""
+    h = hashlib.sha256()
+    h.update(str(int(step)).encode())
+    for key in sorted(chunks):
+        h.update(b"\0")
+        h.update(key.encode())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class Announce:
+    """One published step, as subscribers see it."""
+
+    topic: str
+    seq: int
+    step: int
+    digest: str
+    # CAS digest key -> nbytes (the step's full chunk set; subscribers
+    # diff it against what they already hold).
+    chunks: Dict[str, int]
+    # Publisher wall-clock at publish: the staleness anchor. Cross-host
+    # clock skew folds into every subscriber's staleness identically,
+    # so the *distribution* stays comparable even when the absolute
+    # numbers carry the offset.
+    published_ts: float
+    publisher: str = ""
+
+    @property
+    def bytes_in_step(self) -> int:
+        return int(sum(self.chunks.values()))
+
+    def encode(self) -> bytes:
+        return json.dumps(
+            dataclasses.asdict(self), sort_keys=True
+        ).encode("utf-8")
+
+    @classmethod
+    def decode(cls, raw: bytes) -> Optional["Announce"]:
+        """None for any damage — a subscriber must treat a corrupt
+        record as not-yet-published, never crash on it."""
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            ann = cls(
+                topic=str(doc["topic"]),
+                seq=int(doc["seq"]),
+                step=int(doc["step"]),
+                digest=str(doc["digest"]),
+                chunks={
+                    str(k): int(v) for k, v in doc["chunks"].items()
+                },
+                published_ts=float(doc["published_ts"]),
+                publisher=str(doc.get("publisher", "")),
+            )
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return None
+        if ann.digest != manifest_digest(ann.step, ann.chunks):
+            return None  # field-level damage: digest disagrees
+        return ann
+
+
+def read_head(store: Store, topic: str) -> int:
+    """The highest fully published sequence number (0 = nothing
+    published yet). Unreadable/garbage heads read as 0 — a subscriber
+    facing a flaky store must idle, not crash."""
+    try:
+        raw = store.try_get(head_key(topic))
+    except Exception:  # noqa: BLE001 - poll path must never raise
+        return 0
+    if raw is None:
+        return 0
+    try:
+        return int(raw.decode())
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+def read_announce(
+    store: Store, topic: str, seq: int
+) -> Optional[Announce]:
+    try:
+        raw = store.try_get(announce_key(topic, seq))
+    except Exception:  # noqa: BLE001 - poll path must never raise
+        return None
+    if raw is None:
+        return None
+    return Announce.decode(raw)
+
+
+def verify_chunk_bytes(key: str, data: bytes) -> bool:
+    """Verify chunk bytes against the self-describing CAS digest key
+    (size + whole-blob CRC — the same judgment ``fsck --cas --deep``
+    applies to on-disk copies). Every byte a subscriber accepts — from
+    a peer OR from durable storage — passes through this."""
+    from ..cas import parse_key
+    from ..integrity import _alg_available, _crc_of
+
+    parsed = parse_key(key)
+    if parsed is None:
+        return False
+    alg, want_n, want_crc = parsed
+    if len(data) != want_n:
+        return False
+    if not _alg_available(alg):
+        return True  # cannot judge the bytes; size is all we have
+    return _crc_of(memoryview(data), alg, seed=0) == want_crc
+
+
+def fleet_member_id(doc: Any) -> str:
+    """A stable printable id for ledger/log fields."""
+    return str(doc)

@@ -73,6 +73,11 @@ _WRITE_VECTORIZED_ENV = "TORCHSNAPSHOT_TPU_WRITE_VECTORIZED"
 _FS_DIRECT_IO_ENV = "TORCHSNAPSHOT_TPU_FS_DIRECT_IO"
 _CAS_ENV = "TORCHSNAPSHOT_TPU_CAS"
 _CAS_GC_GRACE_ENV = "TORCHSNAPSHOT_TPU_CAS_GC_GRACE_SECONDS"
+_CDN_ENV = "TORCHSNAPSHOT_TPU_CDN"
+_CDN_STALENESS_BUDGET_ENV = (
+    "TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS"
+)
+_CDN_PULL_TIMEOUT_ENV = "TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"
 _TREE_BARRIER_ENV = "TORCHSNAPSHOT_TPU_TREE_BARRIER"
 _BARRIER_FANOUT_ENV = "TORCHSNAPSHOT_TPU_BARRIER_FANOUT"
 _STORE_SHARDS_ENV = "TORCHSNAPSHOT_TPU_STORE_SHARDS"
@@ -564,6 +569,46 @@ def get_cas_gc_grace_seconds() -> float:
     return _DEFAULT_CAS_GC_GRACE_SECONDS
 
 
+_DEFAULT_CDN_STALENESS_BUDGET_SECONDS = 5.0
+
+
+def is_cdn_enabled() -> bool:
+    """Checkpoint CDN (docs/cdn.md), default OFF: with ``"1"``, a
+    manager constructed with a ``cdn_topic`` publishes every committed
+    step — manifest digest plus CAS chunk keys — to a subscription
+    topic riding the coordination store, and serving-side
+    ``CdnSubscriber`` processes stream the chunk deltas peer-to-peer
+    and hot-swap them in. Off = the manager never announces and never
+    touches the topic keys; subscribers constructed explicitly still
+    work (the knob gates the *training-job* side, where an accidental
+    publish would add coordination traffic to every commit)."""
+    return os.environ.get(_CDN_ENV, "0") not in ("", "0")
+
+
+def get_cdn_staleness_budget_seconds() -> float:
+    """The publish-to-swap latency budget the ``cdn-staleness-high``
+    doctor rule holds the fleet to: when the median staleness across
+    the run ledger's cdn-swapped records exceeds this, the rule fires.
+    Also the subscriber storm's pass/fail line in the cdn_streaming
+    bench leg."""
+    val = os.environ.get(_CDN_STALENESS_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_CDN_STALENESS_BUDGET_SECONDS
+
+
+def get_cdn_pull_timeout_seconds() -> float:
+    """Per-chunk deadline for a subscriber's peer-to-peer pull (connect
+    + one digest-verified transfer) AND the wait for the chunk's elected
+    owner to materialize it. On expiry the subscriber falls back to the
+    durable store read — correctness never rides a peer, only the ~1x
+    storage-read economics do. Defaults to the peer transfer timeout."""
+    val = os.environ.get(_CDN_PULL_TIMEOUT_ENV)
+    if val is not None:
+        return float(val)
+    return get_peer_transfer_timeout_seconds()
+
+
 def is_write_vectorized_enabled() -> bool:
     """Zero-pack vectorized slab writes (default ON): the batcher's slab
     stage hands its members' staged buffers straight to the storage
@@ -993,6 +1038,26 @@ def enable_cas() -> Generator[None, None, None]:
 @contextlib.contextmanager
 def disable_cas() -> Generator[None, None, None]:
     with _override_env(_CAS_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def enable_cdn() -> Generator[None, None, None]:
+    """Force the checkpoint-CDN publish hook ON for the block (the
+    suite's conftest pins it off so tier-1 manager tests see no
+    announce traffic; CDN tests opt back in here)."""
+    with _override_env(_CDN_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def override_cdn_pull_timeout_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    """Pin the CDN peer-pull deadline for the block (subscribers read
+    it per pull, so the storm harness tightens it fleet-wide without
+    threading a parameter through every subscriber)."""
+    with _override_env(_CDN_PULL_TIMEOUT_ENV, str(seconds)):
         yield
 
 

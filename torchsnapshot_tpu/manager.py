@@ -264,6 +264,7 @@ class _PendingManagedSnapshot:
             telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
             self._manager._record_step_history(self._step)
             self._manager._post_step_ledger(self._step, snapshot)
+            self._manager._publish_cdn_step(self._step, snapshot)
             self._manager._autotune_step(self._step)
             self._committed = True
         return snapshot
@@ -313,6 +314,8 @@ class CheckpointManager:
         best_mode: str = "min",
         keep_fast_last_n: Optional[int] = None,
         keep_peer_last_n: Optional[int] = None,
+        cdn_topic: Optional[str] = None,
+        cdn_store: Optional[Any] = None,
     ) -> None:
         if keep_last_n is not None and keep_last_n < 1:
             raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
@@ -388,6 +391,15 @@ class CheckpointManager:
         # CAS steps from an earlier run keeps refcounted GC even with
         # the knob now off.
         self._cas_store: Any = False
+        # Checkpoint CDN publish side (docs/cdn.md): with the CDN knob
+        # on and a topic named, rank 0 announces every committed step's
+        # chunk set to the coordination store so a serving fleet can
+        # track the run. ``cdn_store`` overrides the pg's store (tests,
+        # cross-job stores). Publisher is built lazily on first commit
+        # — constructing a manager must not touch the store.
+        self.cdn_topic = cdn_topic
+        self._cdn_store_arg = cdn_store
+        self._cdn_publisher: Any = None
         # Exact per-step storage accounting computed at commit time
         # (chunks newly materialized vs. reused), read back by
         # _post_step_ledger; and the previous committed manifest's
@@ -478,6 +490,7 @@ class CheckpointManager:
         telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         self._record_step_history(step)
         self._post_step_ledger(step, snapshot)
+        self._publish_cdn_step(step, snapshot)
         self._autotune_step(step)
         return snapshot
 
@@ -617,6 +630,54 @@ class CheckpointManager:
             logger.warning(
                 "could not post step %d to the run ledger: %r", step, e
             )
+
+    def _publish_cdn_step(self, step: int, snapshot: Snapshot) -> None:
+        """Announce the just-committed step's chunk set on the CDN
+        topic (docs/cdn.md). Rank 0 only, post-commit only — the
+        announce's chunks are already durable by construction. Steps
+        without content-addressed chunks (CAS off) have nothing a
+        fleet can dedup-pull, so they are skipped, not half-announced.
+        Best-effort: a publish failure degrades serving freshness,
+        never the save."""
+        if (
+            self.cdn_topic is None
+            or self._pg.get_rank() != 0
+            or not knobs.is_cdn_enabled()
+        ):
+            return
+        try:
+            chunks = _manifest_chunk_refs(snapshot.metadata.manifest)
+            if not chunks:
+                logger.debug(
+                    "cdn: step %d carries no CAS chunks; not published",
+                    step,
+                )
+                return
+            if self._cdn_publisher is None:
+                store = (
+                    self._cdn_store_arg
+                    if self._cdn_store_arg is not None
+                    else self._pg.store
+                )
+                if store is None:
+                    logger.warning(
+                        "cdn: topic %r configured but no coordination "
+                        "store is reachable; steps will not be published",
+                        self.cdn_topic,
+                    )
+                    self.cdn_topic = None
+                    return
+                from .cdn import CdnPublisher
+
+                self._cdn_publisher = CdnPublisher(
+                    store,
+                    self.cdn_topic,
+                    publisher_id=f"rank0@{self.root}",
+                    root=self.root,
+                )
+            self._cdn_publisher.publish(step, chunks)
+        except Exception as e:  # noqa: BLE001 - publishing is best-effort
+            logger.warning("cdn: could not publish step %d: %r", step, e)
 
     def _autotune_step(self, step: int) -> None:
         """One closed-loop tuning pass after ``step`` committed: rank 0
@@ -1047,7 +1108,7 @@ class CheckpointManager:
         store = self._get_cas_store()
         if store is None:
             return
-        pins, orphans = store.load()
+        pins, orphans, leases = store.load_full()
         candidates: Dict[str, int] = dict(orphans)
         unpinned = False
         for old in deleted_steps:
@@ -1061,7 +1122,10 @@ class CheckpointManager:
             # (dead chunks must age out via grace/stray sweeps, never
             # dangle).
             _crashpoint(metric_names.CRASH_GC_UNPINNED)
-        live = store.live_chunks(pins)
+        # Leases (CDN subscriber pins) count as live: a serving fleet's
+        # durable copy source must survive step retention until the
+        # fleet re-leases without it.
+        live = store.live_chunks(pins, leases)
         # Stray sweep: on-disk chunks in NO pin and NO orphan record —
         # a take that crashed before its commit pinned them, or pins
         # reconcile dropped. Without this they would never become GC

@@ -14,6 +14,12 @@ bench leg 10, and ``tests/test_scalemodel.py`` pins correctness under
 injected rank death. See docs/scaling.md.
 """
 
+from .cdn_storm import (  # noqa: F401
+    CdnStormConfig,
+    CdnStormResult,
+    build_step_chunks,
+    run_cdn_storm,
+)
 from .harness import (  # noqa: F401
     CountingStore,
     PerKeyStore,

@@ -1,0 +1,272 @@
+"""CDN subscriber storm: a serving fleet tracking a publishing trainer.
+
+One publisher thread announces ``steps`` synthetic checkpoint steps
+(content-addressed chunk sets with a configurable per-step churn
+fraction — a rolling update replaces some chunks, keeps the rest) while
+``fleet_size`` subscriber threads run the REAL
+:class:`~torchsnapshot_tpu.cdn.CdnSubscriber` machinery: each runs its
+own peer-cache TCP server, polls the topic head with the world-scaled
+pacer, elects chunk owners, pulls novel chunks peer-to-peer, and
+hot-swaps via :class:`~torchsnapshot_tpu.cdn.WeightSwapper`.
+
+The storm's pins (bench leg 11, tests/test_cdn_storm.py):
+
+- **read amplification** — durable reads / unique chunks published,
+  counted by the wrapped ``durable_fetch``. Owner election makes this
+  ~1.0 regardless of fleet size (each unique chunk leaves durable
+  storage once; timeouts under load may add a small epsilon).
+- **staleness** — publish-to-swap seconds per subscriber per step; the
+  storm reports the distribution (median/p90/max).
+- **dedup ratio** — fleet bytes-on-wire vs. fleet bytes-in-steps: a
+  rolling update ships only churned chunks, so wire bytes stay well
+  under step bytes once the fleet holds a baseline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..cas import digest_key
+from ..cdn import CdnPublisher, CdnSubscriber, WeightSwapper
+from ..dist_store import InProcessStore, Store
+from ..knobs import override_cdn_pull_timeout_seconds
+
+
+@dataclasses.dataclass
+class CdnStormConfig:
+    fleet_size: int
+    steps: int = 3
+    # Bootstrap steps published (and applied) before measurement: the
+    # fleet's first sync pulls the FULL chunk set (cold start), while
+    # the staleness pin is about the steady state where only churned
+    # chunks ship. Staleness samples from warmup steps are excluded;
+    # byte/read accounting still covers the whole schedule.
+    warmup_steps: int = 1
+    chunks_per_step: int = 8
+    chunk_bytes: int = 4096
+    # Fraction of the chunk set replaced each step (a rolling update);
+    # 1.0 = every step all-new, 0.0 = pure re-announce.
+    churn_fraction: float = 0.25
+    publish_interval_s: float = 0.05
+    pull_timeout_s: float = 2.0
+    # Per-subscriber wait for the whole storm to complete.
+    timeout_s: float = 60.0
+    topic: str = "storm"
+    swap: bool = True
+
+
+@dataclasses.dataclass
+class CdnStormResult:
+    config: CdnStormConfig
+    wall_s: float
+    # Durable-read accounting (the ~1x pin).
+    durable_reads: int
+    unique_chunks_published: int
+    read_amplification: float
+    # Fleet byte split (the dedup pin).
+    bytes_on_wire: int
+    bytes_in_steps: int
+    bytes_from_peer: int
+    bytes_from_durable: int
+    # Publish-to-swap staleness distribution across all (sub, step).
+    staleness_median_s: float
+    staleness_p90_s: float
+    staleness_max_s: float
+    staleness_samples: int
+    # Convergence: subscribers whose final applied seq == steps.
+    converged_subscribers: int
+    peer_fallbacks: int
+    errors: Dict[int, str]
+
+    @property
+    def dedup_ratio(self) -> float:
+        """bytes_on_wire / bytes_in_steps — < 1 means the fleet shipped
+        less than the steps' logical size (held chunks re-served)."""
+        if self.bytes_in_steps <= 0:
+            return 0.0
+        return self.bytes_on_wire / self.bytes_in_steps
+
+    def converged(self) -> bool:
+        return self.converged_subscribers == self.config.fleet_size
+
+
+def _make_chunk(seed: int, nbytes: int) -> Tuple[str, bytes]:
+    """Deterministic unique chunk bytes + self-describing CAS key."""
+    unit = seed.to_bytes(8, "little", signed=False)
+    data = (unit * (nbytes // 8 + 1))[:nbytes]
+    key = digest_key(("crc32", zlib.crc32(data), len(data)))
+    return key, data
+
+
+def build_step_chunks(
+    cfg: CdnStormConfig,
+) -> Tuple[List[Dict[str, int]], Dict[str, bytes]]:
+    """The storm's publish schedule: per-step chunk sets with churn,
+    plus the backing blob map the counting ``durable_fetch`` serves."""
+    blobs: Dict[str, bytes] = {}
+    schedule: List[Dict[str, int]] = []
+    keys: List[str] = []
+    seed = 0
+    for step in range(cfg.warmup_steps + cfg.steps):
+        if step == 0:
+            replace = cfg.chunks_per_step
+        else:
+            replace = max(
+                1, int(round(cfg.chunks_per_step * cfg.churn_fraction))
+            )
+        kept = keys[: cfg.chunks_per_step - replace]
+        fresh: List[str] = []
+        for _ in range(replace):
+            key, data = _make_chunk(seed, cfg.chunk_bytes)
+            seed += 1
+            blobs[key] = data
+            fresh.append(key)
+        keys = fresh + kept
+        schedule.append({k: len(blobs[k]) for k in keys})
+    return schedule, blobs
+
+
+def run_cdn_storm(
+    cfg: CdnStormConfig, store: Optional[Store] = None
+) -> CdnStormResult:
+    store = store if store is not None else InProcessStore()
+    schedule, blobs = build_step_chunks(cfg)
+    unique_chunks = len(blobs)
+    bytes_in_steps = sum(sum(c.values()) for c in schedule)
+
+    durable_lock = threading.Lock()
+    durable_reads = {"n": 0}
+
+    def durable_fetch(key: str) -> bytes:
+        with durable_lock:
+            durable_reads["n"] += 1
+        return blobs[key]
+
+    # Subscribers read the pull timeout from the knob at call time; the
+    # storm pins it for its own window and restores the caller's value.
+    cleanup = contextlib.ExitStack()
+    cleanup.enter_context(
+        override_cdn_pull_timeout_seconds(cfg.pull_timeout_s)
+    )
+    subs: List[CdnSubscriber] = []
+    errors: Dict[int, str] = {}
+    errors_lock = threading.Lock()
+    started = time.monotonic()
+    try:
+        subs = [
+            CdnSubscriber(
+                store,
+                cfg.topic,
+                i,
+                cfg.fleet_size,
+                durable_fetch=durable_fetch,
+            )
+            for i in range(cfg.fleet_size)
+        ]
+
+        import numpy as np
+
+        total_bytes = cfg.chunks_per_step * cfg.chunk_bytes
+        total_steps = cfg.warmup_steps + cfg.steps
+        deadline = time.monotonic() + cfg.timeout_s
+
+        def subscriber_main(sub: CdnSubscriber) -> None:
+            swapper = (
+                WeightSwapper({"w": np.zeros(total_bytes, np.uint8)})
+                if cfg.swap
+                else None
+            )
+            try:
+                while (
+                    sub.applied_seq < total_steps
+                    and time.monotonic() < deadline
+                ):
+                    sub.track_once(swapper, timeout=0.25)
+            except BaseException as e:  # noqa: BLE001 - recorded, not raised
+                with errors_lock:
+                    errors[sub.subscriber_id] = repr(e)
+
+        threads = [
+            threading.Thread(
+                target=subscriber_main, args=(s,), daemon=True
+            )
+            for s in subs
+        ]
+        for t in threads:
+            t.start()
+
+        publisher = CdnPublisher(store, cfg.topic, publisher_id="storm")
+        for step, chunks in enumerate(
+            schedule[: cfg.warmup_steps], start=1
+        ):
+            publisher.publish(step, chunks)
+            time.sleep(cfg.publish_interval_s)
+        # Warmup barrier: wait for the fleet to finish its cold
+        # bootstrap, then snapshot per-sub sample counts so the
+        # staleness distribution covers steady-state steps only.
+        while (
+            any(s.applied_seq < cfg.warmup_steps for s in subs)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        time.sleep(cfg.publish_interval_s)
+        warmup_samples = [len(s.stats.staleness_s) for s in subs]
+        for step, chunks in enumerate(
+            schedule[cfg.warmup_steps :], start=cfg.warmup_steps + 1
+        ):
+            publisher.publish(step, chunks)
+            time.sleep(cfg.publish_interval_s)
+
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()) + 1.0)
+        wall_s = time.monotonic() - started
+
+        staleness = sorted(
+            s
+            for sub, base in zip(subs, warmup_samples)
+            for s in sub.stats.staleness_s[base:]
+        )
+
+        def pct(frac: float) -> float:
+            if not staleness:
+                return 0.0
+            return staleness[
+                min(len(staleness) - 1, int(len(staleness) * frac))
+            ]
+
+        return CdnStormResult(
+            config=cfg,
+            wall_s=round(wall_s, 3),
+            durable_reads=durable_reads["n"],
+            unique_chunks_published=unique_chunks,
+            read_amplification=(
+                durable_reads["n"] / unique_chunks if unique_chunks else 0.0
+            ),
+            bytes_on_wire=sum(s.stats.bytes_on_wire for s in subs),
+            bytes_in_steps=bytes_in_steps * cfg.fleet_size,
+            bytes_from_peer=sum(s.stats.bytes_from_peer for s in subs),
+            bytes_from_durable=sum(
+                s.stats.bytes_from_durable for s in subs
+            ),
+            staleness_median_s=round(pct(0.5), 6),
+            staleness_p90_s=round(pct(0.9), 6),
+            staleness_max_s=round(staleness[-1], 6) if staleness else 0.0,
+            staleness_samples=len(staleness),
+            converged_subscribers=sum(
+                1 for s in subs if s.applied_seq >= total_steps
+            ),
+            peer_fallbacks=sum(s.stats.peer_fallbacks for s in subs),
+            errors=errors,
+        )
+    finally:
+        for sub in subs:
+            try:
+                sub.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+        cleanup.close()

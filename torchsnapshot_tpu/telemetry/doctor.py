@@ -105,6 +105,13 @@ DEDUP_UNCHANGED_FRAC = 0.5
 # polling a local store never flag.
 COORD_BOUND_FRACTION = 0.3
 COORD_MIN_S = 0.05
+# cdn-staleness-high: the median publish-to-swap latency across the
+# trailing window of cdn-swapped ledger records exceeds the budget knob
+# (TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS) — the serving fleet
+# is lagging the training job. A minimum sample count keeps one slow
+# cold-start swap from convicting the whole fleet.
+CDN_STALENESS_WINDOW = 20
+CDN_STALENESS_MIN_SAMPLES = 5
 # Bench-trial epistemics (formerly private to bench.py):
 # adjacent probes disagreeing beyond this factor = unstable link;
 # achieved/bracket below this ratio on a stable bracket = in-take stall.
@@ -974,6 +981,61 @@ def _dedup_ineffective(ev: Evidence):
             "window": DEDUP_WINDOW_STEPS,
             "reuse_floor": DEDUP_REUSE_FLOOR,
             "unchanged_threshold": DEDUP_UNCHANGED_FRAC,
+        },
+        "source": os.path.basename(ev.ledger_file),
+    }
+
+
+@doctor_rule(names.RULE_CDN_STALENESS_HIGH, scope="evidence")
+def _cdn_staleness_high(ev: Evidence):
+    """The serving fleet is lagging the training job: the median
+    publish-to-swap latency over the trailing ``cdn-swapped`` ledger
+    records exceeds the staleness budget knob. Evidence cites the
+    publish/swap event counts and the per-subscriber staleness spread —
+    a uniformly slow fleet points at the announce path or durable
+    reads; a long tail points at individual subscribers (dead owner
+    endpoints forcing pull-timeout durable fallbacks)."""
+    swaps = [
+        r
+        for r in ev.ledger_records
+        if r.get("event") == names.EVENT_CDN_SWAPPED
+        and r.get("staleness_s") is not None
+    ]
+    window = swaps[-max(CDN_STALENESS_WINDOW, 1) :]
+    if len(window) < CDN_STALENESS_MIN_SAMPLES:
+        return None
+    from .. import knobs as _knobs
+
+    budget = _knobs.get_cdn_staleness_budget_seconds()
+    if budget <= 0:
+        return None
+    samples = sorted(float(r["staleness_s"]) for r in window)
+    median = samples[len(samples) // 2]
+    if median <= budget:
+        return None
+    publishes = sum(
+        1
+        for r in ev.ledger_records
+        if r.get("event") == names.EVENT_CDN_PUBLISHED
+    )
+    return {
+        "summary": (
+            "the serving fleet's median publish-to-swap staleness "
+            "exceeds the budget — subscribers are applying steps late; "
+            "check owner endpoint health (pull-timeout fallbacks), "
+            "announce cadence, and durable-read latency"
+        ),
+        "evidence": {
+            "median_staleness_s": round(median, 4),
+            "p90_staleness_s": round(
+                samples[min(len(samples) - 1, (len(samples) * 9) // 10)], 4
+            ),
+            "budget_s": budget,
+            "swaps_observed": len(window),
+            "publishes_observed": publishes,
+            "subscribers": len(
+                {r.get("subscriber") for r in window}
+            ),
         },
         "source": os.path.basename(ev.ledger_file),
     }

@@ -132,6 +132,22 @@ RSS_PEAK_DELTA_BYTES = "rss_peak_delta_bytes"
 
 WATCHDOG_STALLS_TOTAL = "watchdog_stalls_total"
 
+# -- checkpoint CDN (cdn/) ---------------------------------------------------
+#
+# Pub/sub weight streaming from a training job to a serving fleet
+# (docs/cdn.md): the publisher's announce accounting, each subscriber's
+# chunk-sync byte split by serving tier (durable storage read vs.
+# peer-to-peer pull vs. already-held), and the staleness/swap timings
+# the ``cdn-staleness-high`` doctor rule reads.
+
+CDN_PUBLISHES_TOTAL = "cdn_publishes_total"
+CDN_ANNOUNCE_BYTES_TOTAL = "cdn_announce_bytes_total"
+CDN_UPDATES_APPLIED_TOTAL = "cdn_updates_applied_total"
+CDN_PULL_BYTES_TOTAL = "cdn_pull_bytes_total"
+CDN_CHUNKS_HELD_TOTAL = "cdn_chunks_held_total"
+CDN_STALENESS_SECONDS = "cdn_staleness_seconds"
+CDN_SWAP_SECONDS = "cdn_swap_seconds"
+
 # -- run-level goodput (telemetry/goodput.py) --------------------------------
 #
 # Gauges refreshed from the run ledger after every committed manager
@@ -220,6 +236,12 @@ SPAN_BARRIER_DEPART = "barrier:depart"
 # fanout.py: one owner-table exchange round (needs gather + window
 # publication + peer consumption) under a restore round's nonce prefix.
 SPAN_FANOUT_EXCHANGE = "fanout:exchange"
+
+# cdn/ — the publish announce, one subscriber chunk-sync round (diff +
+# owner fetch + peer pulls), and the staged-buffers-to-live hot swap.
+SPAN_CDN_PUBLISH = "cdn:publish"
+SPAN_CDN_SYNC = "cdn:sync"
+SPAN_CDN_SWAP = "cdn:swap"
 
 # utils/rss_profiler.py: a new peak RSS delta was observed
 INSTANT_RSS_PEAK = "rss:peak"
@@ -326,6 +348,12 @@ RULE_DEDUP_INEFFECTIVE = "dedup-ineffective"
 # not — but the medium is rotting either way; audit the tier named by
 # the evidence (docs/chaos.md).
 RULE_STORAGE_CORRUPTION = "storage-corruption"
+# The serving fleet is falling behind the publisher: the median
+# publish-to-swap latency across the ledger's cdn-swapped records
+# exceeds the knob'd staleness budget
+# (TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS). Cites the ledger's
+# publish/swap events and the per-subscriber staleness spread.
+RULE_CDN_STALENESS_HIGH = "cdn-staleness-high"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).
@@ -368,6 +396,15 @@ EVENT_GC_RECLAIMED = "gc-reclaimed"
 # ``chunks/.quarantine/``). The ``storage-corruption`` doctor rule
 # cites these records; fields carry the location, action and tiers.
 EVENT_REPAIR_PERFORMED = "repair-performed"
+# The manager's post-commit CDN hook announced a step to a topic:
+# carries the topic, sequence number, manifest digest and the announced
+# chunk-set accounting (the publish half the ``cdn-staleness-high``
+# rule correlates swaps against).
+EVENT_CDN_PUBLISHED = "cdn-published"
+# A subscriber hot-swapped an announced step into its serving buffers:
+# carries the subscriber id, step, publish-to-swap staleness and the
+# bytes-on-wire split (durable read vs. peer pull vs. already held).
+EVENT_CDN_SWAPPED = "cdn-swapped"
 
 # ---------------------------------------------------------------------------
 # Crash-point ids (chaos/crashpoints.py).
@@ -418,3 +455,11 @@ CRASH_GC_UNPINNED = "gc-unpinned"
 # Step GC deleted a dropped step's commit marker; its data blobs (and
 # telemetry leftovers) are still on disk.
 CRASH_GC_MARKER_DELETED = "gc-marker-deleted"
+# The CDN publisher wrote the announce record for a step but has NOT
+# advanced the topic head yet (torn announce: subscribers must never
+# observe the record).
+CRASH_CDN_PUBLISH_ANNOUNCED = "cdn-publish-announced"
+# A CDN subscriber finished staging an announced step's chunks into its
+# shadow buffers; the hot swap has not happened (the live weights must
+# still be the previous step's).
+CRASH_CDN_SWAP_STAGED = "cdn-swap-staged"

@@ -148,6 +148,14 @@ class SnapshotReport:
     # autotuner is on — a history row / doctor --trend regression can
     # then always be correlated with the knob change that caused it.
     tunables: Optional[Dict[str, Any]] = None
+    # Restores only (None elsewhere): the cold-start envelope — time
+    # spent before the first storage byte moved, attributed to its
+    # causes (``{"plugin_open_s": s, "event_loop_s": s,
+    # "native_load_s": s}``), and the total. A first-trial restore that
+    # is 10-30x slower than warm trials convicts itself here instead of
+    # leaving the gap a guess (the cold_restore bench's soft spot).
+    cold_start_s: Optional[float] = None
+    cold_start: Optional[Dict[str, float]] = None
     # Multi-rank ops only (None when the op issued no coordination
     # traffic): the coordination split over the op's window —
     # ``{store_ops, store_s, barrier_wait_s, exchange_s, endpoint_s}``
@@ -338,6 +346,16 @@ def build_report(
         degraded_reads=(
             {k: int(v) for k, v in pipeline["degraded_reads"].items()}
             if pipeline.get("degraded_reads")
+            else None
+        ),
+        cold_start_s=(
+            float(pipeline["cold_start_s"])
+            if pipeline.get("cold_start_s") is not None
+            else None
+        ),
+        cold_start=(
+            {k: round(float(v), 6) for k, v in pipeline["cold_start"].items()}
+            if pipeline.get("cold_start")
             else None
         ),
         tunables=dict(tunables) if tunables is not None else None,

@@ -377,6 +377,11 @@ class PeerCache:
 class _PeerServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # The stock backlog of 5 drops connects when a fleet syncs at once
+    # (every non-owner dials the owner within the same announce poll
+    # interval); the kernel's SYN retransmit then stalls the dropped
+    # dialers for whole seconds. Queue a fleet's worth instead.
+    request_queue_size = 128
 
     def __init__(self, addr, cache: PeerCache) -> None:
         super().__init__(addr, _PeerRequestHandler)

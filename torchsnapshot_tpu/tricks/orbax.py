@@ -25,6 +25,43 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 
+def _run_outside_event_loop(fn):
+    """Run ``fn`` off any running asyncio loop. Orbax's
+    ``CheckpointManager``/``AsyncCheckpointer`` invoke handler
+    save/restore from inside ``asyncio.run``; the snapshot pipeline
+    drives its own event loop with ``run_until_complete``, which
+    asyncio forbids while another loop runs on the thread. A fresh
+    thread has no running loop, so the pipeline keeps its
+    single-ownership loop semantics and the caller's loop is never
+    touched."""
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return fn()  # no loop on this thread: the common sync path
+
+    import threading
+
+    result: list = []
+    error: list = []
+
+    def target() -> None:
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            error.append(e)
+
+    thread = threading.Thread(
+        target=target, name="ts-orbax-handler", daemon=True
+    )
+    thread.start()
+    thread.join()
+    if error:
+        raise error[0]
+    return result[0]
+
+
 def _import_orbax():
     try:
         import orbax.checkpoint as ocp
@@ -87,8 +124,12 @@ def _build_handler_classes() -> Tuple[Any, Any, Any]:
         def save(self, directory, *args, **kwargs) -> None:
             ckpt_args = kwargs.get("args") or (args[0] if args else None)
             item = getattr(ckpt_args, "item", ckpt_args)
-            Snapshot.take(
-                str(directory), {self._key: PyTreeState(item)}, pg=self._pg
+            _run_outside_event_loop(
+                lambda: Snapshot.take(
+                    str(directory),
+                    {self._key: PyTreeState(item)},
+                    pg=self._pg,
+                )
             )
 
         def restore(self, directory, *args, **kwargs) -> Any:
@@ -97,7 +138,9 @@ def _build_handler_classes() -> Tuple[Any, Any, Any]:
             snap = Snapshot(str(directory), pg=self._pg)
             if template is None:
                 raw = _RawState()
-                snap.restore({self._key: raw})
+                _run_outside_event_loop(
+                    lambda: snap.restore({self._key: raw})
+                )
                 if raw.value is None:
                     # Nothing under this key: a key mismatch or a non-
                     # snapshot directory must fail AT the checkpoint
@@ -109,7 +152,9 @@ def _build_handler_classes() -> Tuple[Any, Any, Any]:
                     )
                 return raw.value
             stateful = PyTreeState(template)
-            snap.restore({self._key: stateful})
+            _run_outside_event_loop(
+                lambda: snap.restore({self._key: stateful})
+            )
             return stateful.tree
 
         def metadata(self, directory) -> Optional[Any]:
