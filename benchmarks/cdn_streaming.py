@@ -84,14 +84,23 @@ def main() -> None:
         "dedup_ratio": round(r.dedup_ratio, 4),
         "peer_fallbacks": r.peer_fallbacks,
         "errors": len(r.errors),
+        # Wire split: per-tier pull-latency quantiles pooled across the
+        # fleet, and the per-op frame/byte/RPC report split.
+        "pull_latency": r.pull_latency,
+        "wire": r.wire,
     }
+    pulls = " ".join(
+        f"{tier} p50/p95 {t['p50_s']}/{t['p95_s']}s"
+        for tier, t in sorted(r.pull_latency.items())
+    )
     log(
         f"cdn-streaming: {r.converged_subscribers}/{cfg.fleet_size} "
         f"subscribers converged over {cfg.steps} steps; staleness "
         f"med/p90/max {r.staleness_median_s}/{r.staleness_p90_s}/"
         f"{r.staleness_max_s}s; read amplification "
         f"{out['read_amplification']}x; dedup {out['dedup_ratio']} "
-        f"(wire {r.bytes_on_wire} of {r.bytes_in_steps} logical)"
+        f"(wire {r.bytes_on_wire} of {r.bytes_in_steps} logical); "
+        f"pulls {pulls or 'none'}"
     )
     print(json.dumps(out), flush=True)
 

@@ -81,6 +81,10 @@ def _recover_worker(pg, root, mib, enabled):
         "tier_split": report.tier_split if report else None,
         "peer": report.peer if report else None,
         "bytes_moved": report.bytes_moved if report else None,
+        # The restore's wire split (frames/bytes/dial+RPC time, per-op
+        # table): None when the run put nothing on a socket (peer tier
+        # kill-switched = storage-only restore).
+        "wire": report.wire if report else None,
     }
 
 
@@ -116,9 +120,17 @@ def main() -> None:
         out[f"{key}_replacement_tier_split"] = replacement.get(
             "tier_split"
         )
+        # Wire split of the replacement's restore: bytes that rode
+        # sockets, dial + RPC wall, and the per-op table — the "how
+        # much of recovery was wire time" half of the tier split.
+        out[f"{key}_replacement_wire"] = replacement.get("wire")
+        wire = replacement.get("wire") or {}
         log(
             f"peer-restore[{key}]: replacement restored in "
-            f"{replacement['restore_s']}s, world tier split {split}"
+            f"{replacement['restore_s']}s, world tier split {split}, "
+            f"wire {wire.get('bytes', 0)} B in {wire.get('rpcs', 0)} "
+            f"rpcs ({wire.get('rpc_s', 0)}s rpc + "
+            f"{wire.get('dial_s', 0)}s dial)"
         )
     if args.json:
         print(json.dumps(out, separators=(",", ":")), flush=True)

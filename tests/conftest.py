@@ -89,6 +89,13 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_CAS", "0")
 # setting TORCHSNAPSHOT_TPU_CDN=1 around the manager hook under test.
 os.environ.setdefault("TORCHSNAPSHOT_TPU_CDN", "0")
 
+# The fleet metrics plane is pinned off in the suite ("0"; also the
+# packaged default): tier-1 distributed tests assert about exact store
+# traffic and must not see __obs/ publish writes. Fleet-plane tests
+# opt back in via knobs.enable_fleet_obs() or an env override in their
+# multiprocess workers.
+os.environ.setdefault("TORCHSNAPSHOT_TPU_FLEET_OBS", "0")
+
 if os.environ.get("TS_TEST_ON_TPU") != "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")

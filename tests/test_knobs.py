@@ -342,6 +342,22 @@ def test_cdn_knobs() -> None:
         del os.environ["TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"]
 
 
+def test_fleet_obs_knob() -> None:
+    """Suite default (conftest) AND packaged default are off: the
+    __obs/ metrics plane must be an explicit opt-in — no publish
+    traffic rides the coordination store unless asked for."""
+    assert not knobs.is_fleet_obs_enabled()  # conftest pin
+    with knobs.enable_fleet_obs():
+        assert knobs.is_fleet_obs_enabled()
+    assert not knobs.is_fleet_obs_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_FLEET_OBS", None)
+    try:
+        assert not knobs.is_fleet_obs_enabled()  # packaged default: off
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_FLEET_OBS"] = prev
+
+
 def test_history_max_records_knob() -> None:
     assert knobs.get_history_max_records() == 0  # conftest zeroes it
     with knobs.override_history_max_records(7):

@@ -562,6 +562,71 @@ def test_doctor_rule_ids_requires_declarations(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# rpc-op-ids
+# ---------------------------------------------------------------------------
+
+_RPC_NAMES_BAD = """
+RPC_FOO = "Not_Kebab"
+RPC_FOO_AGAIN = "Not_Kebab"
+"""
+
+_RPC_NAMES_FIXED = """
+RPC_FOO = "peer-pull"
+"""
+
+_RPC_EMIT_BAD = """
+from torchsnapshot_tpu.telemetry import wire
+
+def pull(client):
+    with wire.propagate("literal-op"):
+        client.request("another-literal", "step_7")
+    wire.observe_rpc("peer", "third-literal", 0.5)
+"""
+
+_RPC_EMIT_FIXED = """
+from torchsnapshot_tpu.telemetry import names, wire
+
+def pull(client):
+    with wire.propagate(names.RPC_FOO):
+        client.request(names.RPC_FOO, "step_7")
+    wire.observe_rpc("peer", names.RPC_FOO, 0.5)
+"""
+
+
+def test_rpc_op_ids_detects_and_accepts_fix(tmp_path):
+    emitter = _doctor_layout(tmp_path, _RPC_NAMES_BAD, _RPC_EMIT_BAD)
+    analyzer = Analyzer(root=tmp_path, select=["rpc-op-ids"])
+    bad = analyzer.run([emitter], baseline=None)
+    msgs = _messages(bad)
+    assert any("kebab-case" in m for m in msgs)
+    assert any("registered twice" in m for m in msgs)
+    assert any("'literal-op'" in m and "propagate" in m for m in msgs)
+    assert any("'another-literal'" in m and "request" in m for m in msgs)
+    assert any("'third-literal'" in m and "observe_rpc" in m for m in msgs)
+
+    emitter = _doctor_layout(tmp_path, _RPC_NAMES_FIXED, _RPC_EMIT_FIXED)
+    analyzer = Analyzer(root=tmp_path, select=["rpc-op-ids"])
+    fixed = analyzer.run([emitter], baseline=None)
+    assert fixed.new_findings == []
+
+
+def test_rpc_op_ids_requires_declarations(tmp_path):
+    """An empty RPC_ registry is itself a finding: the on-the-wire op
+    namespace must be catalogued before anything propagates one."""
+    emitter = _doctor_layout(tmp_path, "X = 1\n", "def noop():\n    pass\n")
+    analyzer = Analyzer(root=tmp_path, select=["rpc-op-ids"])
+    result = analyzer.run([emitter], baseline=None)
+    assert any("no rpc op ids declared" in m for m in _messages(result))
+
+
+def test_rpc_op_ids_clean_on_head():
+    """The package's own frame-send sites all cite RPC_ constants."""
+    analyzer = Analyzer(root=REPO, select=["rpc-op-ids"])
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == []
+
+
+# ---------------------------------------------------------------------------
 # ledger-event-ids
 # ---------------------------------------------------------------------------
 
@@ -963,6 +1028,7 @@ def test_cli_json_output_and_rule_listing():
         "doctor-rule-ids",
         "ledger-event-ids",
         "crashpoint-ids",
+        "rpc-op-ids",
         "tiered-test-markers",
         "native-decl-sync",
     ):

@@ -351,6 +351,31 @@ def test_merge_applies_clock_offsets(tmp_path):
     assert rank_min_ts(aligned, 1) == rank_min_ts(aligned, 0)
 
 
+def test_merge_degrades_when_a_rank_has_no_clock_offset(tmp_path, caplog):
+    """A rank missing from the offsets map (older schema, or it never
+    reached the gather) merges UNCORRECTED with a warning and an
+    ``unaligned_ranks`` flag — never a failed merge or a dropped pid."""
+    p0 = _fake_rank_trace(tmp_path, 0, 1_000_000, 500)
+    p1 = _fake_rank_trace(tmp_path, 1, 1_500_000, 500)
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.telemetry.trace"):
+        merged = merge_traces([p0, p1], {0: 0.0})
+    validate_chrome(merged)
+    assert merged["otherData"]["unaligned_ranks"] == [1]
+    assert any("no clock offset" in r.message for r in caplog.records)
+
+    def rank_min_ts(doc, pid):
+        return min(
+            e["ts"]
+            for e in doc["traceEvents"]
+            if e["ph"] == "B" and e["pid"] == pid
+        )
+
+    # Rank 1's events are present and verbatim (unshifted), not dropped.
+    pids = {e["pid"] for e in merged["traceEvents"] if e["ph"] != "M"}
+    assert pids == {0, 1}
+    assert rank_min_ts(merged, 1) - rank_min_ts(merged, 0) == 500_000
+
+
 def test_merge_cli_end_to_end(tmp_path, capsys):
     """The acceptance path: python -m torchsnapshot_tpu.telemetry trace
     <dir> merges per-rank files, writes well-formed JSON, and renders a

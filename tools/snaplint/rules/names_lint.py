@@ -47,6 +47,15 @@ _DOCTOR_CALLABLES = {"doctor_rule", "Verdict"}
 # id as their SECOND positional argument (the root/snapshot path comes
 # first) or as the ``event=`` keyword.
 _LEDGER_CALLABLES = {"post_event", "post_event_for_snapshot"}
+# Wire RPC op-id surfaces (telemetry/wire.py, tiered/peer.py): the
+# context propagator and the peer client's request dispatcher take the
+# declared op id first; ``observe_rpc`` takes it SECOND (the endpoint
+# comes first) or as the ``op=`` keyword. A literal id at any of them
+# means the on-the-wire op namespace — what stitched traces and
+# per-op report splits key off — can drift from the names.py registry.
+_RPC_FIRST_ARG_CALLABLES = {"propagate", "request"}
+_RPC_SECOND_ARG_CALLABLES = {"observe_rpc"}
+_RPC_PREFIX = "RPC_"
 # Crash-point surfaces (chaos/crashpoints.py): the kill-point hook and
 # the single-point arming helper both take the declared id first — the
 # ``_crashpoint`` spelling covers the lazy-import aliases the
@@ -73,16 +82,17 @@ def check_metric_names_file(
     include_rule_decls: bool = True,
     include_event_decls: bool = True,
     include_crash_decls: bool = True,
+    include_rpc_decls: bool = True,
 ) -> List[str]:
     """Errors in the declaration file: malformed values (snake_case for
     metrics, colon-case for SPAN_/INSTANT_ trace names, kebab-case for
-    RULE_ doctor-verdict ids, EVENT_ ledger events and CRASH_ crash
-    points), duplicate constants, duplicate values. The
+    RULE_ doctor-verdict ids, EVENT_ ledger events, CRASH_ crash points
+    and RPC_ wire op ids), duplicate constants, duplicate values. The
     ``include_*_decls=False`` flags leave the SPAN_/INSTANT_, RULE_,
-    EVENT_ and CRASH_ checks to the span / doctor / ledger / crashpoint
-    rules (the unified registry runs all five; each defect should
-    report once — with the flag off, those constants are skipped here
-    entirely)."""
+    EVENT_, CRASH_ and RPC_ checks to the span / doctor / ledger /
+    crashpoint / rpc rules (the unified registry runs all six; each
+    defect should report once — with the flag off, those constants are
+    skipped here entirely)."""
     errors = []
     if not path.exists():
         return [f"{path.name}: missing (metric names must be declared here)"]
@@ -104,6 +114,8 @@ def check_metric_names_file(
             if not include_crash_decls and target.id.startswith(
                 _CRASH_PREFIX
             ):
+                continue
+            if not include_rpc_decls and target.id.startswith(_RPC_PREFIX):
                 continue
             if not include_span_decls and target.id.startswith(
                 _SPAN_PREFIXES
@@ -145,6 +157,13 @@ def check_metric_names_file(
                         f"{path.name}:{node.lineno}: {value!r} is not "
                         f"kebab-case (crash-point ids look like "
                         f"'what-just-became-durable')"
+                    )
+            elif target.id.startswith(_RPC_PREFIX):
+                if not _KEBAB_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"kebab-case (wire RPC op ids look like "
+                        f"'layer-operation')"
                     )
             elif not _SNAKE_CASE.match(value):
                 errors.append(
@@ -288,6 +307,21 @@ def check_crashpoint_ids_file(path: Path) -> List[str]:
     )
 
 
+def check_rpc_op_ids_file(path: Path) -> List[str]:
+    """Errors in the declaration file's wire RPC op registry: no RPC_
+    constants at all, non-kebab-case values, duplicate
+    constants/values."""
+    return _scan_prefixed_decls(
+        path,
+        (_RPC_PREFIX,),
+        _KEBAB_CASE,
+        "kebab-case ('layer-operation')",
+        "rpc op",
+        "rpc op ids",
+        "no rpc op ids declared",
+    )
+
+
 # ---------------------------------------------------------------------------
 # call-site checks: ONE tree-level implementation
 # ---------------------------------------------------------------------------
@@ -402,6 +436,37 @@ def _iter_crashpoint_literal_sites(
             candidates.append(node.args[0])
         for kw in node.keywords:
             if kw.arg == "name":
+                candidates.append(kw.value)
+        for cand in candidates:
+            if isinstance(cand, ast.Constant) and isinstance(
+                cand.value, str
+            ):
+                yield node.lineno, called, cand.value
+
+
+def _iter_rpc_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, callable, literal) for string-literal op ids at wire
+    RPC sites: the first positional arg of ``propagate(...)`` /
+    ``<client>.request(...)``, the second positional of
+    ``observe_rpc(endpoint, op, ...)``, or the ``op=`` keyword of
+    either."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        called = _called_name(node.func)
+        candidates = []
+        if called in _RPC_FIRST_ARG_CALLABLES:
+            if node.args:
+                candidates.append(node.args[0])
+        elif called in _RPC_SECOND_ARG_CALLABLES:
+            if len(node.args) >= 2:
+                candidates.append(node.args[1])
+        else:
+            continue
+        for kw in node.keywords:
+            if kw.arg == "op":
                 candidates.append(kw.value)
         for cand in candidates:
             if isinstance(cand, ast.Constant) and isinstance(
@@ -538,6 +603,7 @@ class MetricNameLiteral(Rule):
                 include_rule_decls=False,
                 include_event_decls=False,
                 include_crash_decls=False,
+                include_rpc_decls=False,
             ),
             project,
         )
@@ -650,6 +716,37 @@ class CrashpointIds(Rule):
                         f"literal crash-point id {literal!r} in "
                         f"{called}() — use a telemetry/names.py CRASH_ "
                         f"constant"
+                    ),
+                )
+
+
+@register
+class RpcOpIds(Rule):
+    name = "rpc-op-ids"
+    description = (
+        "wire RPC op ids: kebab-case, declared exactly once in "
+        "telemetry/names.py (RPC_ constants), no literal op strings at "
+        "propagate/request/observe_rpc frame-send sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return
+        yield from _decl_findings(
+            self.name, check_rpc_op_ids_file(names_file), project
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath == NAMES_RELPATH:
+                continue
+            for lineno, called, literal in _iter_rpc_literal_sites(tree):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal rpc op id {literal!r} in {called}() — "
+                        f"use a telemetry/names.py RPC_ constant"
                     ),
                 )
 

@@ -24,6 +24,7 @@ from ..chaos import crashpoint
 from ..dist_store import Store
 from ..telemetry import ledger
 from ..telemetry import names as metric_names
+from ..telemetry import wire
 from ..telemetry.trace import get_recorder as _trace_recorder
 from .topic import Announce, announce_key, head_key, manifest_digest, read_head
 
@@ -51,6 +52,13 @@ class CdnPublisher:
         # Cache the head locally: the publisher is the topic's single
         # writer, so after the first read it alone knows the tip.
         self._seq: Optional[int] = None
+        from .. import knobs
+
+        self._fleet: Optional[wire.FleetReporter] = None
+        if knobs.is_fleet_obs_enabled():
+            self._fleet = wire.FleetReporter(
+                store, "publisher", publisher_id or topic
+            )
 
     @property
     def last_seq(self) -> int:
@@ -74,7 +82,9 @@ class CdnPublisher:
         )
         encoded = ann.encode()
         try:
-            with _trace_recorder().span(
+            with wire.propagate(
+                metric_names.RPC_CDN_PUBLISH
+            ), _trace_recorder().span(
                 metric_names.SPAN_CDN_PUBLISH, topic=self.topic, step=int(step)
             ):
                 # Announce-record-first, head-bump-second: the head is
@@ -98,6 +108,14 @@ class CdnPublisher:
         registry.counter_inc(
             metric_names.CDN_ANNOUNCE_BYTES_TOTAL, float(len(encoded))
         )
+        if self._fleet is not None:
+            try:
+                self._fleet.publish(
+                    phase=f"published:{int(step)}",
+                    extra={"seq": seq, "chunks": len(chunks)},
+                )
+            except Exception:  # noqa: BLE001 - observability never blocks
+                pass
         if self._root is not None:
             ledger.post_event(
                 self._root,
@@ -110,3 +128,12 @@ class CdnPublisher:
                 published_ts=round(ann.published_ts, 6),
             )
         return ann
+
+    def close(self) -> None:
+        """Reap this publisher's fleet-plane snapshot (if any)."""
+        if self._fleet is not None:
+            try:
+                self._fleet.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._fleet = None

@@ -42,6 +42,7 @@ from ..dist_store import (
 from ..resharding import assign_shard_owners
 from ..telemetry import ledger
 from ..telemetry import names as metric_names
+from ..telemetry import wire
 from ..telemetry.trace import get_recorder as _trace_recorder
 from ..tiered.peer import PeerCache, PeerClient, PeerTransferError, _PeerServer
 from .topic import CDN_SERVICE, Announce, read_announce, read_head, verify_chunk_bytes
@@ -57,6 +58,12 @@ class CdnSyncError(RuntimeError):
     """A chunk could not be obtained from any tier (peer AND durable)."""
 
 
+# Per-tier pull-latency samples retained per subscriber (newest kept):
+# enough for a stable p95 without letting a long-lived subscriber grow
+# an unbounded float list.
+_PULL_LATENCY_SAMPLES = 4096
+
+
 @dataclasses.dataclass
 class SubscriberStats:
     """Per-subscriber byte/chunk split by serving tier, plus staleness
@@ -70,10 +77,22 @@ class SubscriberStats:
     bytes_from_durable: int = 0
     peer_fallbacks: int = 0
     staleness_s: List[float] = dataclasses.field(default_factory=list)
+    # tier ("peer" | "durable") -> pull wall-clock samples in seconds
+    # (the peer samples include pacer retries — the latency the serving
+    # process actually saw, not just the winning attempt).
+    pull_latency_s: Dict[str, List[float]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def bytes_on_wire(self) -> int:
         return self.bytes_from_peer + self.bytes_from_durable
+
+    def observe_pull(self, tier: str, seconds: float) -> None:
+        samples = self.pull_latency_s.setdefault(tier, [])
+        samples.append(seconds)
+        if len(samples) > _PULL_LATENCY_SAMPLES:
+            del samples[: len(samples) - _PULL_LATENCY_SAMPLES]
 
 
 class CdnSubscriber:
@@ -135,6 +154,16 @@ class CdnSubscriber:
         publish_endpoint(
             store, CDN_SERVICE, self.subscriber_id, self.host, self.port
         )
+        from .. import knobs
+
+        self._fleet: Optional[wire.FleetReporter] = None
+        if knobs.is_fleet_obs_enabled():
+            self._fleet = wire.FleetReporter(
+                store,
+                "subscriber",
+                str(self.subscriber_id),
+                world=self.fleet_size,
+            )
 
     # -- topic tracking --------------------------------------------------
 
@@ -189,9 +218,11 @@ class CdnSubscriber:
             raise CdnSyncError(
                 f"chunk {key}: no peer copy and no durable_fetch configured"
             )
+        t0 = time.monotonic()
         data = self._durable_fetch(key)
         if not verify_chunk_bytes(key, data):
             raise CdnSyncError(f"chunk {key}: durable copy fails digest")
+        self.stats.observe_pull("durable", time.monotonic() - t0)
         self.stats.chunks_from_durable += 1
         self.stats.bytes_from_durable += len(data)
         telemetry.metrics().counter_inc(
@@ -207,7 +238,8 @@ class CdnSubscriber:
         caller falls back to durable)."""
         from .. import knobs
 
-        deadline = time.monotonic() + knobs.get_cdn_pull_timeout_seconds()
+        t0 = time.monotonic()
+        deadline = t0 + knobs.get_cdn_pull_timeout_seconds()
         path = chunk_location(key)
         pacer = _PollPacer(cap=scaled_poll_cap(self.fleet_size))
         while True:
@@ -220,6 +252,9 @@ class CdnSubscriber:
                 if found is not None:
                     data = found[1]
                     if verify_chunk_bytes(key, data):
+                        self.stats.observe_pull(
+                            "peer", time.monotonic() - t0
+                        )
                         self.stats.chunks_from_peer += 1
                         self.stats.bytes_from_peer += len(data)
                         telemetry.metrics().counter_inc(
@@ -246,7 +281,11 @@ class CdnSubscriber:
         owners = assign_shard_owners(
             (chunk_location(k) for k in wanted), self.fleet_size
         )
-        with _trace_recorder().span(
+        # One wire context for the whole sync round: every peer pull
+        # (and the durable fallback's store frames) nests under a
+        # single trace id, so the merged trace shows the round as one
+        # causally-linked tree instead of unrelated per-chunk RPCs.
+        with wire.propagate(metric_names.RPC_CDN_SYNC), _trace_recorder().span(
             metric_names.SPAN_CDN_SYNC,
             topic=self.topic,
             seq=ann.seq,
@@ -310,6 +349,18 @@ class CdnSubscriber:
             metric_names.CDN_STALENESS_SECONDS, staleness
         )
         registry.histogram_observe(metric_names.CDN_SWAP_SECONDS, swap_s)
+        if self._fleet is not None:
+            try:
+                self._fleet.publish(
+                    phase=f"serving:{ann.step}",
+                    written_bytes=self.stats.bytes_on_wire,
+                    extra={
+                        "seq": ann.seq,
+                        "staleness_s": round(staleness, 3),
+                    },
+                )
+            except Exception:  # noqa: BLE001 - observability never blocks
+                pass
         self._lease_held()
         if self._root is not None:
             ledger.post_event(
@@ -360,6 +411,12 @@ class CdnSubscriber:
             )
 
     def close(self, release_lease: bool = True) -> None:
+        if self._fleet is not None:
+            try:
+                self._fleet.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._fleet = None
         if release_lease and self._cas_store is not None:
             try:
                 self._cas_store.unlease(self.lease_id)

@@ -160,6 +160,22 @@ def _observe_store_requests(op: str, seconds: float, requests: int = 1) -> None:
         pass
 
 
+_WIRE_MODULE = None
+
+
+def _wire():
+    """telemetry.wire lazily resolved (same discipline as
+    :func:`_tele_modules`): the wire observatory instruments this
+    module's framing layer, but dist_store must stay importable below
+    the telemetry package."""
+    global _WIRE_MODULE
+    if _WIRE_MODULE is None:
+        from .telemetry import wire as _wire_mod
+
+        _WIRE_MODULE = _wire_mod
+    return _WIRE_MODULE
+
+
 @dataclass
 class ProcessGroup:
     """What :class:`~torchsnapshot_tpu.pg_wrapper.PGWrapper` consumes: a
@@ -254,6 +270,15 @@ class Store(abc.ABC):
     def multi_delete(self, keys: Iterable[str]) -> None:
         for key in keys:
             self.delete(key)
+
+    def scan(self, prefix: str) -> List[str]:
+        """All present keys starting with ``prefix`` (sorted). Registry
+        consumers only (the fleet plane enumerating ``__obs/``) — not
+        every backing store can enumerate, so the default refuses
+        rather than silently returning nothing."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support prefix scans"
+        )
 
     # -- blocking helpers -------------------------------------------------
 
@@ -460,6 +485,10 @@ _CMD_SET, _CMD_TRY_GET, _CMD_ADD, _CMD_DELETE = 0, 1, 2, 3
 # key->value dict (multi_set) or key list (multi_get / multi_delete);
 # the scalar ``key`` slot of the request tuple is unused ("").
 _CMD_MULTI_SET, _CMD_MULTI_GET, _CMD_MULTI_DELETE = 4, 5, 6
+# Prefix scan (key enumeration): the fleet metrics plane's reader
+# (telemetry/wire.py collect_fleet) discovers `__obs/` publishers with
+# it. ``key`` carries the prefix; arg is unused.
+_CMD_SCAN = 7
 
 _CMD_OP_NAMES = {
     _CMD_SET: "set",
@@ -469,7 +498,24 @@ _CMD_OP_NAMES = {
     _CMD_MULTI_SET: "multi_set",
     _CMD_MULTI_GET: "multi_get",
     _CMD_MULTI_DELETE: "multi_delete",
+    _CMD_SCAN: "scan",
 }
+
+
+def _store_rpc_ids():
+    """cmd int -> declared RPC op id (names.RPC_STORE_*), resolved
+    lazily so the registry stays the single source of op-id strings."""
+    _, n, _ = _tele_modules()
+    return {
+        _CMD_SET: n.RPC_STORE_SET,
+        _CMD_TRY_GET: n.RPC_STORE_TRY_GET,
+        _CMD_ADD: n.RPC_STORE_ADD,
+        _CMD_DELETE: n.RPC_STORE_DELETE,
+        _CMD_MULTI_SET: n.RPC_STORE_MULTI_SET,
+        _CMD_MULTI_GET: n.RPC_STORE_MULTI_GET,
+        _CMD_MULTI_DELETE: n.RPC_STORE_MULTI_DELETE,
+        _CMD_SCAN: n.RPC_STORE_SCAN,
+    }
 
 
 # Chaos-engineering seam (chaos/engine.py install_wire_chaos): when
@@ -479,25 +525,54 @@ _CMD_OP_NAMES = {
 _WIRE_CHAOS = None
 
 
-def send_frame(sock: socket.socket, payload: bytes) -> None:
+def send_frame(
+    sock: socket.socket, payload: bytes, endpoint: str = "store"
+) -> None:
     """Length-prefixed frame write — the one wire framing shared by the
     TCP store and the peer-tier transport (tiered/peer.py), so the two
-    socket protocols cannot drift in how they delimit messages."""
+    socket protocols cannot drift in how they delimit messages.
+
+    Wire observatory (telemetry/wire.py): when the sending thread has
+    an active :func:`~torchsnapshot_tpu.telemetry.wire.propagate`
+    context, the payload is prefixed with the compact trace header
+    BEFORE the chaos hook sees it — chaos corrupts the header exactly
+    like real wire damage would, and the receiver degrades it to a
+    context-free frame. Frame/byte counts land per ``endpoint``."""
+    try:
+        w = _wire()
+        ctx = w.current_context()
+        if ctx is not None:
+            payload = w.encode_frame(ctx, payload)
+    except Exception:  # noqa: BLE001 - observability never breaks the wire
+        pass
     hook = _WIRE_CHAOS
     if hook is not None:
         payload = hook("wire-send", payload)
         if payload is None:
             return  # dropped frame: the receiver waits it out
+    try:
+        _wire().observe_frame(endpoint, "send", len(payload) + 4)
+    except Exception:  # noqa: BLE001 - observability never breaks the wire
+        pass
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket, endpoint: str = "store") -> bytes:
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack("<I", header)
     payload = _recv_exact(sock, length)
     hook = _WIRE_CHAOS
     if hook is not None:
         payload = hook("wire-recv", payload)
+    try:
+        w = _wire()
+        w.observe_frame(endpoint, "recv", len(payload) + 4)
+        ctx, payload = w.decode_frame(payload)
+        # Stash (or clear) the inbound context so the handler that
+        # processes this frame can link its span to the sender's.
+        w.set_received_context(ctx)
+    except Exception:  # noqa: BLE001 - observability never breaks the wire
+        pass
     return payload
 
 
@@ -529,11 +604,23 @@ class _StoreServer(socketserver.ThreadingTCPServer):
         super().__init__(addr, _StoreRequestHandler)
         self.kv: Dict[str, bytes] = {}
         self.kv_lock = threading.Lock()
+        # Concurrent-handler count: the wire observatory's userspace
+        # proxy for accept pressure (the kernel accept queue itself is
+        # not portably readable).
+        self.active_handlers = 0
+        self.active_lock = threading.Lock()
 
 
 class _StoreRequestHandler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         server: _StoreServer = self.server  # type: ignore[assignment]
+        with server.active_lock:
+            server.active_handlers += 1
+            depth = server.active_handlers
+        try:
+            _wire().observe_accept_depth("store", depth)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
         try:
             while True:
                 msg = pickle.loads(_recv_msg(self.request))
@@ -560,11 +647,18 @@ class _StoreRequestHandler(socketserver.BaseRequestHandler):
                         for k in arg:
                             server.kv.pop(k, None)
                         reply = None
+                    elif cmd == _CMD_SCAN:
+                        reply = sorted(
+                            k for k in server.kv if k.startswith(key)
+                        )
                     else:  # pragma: no cover
                         raise ValueError(f"bad store command {cmd}")
                 _send_msg(self.request, pickle.dumps(reply))
         except (ConnectionError, EOFError):
             return
+        finally:
+            with server.active_lock:
+                server.active_handlers -= 1
 
 
 class TCPStore(Store):
@@ -604,10 +698,20 @@ class TCPStore(Store):
                 # and the deadline below never gets a chance to fire.
                 remaining = deadline - time.monotonic()
                 try:
+                    t_dial = time.monotonic()
                     sock = socket.create_connection(
                         (self.host, self.port),
                         timeout=max(0.05, min(5.0, remaining)),
                     )
+                    try:
+                        # Dial latency per successful attempt: a full
+                        # listen backlog shows up here as whole-second
+                        # SYN-retransmit quanta (wire-dial-stalled).
+                        _wire().observe_dial(
+                            "store", time.monotonic() - t_dial
+                        )
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
                     # Back to blocking mode: the per-attempt timeout
                     # must not leak into request/response recv calls.
                     sock.settimeout(None)
@@ -622,6 +726,10 @@ class TCPStore(Store):
                     # yet: fail fast instead of burning the deadline.
                     raise
                 except OSError as e:
+                    try:
+                        _wire().observe_dial("store", 0.0, ok=False)
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
                     # Deadline-bounded with a clear timeout error: a
                     # leader that never comes up must read as "store
                     # unreachable", not as a raw ECONNREFUSED (or a
@@ -643,9 +751,13 @@ class TCPStore(Store):
             sock = self._connect()
             _send_msg(sock, pickle.dumps((cmd, key, arg)))
             reply = pickle.loads(_recv_msg(sock))
-        _observe_store_requests(
-            _CMD_OP_NAMES.get(cmd, "other"), time.monotonic() - t0
-        )
+        elapsed = time.monotonic() - t0
+        _observe_store_requests(_CMD_OP_NAMES.get(cmd, "other"), elapsed)
+        try:
+            w = _wire()
+            w.observe_rpc("store", _store_rpc_ids()[cmd], elapsed)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
         return reply
 
     def set(self, key: str, value: bytes) -> None:
@@ -668,6 +780,9 @@ class TCPStore(Store):
 
     def multi_delete(self, keys: Iterable[str]) -> None:
         self._request(_CMD_MULTI_DELETE, "", list(keys))
+
+    def scan(self, prefix: str) -> List[str]:
+        return self._request(_CMD_SCAN, prefix)
 
     def close(self) -> None:
         with self._sock_lock:
@@ -718,6 +833,10 @@ class InProcessStore(Store):
             for k in keys:
                 self._kv.pop(k, None)
 
+    def scan(self, prefix: str) -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._kv if k.startswith(prefix))
+
 
 # ---------------------------------------------------------------------------
 # Sharded store
@@ -753,8 +872,24 @@ class ShardedStore(Store):
     def num_shards(self) -> int:
         return len(self._stores)
 
+    def _count_shard(self, shard: int, requests: int = 1) -> None:
+        """Per-shard request accounting: the skew evidence behind the
+        ``store-hot-shard`` doctor rule and the fleet snapshot's
+        ``store_shards`` split."""
+        try:
+            telemetry, n, _ = _tele_modules()
+            telemetry.metrics().counter_inc(
+                n.COORD_STORE_SHARD_REQUESTS_TOTAL,
+                float(requests),
+                shard=str(shard),
+            )
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
     def _member(self, key: str) -> Store:
-        return self._stores[shard_for_key(key, len(self._stores))]
+        shard = shard_for_key(key, len(self._stores))
+        self._count_shard(shard)
+        return self._stores[shard]
 
     def _group(self, keys: Iterable[str]) -> Dict[int, List[str]]:
         grouped: Dict[int, List[str]] = {}
@@ -762,6 +897,8 @@ class ShardedStore(Store):
             grouped.setdefault(
                 shard_for_key(key, len(self._stores)), []
             ).append(key)
+        for shard in grouped:
+            self._count_shard(shard)
         return grouped
 
     def set(self, key: str, value: bytes) -> None:
@@ -789,6 +926,12 @@ class ShardedStore(Store):
     def multi_delete(self, keys: Iterable[str]) -> None:
         for shard, shard_keys in self._group(keys).items():
             self._stores[shard].multi_delete(shard_keys)
+
+    def scan(self, prefix: str) -> List[str]:
+        out: List[str] = []
+        for member in self._stores:
+            out.extend(member.scan(prefix))
+        return sorted(set(out))
 
     def close(self) -> None:
         for member in self._stores:

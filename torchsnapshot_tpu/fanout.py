@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import knobs, telemetry
 from .dist_store import Store, StoreTimeoutError, _PollPacer, scaled_poll_cap
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO
+from .telemetry import wire as _wire
 from .telemetry.trace import get_recorder as _trace_recorder
 from .manifest import Manifest, sharded_blob_windows
 from .resharding import assign_shard_owners
@@ -209,9 +210,13 @@ class FanoutRestoreContext:
             reqs=len(read_reqs),
         )
         try:
-            return self._exchange_impl(
-                read_reqs, storage, event_loop, rendezvous_prefix, timeout
-            )
+            # One wire context for the whole round: every store frame
+            # of the needs gather and blob exchange carries the same
+            # trace id, so the merged trace shows the round as one tree.
+            with _wire.propagate(telemetry.names.RPC_FANOUT_EXCHANGE):
+                return self._exchange_impl(
+                    read_reqs, storage, event_loop, rendezvous_prefix, timeout
+                )
         finally:
             _trace_recorder().end(span)
             try:

@@ -81,6 +81,7 @@ _CDN_PULL_TIMEOUT_ENV = "TORCHSNAPSHOT_TPU_CDN_PULL_TIMEOUT_SECONDS"
 _TREE_BARRIER_ENV = "TORCHSNAPSHOT_TPU_TREE_BARRIER"
 _BARRIER_FANOUT_ENV = "TORCHSNAPSHOT_TPU_BARRIER_FANOUT"
 _STORE_SHARDS_ENV = "TORCHSNAPSHOT_TPU_STORE_SHARDS"
+_FLEET_OBS_ENV = "TORCHSNAPSHOT_TPU_FLEET_OBS"
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -585,6 +586,19 @@ def is_cdn_enabled() -> bool:
     return os.environ.get(_CDN_ENV, "0") not in ("", "0")
 
 
+def is_fleet_obs_enabled() -> bool:
+    """Fleet metrics plane (telemetry/wire.py, docs/observability.md),
+    default OFF: with ``"1"``, storm ranks, CDN publishers, and CDN
+    subscribers periodically publish compact crc-guarded wire/progress
+    snapshots under ``__obs/`` on the coordination store (world-scaled
+    pacing, reaped on clean shutdown), which ``python -m
+    torchsnapshot_tpu.telemetry fleet <target>`` renders as a live
+    per-member table. Off = no ``__obs/`` keys are ever written (the
+    test conftest pins 0 so tier-1 store traffic stays deterministic);
+    the fleet CLI still reads whatever another process published."""
+    return os.environ.get(_FLEET_OBS_ENV, "0") not in ("", "0")
+
+
 def get_cdn_staleness_budget_seconds() -> float:
     """The publish-to-swap latency budget the ``cdn-staleness-high``
     doctor rule holds the fleet to: when the median staleness across
@@ -1047,6 +1061,15 @@ def enable_cdn() -> Generator[None, None, None]:
     suite's conftest pins it off so tier-1 manager tests see no
     announce traffic; CDN tests opt back in here)."""
     with _override_env(_CDN_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def enable_fleet_obs() -> Generator[None, None, None]:
+    """Force the fleet metrics plane ON for the block (the suite's
+    conftest pins it off so tier-1 store traffic holds exactly the keys
+    the code under test wrote; fleet-plane tests opt back in here)."""
+    with _override_env(_FLEET_OBS_ENV, "1"):
         yield
 
 

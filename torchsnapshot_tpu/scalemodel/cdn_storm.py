@@ -82,6 +82,15 @@ class CdnStormResult:
     converged_subscribers: int
     peer_fallbacks: int
     errors: Dict[int, str]
+    # Wire split (telemetry/wire.py): per-tier pull-latency quantiles
+    # pooled across the fleet ({tier: {p50_s, p95_s, samples}}) and the
+    # process's per-op wire report split (frames/bytes/rpcs + per-RPC
+    # table) — what bench leg 11's RESULT line cites for "where did the
+    # bytes ride and how long did a pull take per tier".
+    pull_latency: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+    wire: Optional[Dict[str, object]] = None
 
     @property
     def dedup_ratio(self) -> float:
@@ -239,6 +248,28 @@ def run_cdn_storm(
                 min(len(staleness) - 1, int(len(staleness) * frac))
             ]
 
+        pulls_by_tier: Dict[str, List[float]] = {}
+        for sub in subs:
+            for tier, samples in sub.stats.pull_latency_s.items():
+                pulls_by_tier.setdefault(tier, []).extend(samples)
+        pull_latency: Dict[str, Dict[str, float]] = {}
+        for tier, samples in sorted(pulls_by_tier.items()):
+            samples.sort()
+
+            def tier_pct(frac: float) -> float:
+                return samples[
+                    min(len(samples) - 1, int(len(samples) * frac))
+                ]
+
+            pull_latency[tier] = {
+                "p50_s": round(tier_pct(0.5), 6),
+                "p95_s": round(tier_pct(0.95), 6),
+                "samples": len(samples),
+            }
+
+        from ..telemetry import metrics
+        from ..telemetry.report import wire_from_deltas
+
         return CdnStormResult(
             config=cfg,
             wall_s=round(wall_s, 3),
@@ -262,6 +293,11 @@ def run_cdn_storm(
             ),
             peer_fallbacks=sum(s.stats.peer_fallbacks for s in subs),
             errors=errors,
+            pull_latency=pull_latency,
+            # The whole storm shares one process registry, so the
+            # counters ARE the storm's deltas in a fresh bench process;
+            # a long-lived caller sees its own prior traffic folded in.
+            wire=wire_from_deltas(metrics().counters_snapshot()),
         )
     finally:
         for sub in subs:

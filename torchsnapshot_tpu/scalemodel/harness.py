@@ -374,6 +374,18 @@ def _rank_program(
     )
     plugin = MemoryStoragePlugin(plugin_name)
     loop = asyncio.new_event_loop()
+    # Fleet metrics plane (knob-gated, default OFF): each simulated
+    # rank publishes a bounded wire-health snapshot under __obs/ so
+    # `python -m torchsnapshot_tpu.telemetry fleet` renders a live
+    # per-rank table from a running storm.
+    from .. import knobs as _knobs
+    from ..telemetry import wire as _wire
+
+    fleet: Optional[_wire.FleetReporter] = None
+    if _knobs.is_fleet_obs_enabled():
+        fleet = _wire.FleetReporter(
+            store, "rank", str(rank), world=cfg.world_size
+        )
     try:
         if cfg.endpoint_round:
             publish_endpoint(
@@ -383,6 +395,11 @@ def _rank_program(
             if step == cfg.warmup_steps:
                 for k in list(timers):
                     timers[k] = 0.0
+            if fleet is not None:
+                try:
+                    fleet.publish(phase=f"step:{step}")
+                except Exception:  # noqa: BLE001 - never stalls the storm
+                    pass
             if cfg.save_storm:
                 # The Snapshot.take coordination skeleton: one path/nonce
                 # broadcast, the manifest gather to rank 0, the commit
@@ -451,6 +468,11 @@ def _rank_program(
                     f"{cfg.world_size} endpoints"
                 )
     finally:
+        if fleet is not None:
+            try:
+                fleet.close()
+            except Exception:  # noqa: BLE001
+                pass
         loop.close()
 
 

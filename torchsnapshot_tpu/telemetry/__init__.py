@@ -43,7 +43,17 @@ report schema, sink knobs, and CLI.
 
 from __future__ import annotations
 
-from . import doctor, goodput, history, ledger, names, progress, trace, watchdog
+from . import (
+    doctor,
+    goodput,
+    history,
+    ledger,
+    names,
+    progress,
+    trace,
+    watchdog,
+    wire,
+)
 from .registry import (
     DEFAULT_SECONDS_BUCKETS,
     MetricsRegistry,
@@ -97,6 +107,7 @@ __all__ = [
     "series_key",
     "trace",
     "watchdog",
+    "wire",
     "write_prometheus_textfile",
 ]
 

@@ -122,6 +122,21 @@ def summarize_report(
             if report.coordination is not None
             else None
         ),
+        # Wall the op spent on actual sockets (None for ops that put
+        # nothing on the wire — all-zero baselines never flag): dial
+        # time plus request/reply round-trip time from the report's
+        # wire split. The trend companion of the wire-dial-stalled /
+        # wire-hot-endpoint fleet rules — a step whose socket time
+        # creeps up (backlog stall, hot owner) flags here first.
+        "wire_s": (
+            round(
+                float(report.wire.get("dial_s", 0.0))
+                + float(report.wire.get("rpc_s", 0.0)),
+                6,
+            )
+            if report.wire is not None
+            else None
+        ),
         # Which write-path variant served the take's bytes (vectorized /
         # direct / fused / buffered): alongside ``tunables``, what lets
         # doctor --trend correlate a write-path knob flip with the
@@ -209,6 +224,9 @@ _TREND_METRICS = {
     # single-process ops — all-zero baselines never flag): the trend
     # companion of the per-op coordination-bound rule.
     "coordination_s": ("coordination time", 1),
+    # Socket wall (dial + RPC round trips; None/0 for wire-less ops):
+    # the trend companion of the wire-dial-stalled fleet rule.
+    "wire_s": ("wire time", 1),
 }
 
 

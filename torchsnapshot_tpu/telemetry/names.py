@@ -101,6 +101,38 @@ COORD_STORE_SECONDS_TOTAL = "coordination_store_seconds_total"
 COORD_BARRIER_WAIT_SECONDS_TOTAL = "coordination_barrier_wait_seconds_total"
 COORD_EXCHANGE_SECONDS_TOTAL = "coordination_exchange_seconds_total"
 COORD_ENDPOINT_SECONDS_TOTAL = "coordination_endpoint_seconds_total"
+# ShardedStore request routing, labeled shard=<index>: the skew input
+# the ``store-hot-shard`` doctor rule reads (one shard absorbing a
+# disproportionate request share means the crc32 route degenerated for
+# this key population).
+COORD_STORE_SHARD_REQUESTS_TOTAL = "coordination_store_shard_requests_total"
+
+# -- wire observatory (telemetry/wire.py; dist_store.py, tiered/peer.py) -----
+#
+# The socket-level view of every byte the coordination store, peer
+# tier, and CDN move (docs/observability.md "Wire observatory"). Frames
+# and bytes are counted at the shared framing layer itself
+# (``send_frame``/``recv_frame``), labeled ``endpoint`` (store | peer)
+# and ``dir`` (send | recv); dials, per-RPC latency, pool checkouts and
+# accept-queue depth at the client/server seams. The ``*_TOTAL``
+# counters feed the per-op ``wire`` split in SnapshotReport; the
+# histograms feed the fleet plane and the ``wire-dial-stalled`` /
+# ``wire-hot-endpoint`` doctor rules.
+
+WIRE_FRAMES_TOTAL = "wire_frames_total"
+WIRE_BYTES_TOTAL = "wire_bytes_total"
+WIRE_INFLIGHT_FRAMES = "wire_inflight_frames"
+WIRE_DIALS_TOTAL = "wire_dials_total"
+WIRE_DIAL_SECONDS_TOTAL = "wire_dial_seconds_total"
+WIRE_DIAL_SECONDS = "wire_dial_seconds"
+WIRE_RPCS_TOTAL = "wire_rpcs_total"
+WIRE_RPC_SECONDS_TOTAL = "wire_rpc_seconds_total"
+WIRE_RPC_SECONDS = "wire_rpc_seconds"
+WIRE_POOL_CHECKOUTS_TOTAL = "wire_pool_checkouts_total"
+WIRE_ACCEPT_QUEUE_DEPTH = "wire_accept_queue_depth"
+# Frames whose propagation header failed its integrity check (chaos
+# corruption, protocol skew): the transfer proceeded context-free.
+WIRE_CONTEXT_DEGRADED_TOTAL = "wire_context_degraded_total"
 
 # -- self-healing reads (scheduler.py) ---------------------------------------
 #
@@ -243,6 +275,14 @@ SPAN_CDN_PUBLISH = "cdn:publish"
 SPAN_CDN_SYNC = "cdn:sync"
 SPAN_CDN_SWAP = "cdn:swap"
 
+# telemetry/wire.py: the two sides of one framed RPC. The client span's
+# args carry the propagated trace id + its own span id; the handler
+# span's args carry the received trace id + parent span id (= the
+# client's span id), so the trace merge CLI can stitch them into one
+# causally-linked cross-process trace.
+SPAN_WIRE_RPC = "wire:rpc"
+SPAN_WIRE_HANDLER = "wire:handler"
+
 # utils/rss_profiler.py: a new peak RSS delta was observed
 INSTANT_RSS_PEAK = "rss:peak"
 
@@ -354,6 +394,20 @@ RULE_STORAGE_CORRUPTION = "storage-corruption"
 # (TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS). Cites the ledger's
 # publish/swap events and the per-subscriber staleness spread.
 RULE_CDN_STALENESS_HIGH = "cdn-staleness-high"
+# Dial latencies are clustering at whole-second values — the kernel's
+# SYN-retransmit quanta, i.e. a listen backlog overflowing under fan-in
+# (the PR 15 peer-server bug class, now auto-detected from the fleet
+# plane's recent-dial samples). The fix is the server's
+# ``request_queue_size``, not the network.
+RULE_WIRE_DIAL_STALLED = "wire-dial-stalled"
+# One serving endpoint moved a disproportionate byte share of a fan-out
+# round: owner election degenerated (or the fleet's chunk->owner hash
+# is skewed), so a single peer's NIC is the round's critical path.
+RULE_WIRE_HOT_ENDPOINT = "wire-hot-endpoint"
+# One coordination-store shard absorbed a disproportionate request
+# share: the crc32 key route degenerated for this key population, so
+# sharding stopped spreading load (docs/scaling.md).
+RULE_STORE_HOT_SHARD = "store-hot-shard"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).
@@ -463,3 +517,44 @@ CRASH_CDN_PUBLISH_ANNOUNCED = "cdn-publish-announced"
 # shadow buffers; the hot swap has not happened (the live weights must
 # still be the previous step's).
 CRASH_CDN_SWAP_STAGED = "cdn-swap-staged"
+
+# ---------------------------------------------------------------------------
+# Wire RPC op ids (telemetry/wire.py; dist_store.py, tiered/peer.py).
+#
+# Same single-registration rule as the families above, kebab-case.
+# ``RPC_``-prefixed constants name every operation that rides the shared
+# socket framing (``send_frame``/``recv_frame``): the op id travels in
+# the optional wire-context header, labels the per-RPC wire metrics,
+# and keys the peer transport's request dispatch. snaplint's
+# ``rpc-op-ids`` rule lints both halves: declared exactly once here,
+# kebab-case values, no literal op strings at frame-send call sites
+# (``PeerClient.request`` / ``wire.propagate``).
+# ---------------------------------------------------------------------------
+
+# Coordination-store commands (dist_store.py `_CMD_*` wire protocol).
+RPC_STORE_SET = "store-set"
+RPC_STORE_TRY_GET = "store-try-get"
+RPC_STORE_ADD = "store-add"
+RPC_STORE_DELETE = "store-delete"
+RPC_STORE_MULTI_SET = "store-multi-set"
+RPC_STORE_MULTI_GET = "store-multi-get"
+RPC_STORE_MULTI_DELETE = "store-multi-delete"
+RPC_STORE_SCAN = "store-scan"
+# Peer-tier transport commands (tiered/peer.py request dispatch). The
+# constants ARE the on-wire command strings: client and server both
+# reference them, so the protocol and the observability namespace
+# cannot drift apart.
+RPC_PEER_PUSH = "peer-push"
+RPC_PEER_COMMIT = "peer-commit"
+RPC_PEER_PULL = "peer-pull"
+RPC_PEER_REFCHUNKS = "peer-refchunks"
+RPC_PEER_LIST = "peer-list"
+RPC_PEER_EVICT = "peer-evict"
+RPC_PEER_STATS = "peer-stats"
+RPC_PEER_PING = "peer-ping"
+# Composite client-side operations that open a propagation context
+# spanning several frames (fanout.py's owner-table exchange, a CDN
+# subscriber's chunk-sync round).
+RPC_FANOUT_EXCHANGE = "fanout-exchange"
+RPC_CDN_SYNC = "cdn-sync"
+RPC_CDN_PUBLISH = "cdn-publish"

@@ -59,6 +59,21 @@ _COORD_COUNTERS = {
     names.COORD_EXCHANGE_SECONDS_TOTAL: "exchange_s",
     names.COORD_ENDPOINT_SECONDS_TOTAL: "endpoint_s",
 }
+# ...and into the wire split (summed across endpoint/direction labels,
+# with a per-op RPC table kept separately): what the op put on actual
+# sockets — frames, bytes, dials, request/reply round trips, and
+# context-header degradations. Subsumed by ``coordination`` for store
+# traffic but endpoint-true (peer-tier and CDN frames never touch the
+# coordination counters).
+_WIRE_COUNTERS = {
+    names.WIRE_FRAMES_TOTAL: "frames",
+    names.WIRE_BYTES_TOTAL: "bytes",
+    names.WIRE_DIALS_TOTAL: "dials",
+    names.WIRE_DIAL_SECONDS_TOTAL: "dial_s",
+    names.WIRE_RPCS_TOTAL: "rpcs",
+    names.WIRE_RPC_SECONDS_TOTAL: "rpc_s",
+    names.WIRE_CONTEXT_DEGRADED_TOTAL: "context_degraded",
+}
 
 
 @dataclasses.dataclass
@@ -162,6 +177,12 @@ class SnapshotReport:
     # registry counter deltas (process-global, like the plugin table).
     # The ``coordination-bound`` doctor rule keys off this.
     coordination: Optional[Dict[str, float]] = None
+    # Ops whose window put frames on actual sockets (None otherwise):
+    # the wire split — ``{frames, bytes, dials, dial_s, rpcs, rpc_s,
+    # context_degraded}`` totals plus ``ops`` (per declared RPC op id:
+    # {rpcs, rpc_s}). The ``wire-dial-stalled`` / ``wire-hot-endpoint``
+    # doctor rules and the history's ``wire_s`` trend key off this.
+    wire: Optional[Dict[str, Any]] = None
     retries: Dict[str, float] = dataclasses.field(default_factory=dict)
     mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
     aggregated: Optional[Dict[str, Dict[str, float]]] = None
@@ -266,6 +287,37 @@ def coordination_from_deltas(
     return {k: round(v, 6) for k, v in out.items()}
 
 
+def wire_from_deltas(deltas: Dict[str, float]) -> Optional[Dict[str, Any]]:
+    """Wire split from counter deltas: scalar totals summed across
+    endpoint/direction/outcome labels, plus a per-op RPC table keyed by
+    the declared ``RPC_*`` op ids; None when the window put nothing on
+    the wire (single-process ops stay schema-light)."""
+    out = {field: 0.0 for field in _WIRE_COUNTERS.values()}
+    ops: Dict[str, Dict[str, float]] = {}
+    seen = False
+    for series, value in deltas.items():
+        name, labels = parse_series_key(series)
+        field = _WIRE_COUNTERS.get(name)
+        if field is None:
+            continue
+        out[field] += value
+        seen = True
+        if name in (names.WIRE_RPCS_TOTAL, names.WIRE_RPC_SECONDS_TOTAL):
+            op = labels.get("op", "?")
+            table = ops.setdefault(op, {"rpcs": 0.0, "rpc_s": 0.0})
+            key = "rpcs" if name == names.WIRE_RPCS_TOTAL else "rpc_s"
+            table[key] += value
+    if not seen:
+        return None
+    result: Dict[str, Any] = {k: round(v, 6) for k, v in out.items()}
+    if ops:
+        result["ops"] = {
+            op: {k: round(v, 6) for k, v in t.items()}
+            for op, t in sorted(ops.items())
+        }
+    return result
+
+
 def retries_from_deltas(deltas: Dict[str, float]) -> Dict[str, float]:
     """Retry table from counter deltas; every key present (zero-filled)
     so report consumers never need existence checks."""
@@ -360,6 +412,7 @@ def build_report(
         ),
         tunables=dict(tunables) if tunables is not None else None,
         coordination=coordination_from_deltas(counter_deltas),
+        wire=wire_from_deltas(counter_deltas),
         retries=retries_from_deltas(counter_deltas),
         mirror=dict(mirror or {}),
         error=error,
@@ -419,4 +472,20 @@ def aggregate_across_ranks(
         "budget_wait_s",
         [float(r.get("budget_wait_s", 0.0)) for r in rank_reports],
     )
+    # Wire fold: per-rank wire totals spread the same way, so one rank
+    # paying disproportionate socket time (a hot owner, a stalled
+    # dialer) surfaces as the straggler here without reading N reports.
+    if any(r.get("wire") for r in rank_reports):
+        for metric, field in (
+            ("wire_bytes", "bytes"),
+            ("wire_rpc_s", "rpc_s"),
+            ("wire_dial_s", "dial_s"),
+        ):
+            spread(
+                metric,
+                [
+                    float((r.get("wire") or {}).get(field, 0.0))
+                    for r in rank_reports
+                ],
+            )
     return out

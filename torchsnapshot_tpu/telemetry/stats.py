@@ -206,6 +206,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .goodput import main as goodput_main
 
         return goodput_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # ``python -m torchsnapshot_tpu.telemetry fleet <target>``:
+        # live per-rank/per-subscriber table from the __obs/ metrics
+        # plane on the coordination store (telemetry/wire.py).
+        from .wire import fleet_main
+
+        return fleet_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="snapshot-stats",

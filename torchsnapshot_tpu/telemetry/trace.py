@@ -542,6 +542,7 @@ def merge_traces(
     merged: List[Dict[str, Any]] = []
     ranks: List[int] = []
     used_pids: set = set()
+    unaligned: List[int] = []
     dropped = 0
     for path in paths:
         with open(path, "r", encoding="utf-8") as f:
@@ -556,7 +557,20 @@ def merge_traces(
         dropped += int(other.get("dropped_events", 0))
         shift_us = 0
         if clock_offsets_s:
-            shift_us = int(round(clock_offsets_s.get(rank, 0.0) * 1e6))
+            offset = clock_offsets_s.get(rank)
+            if offset is None:
+                # A rank whose report carried no clock offset (older
+                # schema, or it never reached the gather) merges
+                # uncorrected rather than failing the whole merge —
+                # its pid is simply unaligned, and flagged as such.
+                unaligned.append(rank)
+                logger.warning(
+                    "trace merge: no clock offset for rank %d; its "
+                    "timeline is unaligned",
+                    rank,
+                )
+            else:
+                shift_us = int(round(offset * 1e6))
         for ev in doc.get("traceEvents", []):
             if shift_us != 0 or pid != ev.get("pid", rank):
                 ev = dict(ev)
@@ -565,7 +579,7 @@ def merge_traces(
                 ev["pid"] = pid
             merged.append(ev)
     merged.sort(key=lambda ev: ev["ts"])
-    return {
+    out = {
         "traceEvents": merged,
         "displayTimeUnit": "ms",
         "otherData": {
@@ -576,6 +590,74 @@ def merge_traces(
             "dropped_events": dropped,
         },
     }
+    if unaligned:
+        out["otherData"]["unaligned_ranks"] = sorted(set(unaligned))
+    stitch_wire_flows(out)
+    return out
+
+
+def stitched_wire_pairs(
+    doc: Dict[str, Any]
+) -> List[Tuple[Dict[str, Any], Dict[str, Any]]]:
+    """(client RPC span, server handler span) pairs causally linked by
+    the propagated wire context: the handler's ``parent_span_id``
+    equals the client span's ``span_id`` and both carry the same trace
+    id. Works on a single rank's doc or a merged one — the linkage
+    rides span args, not pids."""
+    spans = spans_from_chrome(doc)
+    clients: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        if s["name"] == names.SPAN_WIRE_RPC:
+            span_id = s.get("args", {}).get("span_id")
+            if span_id:
+                clients[str(span_id)] = s
+    pairs: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
+    for s in spans:
+        if s["name"] != names.SPAN_WIRE_HANDLER:
+            continue
+        args = s.get("args", {})
+        client = clients.get(str(args.get("parent_span_id")))
+        if client is None:
+            continue
+        if client.get("args", {}).get("trace_id") == args.get("trace_id"):
+            pairs.append((client, s))
+    return pairs
+
+
+def stitch_wire_flows(doc: Dict[str, Any]) -> int:
+    """Append Chrome flow events (``ph: s`` / ``ph: f``) linking each
+    cross-process client→handler wire pair, so Perfetto draws the RPC
+    arrow from the caller's span to the serving peer's handler span.
+    Returns the number of stitched pairs (also recorded in
+    ``otherData.wire_stitched``)."""
+    pairs = stitched_wire_pairs(doc)
+    events = doc.setdefault("traceEvents", [])
+    for client, handler in pairs:
+        flow_id = str(client["args"]["span_id"])
+        common = {"cat": "wire", "name": "wire-rpc", "id": flow_id}
+        # Flow endpoints must land INSIDE their slices (ts + 1 beats
+        # the >= 1 us minimum span duration) or Perfetto drops them.
+        events.append(
+            {
+                "ph": "s",
+                "pid": client["pid"],
+                "tid": client["tid"],
+                "ts": client["ts"] + 1,
+                **common,
+            }
+        )
+        events.append(
+            {
+                "ph": "f",
+                "bp": "e",
+                "pid": handler["pid"],
+                "tid": handler["tid"],
+                "ts": handler["ts"] + 1,
+                **common,
+            }
+        )
+    doc.setdefault("otherData", {})["wire_stitched"] = len(pairs)
+    return len(pairs)
 
 
 def spans_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -675,6 +757,16 @@ def summarize_merged(doc: Dict[str, Any], top: int = 5) -> str:
                 f"({per_rank[straggler] / 1e3:.1f} ms; min "
                 f"{min(per_rank.values()) / 1e3:.1f} ms)"
             )
+    pairs = stitched_wire_pairs(doc)
+    if pairs:
+        lines.append("")
+        lines.append(f"wire RPCs stitched across processes: {len(pairs)}")
+        for client, handler in pairs[:top]:
+            op = client.get("args", {}).get("op", "?")
+            lines.append(
+                f"  {op:<24} pid {client['pid']} -> pid {handler['pid']} "
+                f"({client['dur_us'] / 1e3:.1f} ms round trip)"
+            )
     stalls = [
         ev
         for ev in doc.get("traceEvents", [])
@@ -711,7 +803,14 @@ def _clock_offsets_from_events(roots: List[str]) -> Dict[int, float]:
             for ev in load_events(path):
                 offsets = ev.get("clock_offsets_s")
                 if offsets:
-                    best = {i: float(o) for i, o in enumerate(offsets)}
+                    # A rank whose slot is null (no gather stamp) gets
+                    # no entry: merge_traces leaves it unaligned with a
+                    # warning instead of failing the merge.
+                    best = {
+                        i: float(o)
+                        for i, o in enumerate(offsets)
+                        if o is not None
+                    }
         except Exception:  # noqa: BLE001 - offsets are an optional refinement
             continue
     return best
@@ -774,6 +873,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             "clock offsets applied (s): "
             + ", ".join(f"rank{r}={o:+.3f}" for r, o in sorted(offsets.items()))
         )
+    unaligned = merged.get("otherData", {}).get("unaligned_ranks")
+    if unaligned:
+        print(
+            f"warning: no clock offsets for rank(s) "
+            f"{', '.join(map(str, unaligned))} — their timelines are "
+            f"unaligned (raw clocks)"
+        )
+    stitched = merged.get("otherData", {}).get("wire_stitched", 0)
+    if stitched:
+        print(f"wire RPCs stitched across processes: {stitched}")
     print()
     print(summarize_merged(merged, top=args.top))
     return 0

@@ -82,6 +82,7 @@ from ..storage_plugins.retry import (
     RetriesExhausted,
 )
 from ..telemetry import names as metric_names
+from ..telemetry import wire
 from ..telemetry.trace import get_recorder as _trace_recorder
 
 logger: logging.Logger = logging.getLogger(__name__)
@@ -386,72 +387,98 @@ class _PeerServer(socketserver.ThreadingTCPServer):
     def __init__(self, addr, cache: PeerCache) -> None:
         super().__init__(addr, _PeerRequestHandler)
         self.cache = cache
+        # Concurrent-handler count: the wire observatory's userspace
+        # proxy for accept pressure on this cache server.
+        self.active_handlers = 0
+        self.active_lock = threading.Lock()
 
 
 class _PeerRequestHandler(socketserver.BaseRequestHandler):
+    def _dispatch(self, cmd: str, args: tuple, cache: PeerCache) -> Any:
+        registry = telemetry.metrics()
+        if cmd == metric_names.RPC_PEER_PUSH:
+            step_key, step, path, entry, data = args
+            return cache.put(step_key, step, path, entry, data)
+        if cmd == metric_names.RPC_PEER_COMMIT:
+            step_key, step = args
+            cache.commit(step_key, step)
+            return (True, "ok")
+        if cmd == metric_names.RPC_PEER_PULL:
+            if len(args) == 3:
+                step_key, path, rng = args
+            else:
+                step_key, path = args
+                rng = None
+            found = cache.get(step_key, path)
+            if found is not None and rng is not None:
+                # Server-side slice: a ranged read of a cached
+                # blob ships only the requested window, not the
+                # whole blob, over the socket.
+                entry, data = found
+                found = (
+                    entry,
+                    data[int(rng[0]) : int(rng[1])],
+                )
+            if found is not None:
+                registry.counter_inc(metric_names.PEER_PULL_HITS_TOTAL)
+                registry.counter_inc(
+                    metric_names.PEER_PULL_BYTES_TOTAL,
+                    len(found[1]),
+                )
+            else:
+                registry.counter_inc(metric_names.PEER_PULL_MISSES_TOTAL)
+            return found
+        if cmd == metric_names.RPC_PEER_REFCHUNKS:
+            step_key, step, paths = args
+            return cache.reference_chunks(step_key, step, list(paths))
+        if cmd == metric_names.RPC_PEER_LIST:
+            (step_key,) = args
+            return cache.inventory(step_key)
+        if cmd == metric_names.RPC_PEER_EVICT:
+            (step_key,) = args
+            return cache.evict_step(step_key)
+        if cmd == metric_names.RPC_PEER_STATS:
+            return cache.stats()
+        if cmd == metric_names.RPC_PEER_PING:
+            return "pong"
+        return None
+
     def handle(self) -> None:
         server: _PeerServer = self.server  # type: ignore[assignment]
         cache = server.cache
-        registry = telemetry.metrics()
+        with server.active_lock:
+            server.active_handlers += 1
+            depth = server.active_handlers
+        try:
+            wire.observe_accept_depth("peer", depth)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
         try:
             while True:
-                cmd, args = pickle.loads(recv_frame(self.request))
-                if cmd == "push":
-                    step_key, step, path, entry, data = args
-                    reply = cache.put(step_key, step, path, entry, data)
-                elif cmd == "commit":
-                    step_key, step = args
-                    cache.commit(step_key, step)
-                    reply = (True, "ok")
-                elif cmd == "pull":
-                    if len(args) == 3:
-                        step_key, path, rng = args
-                    else:
-                        step_key, path = args
-                        rng = None
-                    found = cache.get(step_key, path)
-                    if found is not None and rng is not None:
-                        # Server-side slice: a ranged read of a cached
-                        # blob ships only the requested window, not the
-                        # whole blob, over the socket.
-                        entry, data = found
-                        found = (
-                            entry,
-                            data[int(rng[0]) : int(rng[1])],
-                        )
-                    if found is not None:
-                        registry.counter_inc(
-                            metric_names.PEER_PULL_HITS_TOTAL
-                        )
-                        registry.counter_inc(
-                            metric_names.PEER_PULL_BYTES_TOTAL,
-                            len(found[1]),
-                        )
-                    else:
-                        registry.counter_inc(
-                            metric_names.PEER_PULL_MISSES_TOTAL
-                        )
-                    reply = found
-                elif cmd == "refchunks":
-                    step_key, step, paths = args
-                    reply = cache.reference_chunks(
-                        step_key, step, list(paths)
-                    )
-                elif cmd == "list":
-                    (step_key,) = args
-                    reply = cache.inventory(step_key)
-                elif cmd == "evict":
-                    (step_key,) = args
-                    reply = cache.evict_step(step_key)
-                elif cmd == "stats":
-                    reply = cache.stats()
-                elif cmd == "ping":
-                    reply = "pong"
+                cmd, args = pickle.loads(
+                    recv_frame(self.request, endpoint="peer")
+                )
+                # Stitch the sender's context into this side's trace:
+                # the handler span carries the CLIENT's span id as
+                # parent, so the merged cross-rank timeline links the
+                # subscriber's pull to the serving peer's work.
+                ctx = wire.last_received_context()
+                if ctx is not None:
+                    with _trace_recorder().span(
+                        metric_names.SPAN_WIRE_HANDLER,
+                        op=ctx.op,
+                        trace_id=ctx.trace_id,
+                        parent_span_id=ctx.span_id,
+                    ):
+                        reply = self._dispatch(cmd, args, cache)
                 else:
-                    reply = None
-                send_frame(self.request, pickle.dumps(reply))
+                    reply = self._dispatch(cmd, args, cache)
+                send_frame(self.request, pickle.dumps(reply), endpoint="peer")
         except (ConnectionError, EOFError, OSError):
             return
+        finally:
+            with server.active_lock:
+                server.active_handlers -= 1
 
 
 class PeerClient:
@@ -475,25 +502,56 @@ class PeerClient:
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            sock = socket.create_connection(
-                (self.host, self.port), timeout=self.timeout
-            )
+            t_dial = time.monotonic()
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout
+                )
+            except OSError:
+                try:
+                    wire.observe_dial("peer", 0.0, ok=False)
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
+                raise
+            try:
+                # Dial latency per successful connect: a full listen
+                # backlog on the serving peer shows up here as whole-
+                # second SYN-retransmit quanta (wire-dial-stalled).
+                wire.observe_dial("peer", time.monotonic() - t_dial)
+            except Exception:  # noqa: BLE001 - best-effort
+                pass
             sock.settimeout(self.timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = sock
         return self._sock
 
     def request(self, cmd: str, *args: Any) -> Any:
+        t0 = time.monotonic()
         with self._lock:
             try:
-                sock = self._connect()
-                send_frame(sock, pickle.dumps((cmd, args)))
-                return pickle.loads(recv_frame(sock))
+                # Propagate (or extend) this thread's wire context so
+                # the request frame carries trace/span/op — the serving
+                # peer's handler span links back to it in the merged
+                # trace. ``cmd`` IS the declared RPC id (names.RPC_*).
+                with wire.propagate(cmd) as ctx, _trace_recorder().span(
+                    metric_names.SPAN_WIRE_RPC,
+                    op=cmd,
+                    trace_id=ctx.trace_id,
+                    span_id=ctx.span_id,
+                ):
+                    sock = self._connect()
+                    send_frame(sock, pickle.dumps((cmd, args)), endpoint="peer")
+                    reply = pickle.loads(recv_frame(sock, endpoint="peer"))
             except (OSError, EOFError, pickle.PickleError) as e:
                 self._teardown_locked()
                 raise PeerTransferError(
                     f"peer {self.host}:{self.port} {cmd} failed: {e!r}"
                 ) from e
+        try:
+            wire.observe_rpc("peer", cmd, time.monotonic() - t0)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+        return reply
 
     def _teardown_locked(self) -> None:
         if self._sock is not None:
@@ -507,7 +565,9 @@ class PeerClient:
         with self._lock:
             self._teardown_locked()
 
-    # Typed convenience wrappers.
+    # Typed convenience wrappers. The op ids are the declared RPC
+    # registry constants (names.RPC_PEER_*) — snaplint's rpc-op-ids
+    # rule keeps literal op strings out of request call sites.
 
     def push(
         self,
@@ -517,10 +577,14 @@ class PeerClient:
         entry: tuple,
         data: bytes,
     ) -> Tuple[bool, str]:
-        return tuple(self.request("push", step_key, step, path, entry, data))
+        return tuple(
+            self.request(
+                metric_names.RPC_PEER_PUSH, step_key, step, path, entry, data
+            )
+        )
 
     def commit(self, step_key: str, step: Optional[int]) -> None:
-        self.request("commit", step_key, step)
+        self.request(metric_names.RPC_PEER_COMMIT, step_key, step)
 
     def reference_chunks(
         self, step_key: str, step: Optional[int], paths: List[str]
@@ -528,7 +592,11 @@ class PeerClient:
         """Dedup probe: which of these content-addressed chunk paths the
         peer already pools (now referenced under ``step_key``). The
         pusher ships bytes only for the rest."""
-        return list(self.request("refchunks", step_key, step, list(paths)))
+        return list(
+            self.request(
+                metric_names.RPC_PEER_REFCHUNKS, step_key, step, list(paths)
+            )
+        )
 
     def pull(
         self,
@@ -536,16 +604,18 @@ class PeerClient:
         path: str,
         byte_range: Optional[Tuple[int, int]] = None,
     ) -> Optional[Tuple[tuple, bytes]]:
-        return self.request("pull", step_key, path, byte_range)
+        return self.request(
+            metric_names.RPC_PEER_PULL, step_key, path, byte_range
+        )
 
     def list_step(self, step_key: str) -> Dict[str, tuple]:
-        return dict(self.request("list", step_key))
+        return dict(self.request(metric_names.RPC_PEER_LIST, step_key))
 
     def evict(self, step_key: str) -> bool:
-        return bool(self.request("evict", step_key))
+        return bool(self.request(metric_names.RPC_PEER_EVICT, step_key))
 
     def stats(self) -> Dict[str, Any]:
-        return dict(self.request("stats"))
+        return dict(self.request(metric_names.RPC_PEER_STATS))
 
 
 # ---------------------------------------------------------------------------
@@ -1262,11 +1332,23 @@ class PeerRestoreContext:
                 self._endpoint_failures.get(endpoint, 0)
                 >= _PULL_DEAD_AFTER_FAILURES
             ):
-                return None
-            free = self._free_clients.get(endpoint)
-            if free:
-                return free.pop()
-        return PeerClient(endpoint[0], endpoint[1], timeout=self.timeout)
+                outcome = "dead"
+                client = None
+            else:
+                free = self._free_clients.get(endpoint)
+                if free:
+                    outcome = "reused"
+                    client = free.pop()
+                else:
+                    outcome = "new"
+                    client = PeerClient(
+                        endpoint[0], endpoint[1], timeout=self.timeout
+                    )
+        try:
+            wire.observe_pool_checkout("peer", outcome)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+        return client
 
     def _give_back(
         self, endpoint: Tuple[str, int], client: PeerClient
