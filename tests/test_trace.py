@@ -497,6 +497,14 @@ def test_watchdog_fires_exactly_once_on_injected_slow_plugin(
     assert any(
         "take rank0" in row and "items" in row for row in args["progress"]
     )
+    # The stall instant names the blocking chain: the culprit's track
+    # prefix plus the segment the wedged span charges to, so a stalled
+    # fleet is diagnosable from the instant alone.
+    from torchsnapshot_tpu.telemetry import critpath
+
+    assert args["critical_path"]
+    assert any(args["span"] in entry for entry in args["critical_path"])
+    assert args["gating_segment"] == critpath.segment_for(args["span"])
     # The log carried the tree and the faulthandler-style stacks.
     log_text = caplog.text
     assert "open-span tree" in log_text

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Default-lane bench differential: did the newest round regress?
+
+Thin shim over ``telemetry/critpath.py``'s bench differential, aimed at
+CI (the docs-consistency job) and pre-merge hygiene: the newest parsed
+``BENCH_r*.json`` is judged against the rolling baseline of its
+predecessors with the per-leg directions and tolerance floors declared
+in ``critpath.BENCH_LEGS`` (sized to the measured round-to-round link
+drift of this box, so pure drift stays quiet while a real slowdown
+fires). Nonzero exit on regression. Stdlib + repo only; run from
+anywhere:
+
+    python tools/bench_diff.py
+"""
+
+import importlib
+import sys
+import types
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+sys.path.insert(0, str(ROOT))
+
+from check_bench_docs import scan_records  # noqa: E402
+
+
+def _critpath():
+    """telemetry/critpath.py, importable in the minimal CI environment:
+    the top-level package's eager imports pull in jax (absent in the
+    docs-consistency job), but the telemetry subpackage is stdlib-only —
+    a stub parent package lets it load without executing
+    ``torchsnapshot_tpu/__init__.py``."""
+    try:
+        from torchsnapshot_tpu.telemetry import critpath
+
+        return critpath
+    except ImportError:
+        if "torchsnapshot_tpu" not in sys.modules:
+            stub = types.ModuleType("torchsnapshot_tpu")
+            stub.__path__ = [str(ROOT / "torchsnapshot_tpu")]
+            sys.modules["torchsnapshot_tpu"] = stub
+        return importlib.import_module(
+            "torchsnapshot_tpu.telemetry.critpath"
+        )
+
+
+def main(root: Path = ROOT) -> int:
+    critpath = _critpath()
+
+    parsed = [
+        (path.name, record)
+        for _, path, record in sorted(scan_records(root))
+        if record is not None
+    ]
+    if len(parsed) < 2:
+        print(
+            "bench_diff: fewer than two parsed BENCH_r*.json records; "
+            "nothing to compare"
+        )
+        return 0
+    newest_label = parsed[-1][0]
+    previous_label = parsed[-2][0]
+    print(
+        f"bench_diff: {newest_label} vs baseline of "
+        f"{len(parsed) - 1} earlier parsed records "
+        f"(newest predecessor {previous_label})"
+    )
+    rows = critpath.bench_regressions(parsed)
+    for verdict in critpath.bench_verdicts(rows):
+        print(verdict.format())
+    if rows:
+        print(f"bench_diff: {len(rows)} regressed leg(s) in {newest_label}")
+        return 2
+    print(f"bench_diff: no leg of {newest_label} regressed beyond tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -275,6 +275,22 @@ def _emit_snapshot_report(
                 tunables if tunables is not None else knobs.tunable_snapshot()
             ),
         )
+        # Blocking-chain attribution over the op's recorder window
+        # (telemetry/critpath.py). Computed BEFORE the gather so every
+        # rank's dict carries its segments into the cross-rank fold.
+        # The envelope span closed before this call (callers end it
+        # before emitting), so the window holds the op's full extent.
+        if trace_mark is not None:
+            try:
+                from .telemetry import critpath as _critpath
+
+                report.critical_path = _critpath.critical_path_from_events(
+                    _trace_recorder().events_since(trace_mark), kind
+                )
+            except Exception as e:  # noqa: BLE001 - attribution is best-effort
+                logger.warning(
+                    "telemetry: critical-path attribution failed: %r", e
+                )
         gathered = None
         if (
             nonce

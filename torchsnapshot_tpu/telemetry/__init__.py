@@ -44,6 +44,7 @@ report schema, sink knobs, and CLI.
 from __future__ import annotations
 
 from . import (
+    critpath,
     doctor,
     goodput,
     history,
@@ -84,6 +85,7 @@ __all__ = [
     "aggregate_across_ranks",
     "build_report",
     "clock_offsets_from_gather",
+    "critpath",
     "current_progress",
     "doctor",
     "emit_report",

@@ -204,6 +204,8 @@ def registered_rule_ids() -> List[str]:
         names.RULE_IN_TAKE_STALL,
         names.RULE_LINK_UNSTABLE,
         names.RULE_TREND_REGRESSION,
+        names.RULE_CRITICAL_PATH_SHIFTED,
+        names.RULE_BENCH_REGRESSION,
     ]
     return sorted(
         {
@@ -1506,6 +1508,29 @@ def diagnose_trend(
                     f"{where} regressed on {row['metric']}: "
                     f"{row['value']} against a rolling baseline median "
                     f"of {row['baseline_median']}"
+                ),
+                evidence={
+                    k: v for k, v in row.items() if k not in ("path",)
+                },
+                source=str(row.get("path") or ""),
+            )
+        )
+    # Dominant-segment shifts (telemetry/critpath.py): the bottleneck
+    # MOVED against the rolling window's modal dominant — a regression
+    # class magnitude thresholds cannot see when the wall barely
+    # changes (e.g. write drain shrank exactly as coordination grew).
+    from .critpath import detect_critical_path_shifts
+
+    for row in detect_critical_path_shifts(records, window=window):
+        step = row.get("step")
+        where = f"step {step}" if step is not None else f"record {row['index']}"
+        verdicts.append(
+            Verdict(
+                rule=names.RULE_CRITICAL_PATH_SHIFTED,
+                summary=(
+                    f"{where} critical path shifted to "
+                    f"{row['dominant']} (window dominant: "
+                    f"{row['previous_dominant']})"
                 ),
                 evidence={
                     k: v for k, v in row.items() if k not in ("path",)

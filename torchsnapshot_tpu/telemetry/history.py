@@ -151,6 +151,26 @@ def summarize_report(
         "tunables": (
             dict(report.tunables) if report.tunables is not None else None
         ),
+        # Blocking-chain attribution (telemetry/critpath.py; None for
+        # pre-critpath reports / overrun trace windows): the dominant
+        # path segment, attribution coverage, and per-segment gated
+        # seconds. Feeds one ``critpath_<segment>_s`` trend series per
+        # segment plus the doctor's critical-path-shifted rule — a step
+        # whose bottleneck MOVED flags even when the wall barely did.
+        "critpath": (
+            {
+                "dominant": report.critical_path.get("dominant"),
+                "coverage": report.critical_path.get("coverage"),
+                "segments": {
+                    k: round(float(v), 6)
+                    for k, v in (
+                        report.critical_path.get("segments") or {}
+                    ).items()
+                },
+            }
+            if report.critical_path
+            else None
+        ),
         "error": report.error,
     }
 
@@ -240,12 +260,27 @@ def _metric_series(records: List[Dict[str, Any]]) -> Dict[str, List[float]]:
     )
     for p in phase_names:
         series[f"phase_{p}_s"] = []
+    # Critical-path segments follow the phases' dynamic pattern: one
+    # series per segment seen anywhere in the history (records missing
+    # it contribute 0.0 — a segment that appears is itself signal).
+    seg_names = sorted(
+        {
+            s
+            for r in records
+            for s in ((r.get("critpath") or {}).get("segments") or {})
+        }
+    )
+    for s in seg_names:
+        series[f"critpath_{s}_s"] = []
     for r in records:
         for k in _TREND_METRICS:
             series[k].append(float(r.get(k) or 0.0))
         phases = r.get("phases") or {}
         for p in phase_names:
             series[f"phase_{p}_s"].append(float(phases.get(p, 0.0)))
+        segments = (r.get("critpath") or {}).get("segments") or {}
+        for s in seg_names:
+            series[f"critpath_{s}_s"].append(float(segments.get(s, 0.0)))
     return series
 
 

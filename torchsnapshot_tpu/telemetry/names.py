@@ -408,6 +408,18 @@ RULE_WIRE_HOT_ENDPOINT = "wire-hot-endpoint"
 # share: the crc32 key route degenerated for this key population, so
 # sharding stopped spreading load (docs/scaling.md).
 RULE_STORE_HOT_SHARD = "store-hot-shard"
+# Critical-path analysis (telemetry/critpath.py): the dominant
+# path segment of a step's critical-path attribution differs from the
+# rolling window's modal dominant segment — the bottleneck MOVED (e.g.
+# write drain gave way to coordination), which a magnitude-only trend
+# check cannot see when the wall clock barely shifts.
+RULE_CRITICAL_PATH_SHIFTED = "critical-path-shifted"
+# A signal-of-record bench leg slowed beyond its declared tolerance
+# (median + k*MAD over the preceding BENCH_r*.json records, with
+# relative/absolute floors sized to the measured round-to-round link
+# drift): the regression is in the code, not the noise. Emitted by the
+# diff engine / ``tools/bench_diff.py``, never from a live op.
+RULE_BENCH_REGRESSION = "bench-regression"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).

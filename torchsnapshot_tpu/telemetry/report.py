@@ -183,6 +183,16 @@ class SnapshotReport:
     # {rpcs, rpc_s}). The ``wire-dial-stalled`` / ``wire-hot-endpoint``
     # doctor rules and the history's ``wire_s`` trend key off this.
     wire: Optional[Dict[str, Any]] = None
+    # Blocking-chain attribution over the op's flight-recorder window
+    # (telemetry/critpath.py; None when no envelope span landed in the
+    # window): ``{wall_s, coverage, segments: {segment: seconds},
+    # dominant, chain: [{span, segment, gated_s, blob?}]}``. The
+    # segments partition the op's wall — each microsecond charged to
+    # the innermost open span's path segment — so ``coverage`` sits at
+    # ~1.0 and the dominant segment names the op's actual bottleneck.
+    # Feeds the history's ``critpath`` rows, ``doctor --trend``'s
+    # dominant-shift rule, and the ``telemetry diff`` CLI.
+    critical_path: Optional[Dict[str, Any]] = None
     retries: Dict[str, float] = dataclasses.field(default_factory=dict)
     mirror: Dict[str, Any] = dataclasses.field(default_factory=dict)
     aggregated: Optional[Dict[str, Dict[str, float]]] = None
@@ -485,6 +495,31 @@ def aggregate_across_ranks(
                 metric,
                 [
                     float((r.get("wire") or {}).get(field, 0.0))
+                    for r in rank_reports
+                ],
+            )
+    # Critical-path fold: per-segment gated seconds spread across ranks
+    # (union of segments any rank attributed), so "which rank's write
+    # drain gated the step" is one straggler lookup, not N report reads.
+    if any(r.get("critical_path") for r in rank_reports):
+        segments = sorted(
+            {
+                seg
+                for r in rank_reports
+                for seg in (r.get("critical_path") or {}).get(
+                    "segments", {}
+                )
+            }
+        )
+        for seg in segments:
+            spread(
+                f"critpath_{seg}_s",
+                [
+                    float(
+                        (r.get("critical_path") or {})
+                        .get("segments", {})
+                        .get(seg, 0.0)
+                    )
                     for r in rank_reports
                 ],
             )

@@ -17,6 +17,9 @@ CLI (also ``python -m torchsnapshot_tpu.telemetry`` and
     snapshot-stats goodput <manager-root> # run-level wall-time
                                           # attribution + storage spend
                                           # (telemetry/goodput.py)
+    snapshot-stats diff <before> <after>  # critical-path / bench-record
+                                          # differential comparison
+                                          # (telemetry/critpath.py)
 
 Output: one row per (path, kind, rank) record — phase durations,
 bytes, throughput, budget wait, retries — followed by a per-tier
@@ -206,6 +209,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .goodput import main as goodput_main
 
         return goodput_main(argv[1:])
+    if argv and argv[0] == "diff":
+        # ``python -m torchsnapshot_tpu.telemetry diff <before> <after>``:
+        # differential critical-path / bench-record comparison
+        # (telemetry/critpath.py).
+        from .critpath import diff_main
+
+        return diff_main(argv[1:])
     if argv and argv[0] == "fleet":
         # ``python -m torchsnapshot_tpu.telemetry fleet <target>``:
         # live per-rank/per-subscriber table from the __obs/ metrics

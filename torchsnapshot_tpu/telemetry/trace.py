@@ -662,26 +662,35 @@ def stitch_wire_flows(doc: Dict[str, Any]) -> int:
 
 def spans_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Reconstruct completed spans from a Chrome trace document's B/E
-    pairs: ``{"name", "pid", "tid", "ts", "dur_us"}``."""
-    stacks: Dict[Tuple[int, int], List[Dict[str, Any]]] = {}
+    pairs: ``{"name", "pid", "tid", "ts", "dur_us", "self_us"}``.
+    ``self_us`` is the span's inclusive duration minus the durations of
+    its direct children on the same track — the time the span spent in
+    its OWN frame, which is what separates a genuinely slow stage from
+    an envelope that merely contains one."""
+    # Stack entries are [begin_event, accumulated_child_us].
+    stacks: Dict[Tuple[int, int], List[List[Any]]] = {}
     spans: List[Dict[str, Any]] = []
     for ev in doc.get("traceEvents", []):
         ph = ev.get("ph")
         key = (ev.get("pid", 0), ev.get("tid", 0))
         if ph == "B":
-            stacks.setdefault(key, []).append(ev)
+            stacks.setdefault(key, []).append([ev, 0])
         elif ph == "E":
             stack = stacks.get(key)
             if not stack:
                 continue  # torn window: span began before the export mark
-            begin = stack.pop()
+            begin, child_us = stack.pop()
+            dur_us = ev["ts"] - begin["ts"]
+            if stack:
+                stack[-1][1] += dur_us
             spans.append(
                 {
                     "name": begin.get("name", "?"),
                     "pid": key[0],
                     "tid": key[1],
                     "ts": begin["ts"],
-                    "dur_us": ev["ts"] - begin["ts"],
+                    "dur_us": dur_us,
+                    "self_us": max(0, dur_us - child_us),
                     "args": begin.get("args", {}),
                 }
             )
@@ -697,7 +706,11 @@ def longest_spans_from_doc(
     spans = sorted(spans_from_chrome(doc), key=lambda s: -s["dur_us"])
     out = []
     for s in spans[:n]:
-        entry = {"name": s["name"], "dur_ms": round(s["dur_us"] / 1000, 1)}
+        entry = {
+            "name": s["name"],
+            "dur_ms": round(s["dur_us"] / 1000, 1),
+            "self_ms": round(s.get("self_us", s["dur_us"]) / 1000, 1),
+        }
         blob = s.get("args", {}).get("blob")
         if blob:
             entry["blob"] = blob
@@ -734,13 +747,24 @@ def summarize_merged(doc: Dict[str, Any], top: int = 5) -> str:
             f"[{(begin - t0) / 1e3:.1f} .. {(end - t0) / 1e3:.1f}] ms"
         )
     lines.append("")
-    lines.append(f"longest spans (top {top}):")
+    lines.append(f"longest spans (top {top}, inclusive / self):")
     for s in sorted(spans, key=lambda s: -s["dur_us"])[:top]:
         blob = s.get("args", {}).get("blob")
         suffix = f" ({blob})" if blob else ""
         lines.append(
             f"  {s['name']:<32} rank {s['pid']} "
-            f"{s['dur_us'] / 1e3:>10.1f} ms{suffix}"
+            f"{s['dur_us'] / 1e3:>10.1f} ms / "
+            f"{s.get('self_us', s['dur_us']) / 1e3:.1f} ms self{suffix}"
+        )
+    lines.append("")
+    lines.append(f"top self-time spans (top {top}):")
+    for s in sorted(
+        spans, key=lambda s: -s.get("self_us", s["dur_us"])
+    )[:top]:
+        lines.append(
+            f"  {s['name']:<32} rank {s['pid']} "
+            f"{s.get('self_us', s['dur_us']) / 1e3:>10.1f} ms self "
+            f"(of {s['dur_us'] / 1e3:.1f} ms)"
         )
     if len(ranks) > 1:
         totals: Dict[str, Dict[int, float]] = {}

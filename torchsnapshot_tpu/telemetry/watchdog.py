@@ -177,6 +177,20 @@ class StallWatchdog:
         # says how FAR each op got (bytes written vs planned, in-flight
         # items), not just which spans are open.
         progress_rows = _progress_rows()
+        # The critical-path prefix at stall time: the culprit's track's
+        # open spans oldest -> youngest — the chain of frames gating the
+        # op RIGHT NOW, ending in the culprit. Paired with
+        # critpath.segment_for it names the path segment the stall is
+        # charged to, so a frozen op reads the same way in the stall
+        # instant as in a post-hoc ``critical_path`` report.
+        from .critpath import segment_for
+
+        track = [
+            s for s in open_spans if s["tid"] == culprit["tid"]
+        ]
+        critical_prefix = [
+            f"{s['name']}@{s['age_s']}s" for s in track[:16]
+        ]
         # count_as_progress=False: the stall marker itself must not
         # reset the idle clock and make the stall look resolved.
         self._recorder.instant(
@@ -187,6 +201,8 @@ class StallWatchdog:
             idle_s=round(idle_s, 3),
             thread=culprit["thread"],
             deadline_s=deadline_s,
+            critical_path=critical_prefix,
+            gating_segment=segment_for(culprit["name"]),
             open_spans=[
                 f"{s['name']}@{s['age_s']}s" for s in open_spans[:16]
             ],
@@ -197,12 +213,15 @@ class StallWatchdog:
         metrics().counter_inc(names.WATCHDOG_STALLS_TOTAL)
         logger.error(
             "watchdog: span %r open for %.1fs with no recorder activity "
-            "for %.1fs (deadline %.1fs); open-span tree:\n%s\n"
+            "for %.1fs (deadline %.1fs); gating segment %s, critical "
+            "path %s; open-span tree:\n%s\n"
             "op progress:\n%s\nthread stacks:\n%s",
             culprit["name"],
             culprit["age_s"],
             idle_s,
             deadline_s,
+            segment_for(culprit["name"]),
+            " -> ".join(critical_prefix) or "(none)",
             tree,
             "\n".join(f"  {row}" for row in progress_rows) or "  (none)",
             _thread_stacks(),

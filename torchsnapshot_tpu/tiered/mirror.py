@@ -354,6 +354,18 @@ class Mirror:
                 },
                 error=repr(job.error) if job.error is not None else None,
             )
+            # Blocking-chain attribution over the job's span window
+            # (telemetry/critpath.py): which segment — per-blob copies,
+            # storage writes — actually gated the replication wall.
+            try:
+                from ..telemetry import critpath as _critpath
+                from ..telemetry.trace import get_recorder as _rec
+
+                report.critical_path = _critpath.critical_path_from_events(
+                    _rec().events_since(job.trace_mark), "mirror"
+                )
+            except Exception:  # noqa: BLE001 - attribution is best-effort
+                pass
             telemetry.emit_report(report, registry)
             # Run-ledger settle event: how long the step's bytes existed
             # only on the fast tier. The owned-root gate inside the post
