@@ -116,6 +116,19 @@ def test_snaplint_lane_is_clean(capsys):
     assert rc == 0, capsys.readouterr().out
 
 
+def test_snaplint_protocol_lane_is_clean(capsys):
+    """The protocol lane next to the bench-docs checks: the
+    coordination-plane model rules over the package, nonzero exit on
+    any new finding. Unlike ``tools/bench_diff.py`` this needs no
+    stub-parent-package import trick — snaplint is stdlib-``ast`` only
+    and never imports ``torchsnapshot_tpu`` (whose ``__init__`` pulls
+    jax), so the jax-free CI box runs it as-is."""
+    from tools.snaplint.__main__ import main
+
+    rc = main(["--protocol", "torchsnapshot_tpu"])
+    assert rc == 0, capsys.readouterr().out
+
+
 def test_checkers_are_snaplint_shims():
     """The three pre-snaplint checkers must stay thin shims over the
     framework's rule implementations — one implementation, two entry

@@ -134,6 +134,32 @@ def test_publisher_resumes_seq_from_store():
     assert ann.seq == 2
 
 
+def test_publisher_reaps_announces_past_retention():
+    """The store-key-leak fix: a long-running topic holds a bounded
+    number of announce records, not one per publish forever. The head's
+    announce (the only one subscribers read) always survives."""
+    from torchsnapshot_tpu.cdn.publisher import _ANNOUNCE_RETAIN
+
+    store = InProcessStore()
+    pub = CdnPublisher(store, "t")
+    key, data = _chunk(1)
+    total = _ANNOUNCE_RETAIN + 5
+    for step in range(1, total + 1):
+        assert pub.publish(step, {key: len(data)}) is not None
+    live = [
+        seq
+        for seq in range(1, total + 1)
+        if read_announce(store, "t", seq) is not None
+    ]
+    assert live == list(range(total - _ANNOUNCE_RETAIN + 1, total + 1))
+    assert read_head(store, "t") == total
+    # Retention survives a publisher restart: seq resumes from the head
+    # and the reaper keeps walking the same continuous sequence.
+    pub2 = CdnPublisher(store, "t")
+    assert pub2.publish(total + 1, {key: len(data)}).seq == total + 1
+    assert read_announce(store, "t", total + 1 - _ANNOUNCE_RETAIN) is None
+
+
 # ---------------------------------------------------------------------------
 # subscriber
 # ---------------------------------------------------------------------------

@@ -442,6 +442,62 @@ def test_fanout_owner_read_failure_fails_fast(tmp_path) -> None:
     )
 
 
+def test_fanout_failed_round_leaves_no_store_keys() -> None:
+    """The store-key teardown discipline on the ERROR path (snaplint's
+    store-key-leak class): when an owner's fetch fails, the surviving
+    peer consumes the error marker AND reaps the window it had already
+    published for the failed rank — a failed round leaves zero keys
+    under its nonce prefix, same as a successful one."""
+    import asyncio
+    import threading
+
+    from torchsnapshot_tpu.dist_store import InProcessStore
+    from torchsnapshot_tpu.fanout import FanoutError, FanoutRestoreContext
+    from torchsnapshot_tpu.io_types import ReadReq
+
+    store = InProcessStore()
+    owners = {"sharded/a": 0, "sharded/b": 1}
+    windows = {"sharded/a": (0, 8), "sharded/b": (0, 8)}
+
+    class _Storage:
+        def __init__(self, fail_path):
+            self.fail_path = fail_path
+
+        async def read(self, read_io):
+            if read_io.path == self.fail_path:
+                raise RuntimeError("injected owner read failure")
+            read_io.buf = memoryview(b"x" * 8)
+
+    errors = {}
+
+    def _rank(rank, need, fail_path):
+        ctx = FanoutRestoreContext(owners, windows, store, rank, 2)
+        loop = asyncio.new_event_loop()
+        try:
+            ctx.exchange(
+                [ReadReq(path=need, buffer_consumer=None)],
+                _Storage(fail_path),
+                loop,
+                "nonce",
+                timeout=30.0,
+            )
+        except BaseException as e:  # noqa: BLE001 - collected per rank
+            errors[rank] = e
+        finally:
+            loop.close()
+
+    # Rank 0 owns blob a (needed by rank 1) and its fetch fails; rank 1
+    # owns blob b (needed by rank 0) and publishes it successfully.
+    t0 = threading.Thread(target=_rank, args=(0, "sharded/b", "sharded/a"))
+    t1 = threading.Thread(target=_rank, args=(1, "sharded/a", "sharded/a"))
+    t0.start(), t1.start()
+    t0.join(60), t1.join(60)
+
+    assert isinstance(errors.get(0), RuntimeError)
+    assert isinstance(errors.get(1), FanoutError)
+    assert store.scan("nonce") == []
+
+
 # ---------------------------------------------------------------------------
 # Owner assignment unit pins
 # ---------------------------------------------------------------------------

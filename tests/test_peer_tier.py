@@ -121,6 +121,10 @@ def test_peer_transport_roundtrip_and_dead_endpoint() -> None:
             "127.0.0.1", server.server_address[1], timeout=5
         )
         entry = compute_checksum_entry(b"hello")
+        # The typed liveness probe: a full request/response round trip
+        # through the dispatch loop (the RPC_PEER_PING handler's paired
+        # client side).
+        assert client.ping() is True
         assert client.push("s", 0, "blob", entry, b"hello") == (True, "ok")
         client.commit("s", 0)
         assert sorted(client.list_step("s")) == ["blob"]
@@ -139,6 +143,8 @@ def test_peer_transport_roundtrip_and_dead_endpoint() -> None:
     dead = peer.PeerClient("127.0.0.1", 1, timeout=0.5)
     with pytest.raises(peer.PeerTransferError):
         dead.request("ping")
+    # ping() maps transport failure to False instead of raising.
+    assert dead.ping() is False
     assert time.monotonic() - t0 < 5.0
 
 

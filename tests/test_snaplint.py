@@ -1031,6 +1031,13 @@ def test_cli_json_output_and_rule_listing():
         "rpc-op-ids",
         "tiered-test-markers",
         "native-decl-sync",
+        # The protocol family (tools/snaplint/protocol/).
+        "store-key-leak",
+        "rank-asymmetric-protocol",
+        "wait-without-error-poll",
+        "rpc-unpaired",
+        "commit-ordering",
+        "store-namespace-docs",
     ):
         assert rule in listing.stdout
 

@@ -1,0 +1,775 @@
+"""Protocol model checker tests: extractor pins (key-template
+normalization, cross-module writer/reader resolution, the namespace
+table), a bad/fixed fixture pair per protocol rule, the suppression and
+baseline round-trips, CLI surfaces (``--protocol``, ``--protocol-dump``,
+``--jobs``), and the clean-on-HEAD lane pins that keep the shipped
+baseline for the family empty.
+
+Fixtures live under ``tmp_path/torchsnapshot_tpu/`` because the
+protocol rules are project-level over the *package*: the model
+extractor sweeps every module under that prefix (with a disk fallback),
+exactly like the names-lint rules.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.snaplint import Analyzer  # noqa: E402
+from tools.snaplint.core import load_project, write_baseline  # noqa: E402
+from tools.snaplint.core import load_baseline  # noqa: E402
+from tools.snaplint.protocol import PROTOCOL_RULE_NAMES  # noqa: E402
+from tools.snaplint.protocol import model as pm  # noqa: E402
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "torchsnapshot_tpu"
+    pkg.mkdir(exist_ok=True)
+    for relname, source in files.items():
+        path = pkg / relname
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    return pkg
+
+
+def _run(tmp_path, files, rule, baseline=None):
+    pkg = _write_pkg(tmp_path, files)
+    analyzer = Analyzer(root=tmp_path, select=[rule])
+    return analyzer.run([pkg], baseline=baseline)
+
+
+def _model(tmp_path, files):
+    pkg = _write_pkg(tmp_path, files)
+    return pm.get_model(load_project([pkg], tmp_path))
+
+
+def _messages(result):
+    return [f.message for f in result.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# model extractor pins
+# ---------------------------------------------------------------------------
+
+
+def test_extractor_normalizes_fstring_format_and_concat_keys(tmp_path):
+    mdl = _model(
+        tmp_path,
+        {
+            "mod.py": """
+PREFIX = "__fam"
+
+def writes(store, topic, seq):
+    store.set(f"{PREFIX}/{topic}/head", b"1")
+    store.set("__fam/{}/announce/{}".format(topic, seq), b"2")
+    store.set(PREFIX + "/" + topic + "/tail", b"3")
+"""
+        },
+    )
+    templates = {s.template for s in mdl.key_sites}
+    assert "__fam/{*}/head" in templates
+    assert "__fam/{*}/announce/{*}" in templates
+    assert "__fam/{*}/tail" in templates
+
+
+def test_extractor_resolves_cross_module_key_helpers(tmp_path):
+    """A single-return key helper in one module normalizes call sites in
+    ANOTHER module to the same template — writer and reader resolve to
+    one family."""
+    mdl = _model(
+        tmp_path,
+        {
+            "keys.py": """
+TOPIC_PREFIX = "__topic"
+
+def head_key(topic):
+    return f"{TOPIC_PREFIX}/{topic}/head"
+""",
+            "writer.py": """
+from .keys import head_key
+
+def publish(store, topic):
+    store.set(head_key(topic), b"1")
+""",
+            "reader.py": """
+from .keys import head_key
+
+def wait(store, topic):
+    return store.get(head_key(topic), 5.0)
+""",
+        },
+    )
+    fams = mdl.families()
+    sites = fams["__topic/{*}/head"]
+    assert {s.role for s in sites} == {"set", "wait"}
+    assert {s.relpath for s in sites} == {
+        "torchsnapshot_tpu/writer.py",
+        "torchsnapshot_tpu/reader.py",
+    }
+
+
+def test_extractor_namespace_table_and_dump_schema(tmp_path):
+    mdl = _model(
+        tmp_path,
+        {
+            "mod.py": """
+def go(store, r):
+    store.set(f"__alpha/{r}/x", b"1")
+    store.delete(f"__alpha/{r}/x")
+    store.set("__beta/flag", b"1")
+    store.delete("__beta/flag")
+    store.set(f"unprefixed/{r}", b"1")
+    store.delete(f"unprefixed/{r}")
+"""
+        },
+    )
+    # Only dunder first segments are namespaces (caller-scoped prefixes
+    # like barrier/fanout nonces are not).
+    assert mdl.namespaces() == ["__alpha", "__beta"]
+    dump = mdl.as_dict()
+    for key in (
+        "version",
+        "namespaces",
+        "key_families",
+        "opaque_deletes",
+        "rpc_ops",
+        "declared_rpc_ops",
+        "crashpoints",
+    ):
+        assert key in dump, key
+
+
+def test_extractor_store_annotated_params_count_as_store(tmp_path):
+    """The bootstrap idiom: ``base: Store`` / ``kv: Store`` receivers
+    are store traffic even though the name has no 'store' in it."""
+    mdl = _model(
+        tmp_path,
+        {
+            "mod.py": """
+from .dist_store import Store
+
+def bootstrap(kv: Store, rank):
+    kv.set("__boot/addr", b"hp")
+
+def unrelated(d, rank):
+    d.set("not/a/store/key", b"1")
+"""
+        },
+    )
+    templates = {s.template for s in mdl.key_sites}
+    assert "__boot/addr" in templates
+    assert not any("not/a" in t for t in templates)
+
+
+# ---------------------------------------------------------------------------
+# store-key-leak
+# ---------------------------------------------------------------------------
+
+_LEAK_BAD = {
+    "mod.py": """
+def publish(store, topic, seq):
+    store.set(f"__t/{topic}/announce/{seq}", b"1")
+"""
+}
+
+# The fix shape: a delete somewhere in the project covers the family —
+# here in a DIFFERENT module, resolved cross-module.
+_LEAK_FIXED = {
+    "mod.py": """
+def publish(store, topic, seq):
+    store.set(f"__t/{topic}/announce/{seq}", b"1")
+""",
+    "reaper.py": """
+def reap(store, topic, seq):
+    store.delete(f"__t/{topic}/announce/{seq}")
+""",
+}
+
+
+def test_store_key_leak_detects_and_accepts_cross_module_fix(tmp_path):
+    bad = _run(tmp_path, _LEAK_BAD, "store-key-leak")
+    assert len(bad.new_findings) == 1
+    assert "__t/{*}/announce/{*}" in bad.new_findings[0].message
+    fixed = _run(tmp_path, _LEAK_FIXED, "store-key-leak")
+    assert fixed.new_findings == []
+
+
+def test_store_key_leak_opaque_delete_in_module_excuses(tmp_path):
+    """An untraceable delete (computed key list) in the same module is
+    conservative cover: the analyzer cannot prove the leak."""
+    result = _run(
+        tmp_path,
+        {
+            "mod.py": """
+def round_trip(store, prefix, keys):
+    store.set(f"__r/{prefix}/data", b"1")
+    store.multi_delete(keys)
+"""
+        },
+        "store-key-leak",
+    )
+    assert result.new_findings == []
+
+
+def test_store_key_leak_inline_suppression(tmp_path):
+    result = _run(
+        tmp_path,
+        {
+            "mod.py": """
+def register(store, service, rank):
+    # Registry semantics: survivors stay discoverable for the run.
+    # snaplint: disable=store-key-leak
+    store.set(f"__reg/{service}/{rank}", b"hp")
+"""
+        },
+        "store-key-leak",
+    )
+    assert result.new_findings == []
+    assert len(result.suppressed) == 1
+
+
+def test_store_key_leak_baseline_round_trip(tmp_path):
+    bad = _run(tmp_path, _LEAK_BAD, "store-key-leak")
+    assert len(bad.new_findings) == 1
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(baseline_file, bad.new_findings)
+    again = _run(
+        tmp_path,
+        _LEAK_BAD,
+        "store-key-leak",
+        baseline=load_baseline(baseline_file),
+    )
+    assert again.new_findings == [] and len(again.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# rank-asymmetric-protocol
+# ---------------------------------------------------------------------------
+
+_ASYM_KNOB_BAD = {
+    "mod.py": """
+from torchsnapshot_tpu import knobs
+
+def publish(store, sess):
+    if knobs.is_cdn_enabled():
+        store.set(f"__sess/{sess}/ready", b"1")
+    store.delete(f"__sess/{sess}/ready")
+
+def wait(store, sess):
+    return store.get(f"__sess/{sess}/ready", 5.0)
+"""
+}
+
+_ASYM_KNOB_FIXED = {
+    "mod.py": """
+from torchsnapshot_tpu import knobs
+
+def publish(store, sess):
+    store.set(f"__sess/{sess}/ready", b"1")
+    store.delete(f"__sess/{sess}/ready")
+
+def wait(store, sess):
+    return store.get(f"__sess/{sess}/ready", 5.0)
+"""
+}
+
+
+def test_rank_asym_knob_guarded_set_with_unguarded_wait(tmp_path):
+    bad = _run(tmp_path, _ASYM_KNOB_BAD, "rank-asymmetric-protocol")
+    assert len(bad.new_findings) == 1
+    assert "knob/env guard" in bad.new_findings[0].message
+    fixed = _run(tmp_path, _ASYM_KNOB_FIXED, "rank-asymmetric-protocol")
+    assert fixed.new_findings == []
+
+
+_ASYM_CHAIN_BAD = {
+    "mod.py": """
+def _commit_metadata(store, rank, world):
+    store.barrier("commit", rank, world)
+
+def save(store, rank, world):
+    if rank == 0:
+        _commit_metadata(store, rank, world)
+"""
+}
+
+_ASYM_CHAIN_FIXED = {
+    "mod.py": """
+def _commit_metadata(store, rank, world):
+    store.barrier("commit", rank, world)
+
+def save(store, rank, world):
+    _commit_metadata(store, rank, world)
+"""
+}
+
+
+def test_rank_asym_collective_reached_through_call_chain(tmp_path):
+    """The PR 2 bug class across a function boundary: the direct rule
+    cannot see it (the collective itself is unconditional inside the
+    helper), the model's call graph can."""
+    direct = _run(tmp_path, _ASYM_CHAIN_BAD, "collective-under-conditional")
+    assert direct.new_findings == []
+    bad = _run(tmp_path, _ASYM_CHAIN_BAD, "rank-asymmetric-protocol")
+    assert len(bad.new_findings) == 1
+    assert "_commit_metadata" in bad.new_findings[0].message
+    fixed = _run(tmp_path, _ASYM_CHAIN_FIXED, "rank-asymmetric-protocol")
+    assert fixed.new_findings == []
+
+
+def test_rank_asym_ambiguous_callee_names_do_not_convict(tmp_path):
+    """`get`-shaped names defined more than once never enter the call
+    graph — a name-based edge through them would convict half the
+    codebase."""
+    result = _run(
+        tmp_path,
+        {
+            "a.py": """
+def helper(store, rank, world):
+    store.barrier("x", rank, world)
+""",
+            "b.py": """
+def helper(value):
+    return value
+
+def save(store, rank, world):
+    if rank == 0:
+        helper(store)
+""",
+        },
+        "rank-asymmetric-protocol",
+    )
+    assert result.new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# wait-without-error-poll
+# ---------------------------------------------------------------------------
+
+_WAIT_BAD = {
+    "mod.py": """
+import time
+
+def wait(store, key):
+    while True:
+        val = store.try_get(key)
+        if val is not None:
+            return val
+        time.sleep(0.05)
+"""
+}
+
+# Two blessed shapes: poll the round's error key in the same batched
+# read, or ride the shared exponential pacer.
+_WAIT_FIXED_ERROR = {
+    "mod.py": """
+import time
+
+def wait(store, key, prefix):
+    while True:
+        got = store.multi_get([key, f"{prefix}/error"])
+        if got.get(key) is not None:
+            return got[key]
+        time.sleep(0.05)
+"""
+}
+
+_WAIT_FIXED_PACER = {
+    "mod.py": """
+def wait(store, key, pacer, deadline):
+    while True:
+        val = store.try_get(key)
+        if val is not None:
+            return val
+        pacer.sleep(deadline)
+"""
+}
+
+
+def test_wait_without_error_poll_detects_and_accepts_fixes(tmp_path):
+    bad = _run(tmp_path, _WAIT_BAD, "wait-without-error-poll")
+    assert len(bad.new_findings) == 1
+    assert "error key" in bad.new_findings[0].message
+    for fixed in (_WAIT_FIXED_ERROR, _WAIT_FIXED_PACER):
+        assert _run(tmp_path, fixed, "wait-without-error-poll").new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# rpc-unpaired
+# ---------------------------------------------------------------------------
+
+_RPC_BAD = {
+    "client.py": """
+from .telemetry import names as metric_names
+
+class Client:
+    def request(self, cmd, *args):
+        return None
+
+    def evict(self, step):
+        return self.request(metric_names.RPC_TIER_EVICT, step)
+""",
+    "server.py": """
+from .telemetry import names as metric_names
+
+def dispatch(cmd, args):
+    if cmd == metric_names.RPC_TIER_PUSH:
+        return "pushed"
+    return None
+""",
+}
+
+_RPC_FIXED = {
+    "client.py": """
+from .telemetry import names as metric_names
+
+class Client:
+    def request(self, cmd, *args):
+        return None
+
+    def evict(self, step):
+        return self.request(metric_names.RPC_TIER_EVICT, step)
+
+    def push(self, step):
+        return self.request(metric_names.RPC_TIER_PUSH, step)
+""",
+    "server.py": """
+from .telemetry import names as metric_names
+
+def dispatch(cmd, args):
+    if cmd == metric_names.RPC_TIER_PUSH:
+        return "pushed"
+    if cmd == metric_names.RPC_TIER_EVICT:
+        return "evicted"
+    return None
+""",
+}
+
+
+def test_rpc_unpaired_both_directions_and_fix(tmp_path):
+    bad = _run(tmp_path, _RPC_BAD, "rpc-unpaired")
+    msgs = _messages(bad)
+    assert len(msgs) == 2
+    assert any("RPC_TIER_EVICT" in m and "no server dispatch" in m for m in msgs)
+    assert any("RPC_TIER_PUSH" in m and "no client call site" in m for m in msgs)
+    fixed = _run(tmp_path, _RPC_FIXED, "rpc-unpaired")
+    assert fixed.new_findings == []
+
+
+_FRAME_BAD = {
+    "mod.py": """
+from .framing import send_frame, recv_frame
+
+def talk(sock, payload):
+    send_frame(sock, payload)
+    return recv_frame(sock)
+"""
+}
+
+_FRAME_FIXED = {
+    "mod.py": """
+from .framing import send_frame, recv_frame
+from .telemetry import wire
+from .telemetry import names as metric_names
+
+def talk(sock, payload):
+    with wire.propagate(metric_names.RPC_TIER_PUSH):
+        send_frame(sock, payload)
+        return recv_frame(sock)
+
+def serve(sock, ctx):
+    wire.set_received_context(ctx)
+    send_frame(sock, b"reply")
+""",
+    "server2.py": """
+from .telemetry import names as metric_names
+
+class Client:
+    def request(self, cmd):
+        return None
+
+    def push(self):
+        return self.request(metric_names.RPC_TIER_PUSH)
+
+def dispatch(cmd):
+    if cmd == metric_names.RPC_TIER_PUSH:
+        return True
+""",
+}
+
+
+def test_rpc_frames_outside_propagate_scope(tmp_path):
+    bad = _run(tmp_path, _FRAME_BAD, "rpc-unpaired")
+    msgs = _messages(bad)
+    assert len(msgs) == 2  # send + recv
+    assert all("wire.propagate" in m for m in msgs)
+    # In a propagate scope (client) or adopting the received context
+    # (server): invisible-to-observatory findings clear.
+    fixed = _run(tmp_path, _FRAME_FIXED, "rpc-unpaired")
+    assert fixed.new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# commit-ordering
+# ---------------------------------------------------------------------------
+
+_ORDER_BAD_MARKER_FIRST = {
+    "mod.py": """
+def publish(store, topic, seq):
+    store.set(f"__t/{topic}/head", str(seq).encode())
+    store.set(f"__t/{topic}/announce/{seq}", b"payload")
+    store.delete(f"__t/{topic}/head")
+    store.delete(f"__t/{topic}/announce/{seq}")
+"""
+}
+
+_ORDER_BAD_NO_CRASHPOINT = {
+    "mod.py": """
+def publish(store, topic, seq):
+    store.set(f"__t/{topic}/announce/{seq}", b"payload")
+    store.set(f"__t/{topic}/head", str(seq).encode())
+    store.delete(f"__t/{topic}/head")
+    store.delete(f"__t/{topic}/announce/{seq}")
+"""
+}
+
+_ORDER_FIXED = {
+    "mod.py": """
+from .chaos import crashpoint
+from .telemetry import names as metric_names
+
+def publish(store, topic, seq):
+    store.set(f"__t/{topic}/announce/{seq}", b"payload")
+    crashpoint(metric_names.CRASH_PUBLISH_ANNOUNCED)
+    store.set(f"__t/{topic}/head", str(seq).encode())
+    store.delete(f"__t/{topic}/head")
+    store.delete(f"__t/{topic}/announce/{seq}")
+""",
+    "telemetry/names.py": """
+CRASH_PUBLISH_ANNOUNCED = "publish_announced"
+""",
+}
+
+
+def test_commit_ordering_marker_before_payload(tmp_path):
+    bad = _run(tmp_path, _ORDER_BAD_MARKER_FIRST, "commit-ordering")
+    assert len(bad.new_findings) == 1
+    assert "written before payload" in bad.new_findings[0].message
+
+
+def test_commit_ordering_marker_last_needs_crashpoint(tmp_path):
+    bad = _run(tmp_path, _ORDER_BAD_NO_CRASHPOINT, "commit-ordering")
+    assert len(bad.new_findings) == 1
+    assert "no crashpoint()" in bad.new_findings[0].message
+    fixed = _run(tmp_path, _ORDER_FIXED, "commit-ordering")
+    assert fixed.new_findings == []
+
+
+def test_commit_ordering_flags_unthreaded_crash_declaration(tmp_path):
+    result = _run(
+        tmp_path,
+        {
+            "telemetry/names.py": """
+CRASH_NEVER_THREADED = "never_threaded"
+"""
+        },
+        "commit-ordering",
+    )
+    assert len(result.new_findings) == 1
+    finding = result.new_findings[0]
+    assert finding.path == "torchsnapshot_tpu/telemetry/names.py"
+    assert "CRASH_NEVER_THREADED" in finding.message
+
+
+# ---------------------------------------------------------------------------
+# store-namespace-docs
+# ---------------------------------------------------------------------------
+
+
+def _run_ns_docs(tmp_path, table_rows):
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    lines = ["# scaling", "", "| namespace | owner |", "|---|---|"]
+    lines += [f"| `{ns}/...` | x |" for ns in table_rows]
+    (tmp_path / "docs" / "scaling.md").write_text("\n".join(lines) + "\n")
+    return _run(
+        tmp_path,
+        {
+            "mod.py": """
+def go(store, r):
+    store.set(f"__real/{r}", b"1")
+    store.delete(f"__real/{r}")
+"""
+        },
+        "store-namespace-docs",
+    )
+
+
+def test_namespace_docs_sync_both_directions(tmp_path):
+    missing = _run_ns_docs(tmp_path, [])
+    assert len(missing.new_findings) == 1
+    assert "'__real/' is used in the code but missing" in (
+        missing.new_findings[0].message
+    )
+
+    stale = _run_ns_docs(tmp_path, ["__real", "__ghost"])
+    assert len(stale.new_findings) == 1
+    assert "'__ghost/'" in stale.new_findings[0].message
+
+    in_sync = _run_ns_docs(tmp_path, ["__real"])
+    assert in_sync.new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# performance satellites: shared parse cache + --jobs parity
+# ---------------------------------------------------------------------------
+
+
+def test_jobs_parallel_findings_match_serial(tmp_path):
+    """``--jobs N`` must be a pure speedup: identical findings, same
+    order, over a project with violations for several rule families."""
+    files = dict(_LEAK_BAD)
+    files.update(_WAIT_BAD)
+    files["rpc.py"] = _RPC_BAD["client.py"]
+    _write_pkg(tmp_path, files)
+    analyzer = Analyzer(root=tmp_path)
+    serial = analyzer.run([tmp_path / "torchsnapshot_tpu"], baseline=set())
+    parallel = Analyzer(root=tmp_path).run(
+        [tmp_path / "torchsnapshot_tpu"], baseline=set(), jobs=4
+    )
+    assert [f.render() for f in serial.new_findings] == [
+        f.render() for f in parallel.new_findings
+    ]
+    assert serial.new_findings  # the parity check is not vacuous
+
+
+def test_shared_parse_cache_reuses_modules(tmp_path):
+    from tools.snaplint.core import load_module_cached
+
+    f = tmp_path / "m.py"
+    f.write_text("x = 1\n")
+    first = load_module_cached(f, tmp_path)
+    assert load_module_cached(f, tmp_path) is first
+    # An edit invalidates by (mtime_ns, size).
+    f.write_text("x = 2  # changed\n")
+    assert load_module_cached(f, tmp_path) is not first
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_cli_protocol_flag_selects_family_and_is_clean_on_head():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "tools.snaplint",
+            "--protocol",
+            "torchsnapshot_tpu",
+            "--json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["new_findings"] == []
+
+
+def test_cli_protocol_dump_is_machine_readable():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "tools.snaplint",
+            "--protocol-dump",
+            "torchsnapshot_tpu",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    dump = json.loads(proc.stdout)
+    # The real coordination plane's namespace inventory (mirrored by the
+    # docs/scaling.md table, kept in sync by store-namespace-docs).
+    for ns in ("__cdn", "__endpoint", "__obs", "__preemption", "__ts"):
+        assert ns in dump["namespaces"], ns
+    templates = {f["template"] for f in dump["key_families"]}
+    assert "__cdn/{*}/head" in templates
+    assert "__cdn/{*}/announce/{*}" in templates
+    assert any(op.startswith("RPC_PEER_") for op in dump["rpc_ops"])
+
+
+def test_list_rules_includes_protocol_family():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.snaplint", "--list-rules"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    for name in PROTOCOL_RULE_NAMES:
+        assert name in proc.stdout, name
+
+
+# ---------------------------------------------------------------------------
+# clean-on-HEAD lane pins
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_family_clean_on_head_with_empty_baseline():
+    """The acceptance gate: every protocol rule over the real package
+    with NO baseline. A finding here is a real protocol defect (fix it
+    in source) or a justified exception (inline suppression with a
+    comment) — never a baseline entry."""
+    analyzer = Analyzer(root=REPO, select=list(PROTOCOL_RULE_NAMES))
+    result = analyzer.run([REPO / "torchsnapshot_tpu"], baseline=set())
+    assert result.new_findings == [], "\n".join(
+        f.render() for f in result.new_findings
+    )
+
+
+def test_crash_registry_fully_threaded_on_head():
+    """Every declared CRASH_* id is threaded through at least one
+    crashpoint() call site — the chaos matrix has no rows that can
+    never fire (and the declared registry is non-trivial)."""
+    project = load_project([REPO / "torchsnapshot_tpu"], REPO)
+    mdl = pm.get_model(project)
+    declared = set(mdl.declared_crashpoints)
+    threaded = {s.const for s in mdl.crash_sites}
+    assert declared, "no declared CRASH_* ids extracted"
+    assert declared <= threaded, sorted(declared - threaded)
+
+
+def test_head_model_knows_the_coordination_plane():
+    """Spot pins against the real package: the extracted model sees the
+    plane's load-bearing families and RPC surface."""
+    project = load_project([REPO / "torchsnapshot_tpu"], REPO)
+    mdl = pm.get_model(project)
+    fams = mdl.families()
+    # CDN announce family: written by the publisher, reaped by its
+    # retention delete (the PR's store-key-leak fix).
+    announce = fams["__cdn/{*}/announce/{*}"]
+    assert {s.role for s in announce} >= {"set", "delete"}
+    # Peer RPC ops pair: every request op has a handler and vice versa.
+    by_op = {}
+    for site in mdl.rpc_sites:
+        by_op.setdefault(site.op, set()).add(site.role)
+    peer_ops = {
+        op: roles for op, roles in by_op.items() if op.startswith("RPC_PEER_")
+    }
+    assert peer_ops
+    for op, roles in peer_ops.items():
+        if "request" in roles or "handler" in roles:
+            assert {"request", "handler"} <= roles, (op, roles)

@@ -72,6 +72,25 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    parser.add_argument(
+        "--protocol",
+        action="store_true",
+        help="run only the protocol rule family (coordination-plane model)",
+    )
+    parser.add_argument(
+        "--protocol-dump",
+        action="store_true",
+        help="print the extracted protocol model as JSON and exit "
+        "(no rules run)",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help="fan the rules out across N forked worker processes "
+        "(default: 1, serial)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -81,6 +100,10 @@ def main(argv=None) -> int:
 
     root = Path(args.root)
     select = args.select.split(",") if args.select else None
+    if args.protocol:
+        from .protocol import PROTOCOL_RULE_NAMES
+
+        select = list(PROTOCOL_RULE_NAMES)
     disable = args.disable.split(",") if args.disable else None
     try:
         analyzer = Analyzer(root=root, select=select, disable=disable)
@@ -101,10 +124,22 @@ def main(argv=None) -> int:
         )
         return 2
 
+    if args.protocol_dump:
+        from .core import load_project
+        from .protocol import model as protocol_model
+
+        project = load_project(paths, root)
+        print(
+            json.dumps(
+                protocol_model.get_model(project).as_dict(), indent=2
+            )
+        )
+        return 0
+
     baseline = (
         [] if args.no_baseline else load_baseline(Path(args.baseline))
     )
-    result = analyzer.run(paths, baseline=baseline)
+    result = analyzer.run(paths, baseline=baseline, jobs=max(1, args.jobs))
 
     if args.write_baseline:
         write_baseline(Path(args.baseline), result.findings)

@@ -161,6 +161,28 @@ def all_rules() -> Dict[str, Type[Rule]]:
     return dict(_REGISTRY)
 
 
+# One parsed-AST cache per process, shared by every consumer that loads
+# modules — the project loader, the analyzer's suppression side-loads,
+# the names-lint disk fallback, and the protocol model's package sweep.
+# Keyed by (resolved path, root), validated by (mtime_ns, size) so an
+# edited file re-parses while a 13-rule run over 100+ files parses each
+# file exactly once.
+_MODULE_CACHE: Dict[tuple, tuple] = {}
+
+
+def load_module_cached(path: Path, root: Path) -> ModuleInfo:
+    resolved = Path(path).resolve()
+    stat = resolved.stat()
+    cache_key = (str(resolved), str(Path(root).resolve()))
+    stamp = (stat.st_mtime_ns, stat.st_size)
+    hit = _MODULE_CACHE.get(cache_key)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    module = ModuleInfo.load(path, root)
+    _MODULE_CACHE[cache_key] = (stamp, module)
+    return module
+
+
 def load_project(paths: Sequence[Path], root: Path) -> Project:
     """Parse every ``.py`` under ``paths`` once; syntax errors become
     ``parse-error`` findings rather than aborting the run."""
@@ -188,7 +210,7 @@ def load_project(paths: Sequence[Path], root: Path) -> Project:
     parse_errors: List[Finding] = []
     for f in files:
         try:
-            modules.append(ModuleInfo.load(f, root))
+            modules.append(load_module_cached(f, root))
         except SyntaxError as e:
             try:
                 rel = f.resolve().relative_to(root.resolve()).as_posix()
@@ -247,13 +269,11 @@ class Analyzer:
         self,
         paths: Sequence[Path],
         baseline: Optional[Sequence[str]] = None,
+        jobs: int = 1,
     ) -> "RunResult":
         project = load_project(paths, self.root)
         raw: List[Finding] = list(project.parse_errors)
-        for rule in self.rules:
-            for module in project.modules:
-                raw.extend(rule.check_module(module, project))
-            raw.extend(rule.check_project(project))
+        raw.extend(self._run_rules(project, jobs))
         raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
 
         kept: List[Finding] = []
@@ -268,7 +288,7 @@ class Analyzer:
                 if f.path not in side_loaded:
                     candidate = self.root / f.path
                     try:
-                        side_loaded[f.path] = ModuleInfo.load(
+                        side_loaded[f.path] = load_module_cached(
                             candidate, self.root
                         )
                     except (OSError, SyntaxError):
@@ -299,6 +319,59 @@ class Analyzer:
             suppressed=suppressed,
             project=project,
         )
+
+    def _run_rules(self, project: Project, jobs: int) -> List[Finding]:
+        """Run every selected rule over the loaded project, optionally
+        fanning the *rules* out across ``jobs`` forked workers. Findings
+        are identical to the serial path by construction: the same rule
+        set runs over the same shared trees, and the caller sorts the
+        merged list with the same key either way."""
+        rule_names = [r.name for r in self.rules]
+        if jobs > 1 and len(rule_names) > 1:
+            chunks = [rule_names[i::jobs] for i in range(jobs)]
+            chunks = [c for c in chunks if c]
+            try:
+                import multiprocessing as mp
+
+                # fork is what makes this cheap: workers inherit the
+                # parsed project copy-on-write instead of re-parsing or
+                # pickling ASTs. Elsewhere (spawn-only platforms), fall
+                # back to serial rather than pay a slower parallel path.
+                ctx = mp.get_context("fork")
+                global _WORKER_PROJECT
+                _WORKER_PROJECT = project
+                try:
+                    with ctx.Pool(processes=len(chunks)) as pool:
+                        parts = pool.map(_run_rule_chunk, chunks)
+                finally:
+                    _WORKER_PROJECT = None
+                return [f for part in parts for f in part]
+            except (ImportError, ValueError, OSError):
+                pass
+        findings: List[Finding] = []
+        for rule in self.rules:
+            for module in project.modules:
+                findings.extend(rule.check_module(module, project))
+            findings.extend(rule.check_project(project))
+        return findings
+
+
+_WORKER_PROJECT: Optional[Project] = None
+
+
+def _run_rule_chunk(rule_names: Sequence[str]) -> List["Finding"]:
+    """Worker body for ``--jobs``: run a subset of rules over the
+    fork-inherited project."""
+    project = _WORKER_PROJECT
+    assert project is not None
+    rules = all_rules()
+    findings: List[Finding] = []
+    for name in rule_names:
+        rule = rules[name]()
+        for module in project.modules:
+            findings.extend(rule.check_module(module, project))
+        findings.extend(rule.check_project(project))
+    return findings
 
 
 @dataclass

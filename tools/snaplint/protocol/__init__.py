@@ -1,0 +1,11 @@
+"""Protocol model checker: a cross-module model of the coordination
+plane (store key families, RPC ops, wire-context scopes, durable-write
+orderings, crash points) plus the rules that run on it.
+
+``model.py`` extracts the model from the package's ASTs; ``rules.py``
+registers the protocol rule family in the shared snaplint registry.
+``PROTOCOL_RULE_NAMES`` is the family list the CLI's ``--protocol``
+lane selects.
+"""
+
+from .rules import PROTOCOL_RULE_NAMES  # noqa: F401

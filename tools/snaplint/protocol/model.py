@@ -1,0 +1,893 @@
+"""Extract the coordination-plane protocol model from the package ASTs.
+
+The model is what the protocol rules share: every store key op with its
+normalized key *template*, every RPC op site (client request / server
+handler / ``wire.propagate`` scope / raw frame call), every
+``crashpoint`` site, and the ordered durable-write sequences per
+function. One build per :class:`~tools.snaplint.core.Project`, cached
+on the project object.
+
+Key templates
+-------------
+A key expression normalizes to a ``/``-separated template whose
+unresolvable parts are the placeholder ``{*}``:
+
+- ``f"{OBS_PREFIX}/{role}/{ident}"``            -> ``__obs/{*}/{*}``
+  (module constants resolve; locals resolve through one intraprocedural
+  pass; everything else is a placeholder)
+- ``head_key(topic)``                           -> ``__cdn/{*}/head``
+  (single-``return`` key helpers inline cross-module, parameters bound
+  to the call site's normalized arguments)
+- ``self._key("flag")``                         -> ``__preemption/{*}/flag``
+  (``self.X`` resolves through the enclosing class's attribute
+  assignments; ``self._key`` resolves to the enclosing class's method)
+- ``"{}/chunk".format(n)`` / ``"%s/c" % n``     -> ``{*}/chunk`` etc.
+
+Two templates *unify* segment-wise (equal literal, or either side a
+placeholder) — that is how a ``multi_delete`` is matched against the
+``set`` family it tears down. A delete whose keys cannot be normalized
+at all (an accumulated list threaded through callbacks) is recorded as
+an *opaque* delete: it conservatively excuses set-families in its own
+module, because static analysis cannot prove what it covers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import (
+    Container,
+    Dict,
+    FrozenSet,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+from .. import scopes
+from ..core import ModuleInfo, Project, load_module_cached
+
+PLACEHOLDER = "{*}"
+PACKAGE_PREFIX = "torchsnapshot_tpu/"
+NAMES_RELPATH = "torchsnapshot_tpu/telemetry/names.py"
+
+# Store primitives, by role.
+SET_OPS = {"set", "multi_set", "add"}
+DELETE_OPS = {"delete", "multi_delete"}
+READ_OPS = {"try_get", "multi_get", "scan"}
+BLOCKING_OPS = {"get", "wait_any"}
+STORE_OPS = SET_OPS | DELETE_OPS | READ_OPS | BLOCKING_OPS
+
+_FORMAT_FIELD_RE = re.compile(r"\{[^{}]*\}")
+_PRINTF_FIELD_RE = re.compile(r"%[sdrif]")
+_MULTI_PLACEHOLDER_RE = re.compile(r"(\{\*\})+")
+
+_INLINE_DEPTH = 4  # key-helper inlining recursion bound
+
+
+@dataclass
+class KeySite:
+    """One store primitive call on one key template."""
+
+    relpath: str
+    line: int
+    col: int
+    op: str  # the Store method name
+    template: str
+    func: str  # enclosing function qualname ("" at module level)
+    rank_guarded: bool = False
+    knob_guarded: bool = False
+
+    @property
+    def role(self) -> str:
+        if self.op in SET_OPS:
+            return "set"
+        if self.op in DELETE_OPS:
+            return "delete"
+        if self.op in BLOCKING_OPS:
+            return "wait"
+        return "read"
+
+
+@dataclass
+class RpcSite:
+    relpath: str
+    line: int
+    role: str  # "request" | "handler" | "propagate"
+    op: str  # the RPC_* constant name
+
+
+@dataclass
+class FrameSite:
+    relpath: str
+    line: int
+    kind: str  # "send" | "recv"
+    func: str
+    in_propagate: bool  # lexically inside a ``with *.propagate(...)``
+    adopts_context: bool  # enclosing function reads the received context
+
+
+@dataclass
+class CrashSite:
+    relpath: str
+    line: int
+    const: str  # the CRASH_* constant name
+
+
+@dataclass
+class WriteSeq:
+    """Ordered durable store writes within one function, plus the crash
+    points threaded through it — the commit-ordering rule's unit."""
+
+    relpath: str
+    func: str
+    writes: List[KeySite] = field(default_factory=list)
+    crash_lines: List[int] = field(default_factory=list)
+
+
+@dataclass
+class ProtocolModel:
+    key_sites: List[KeySite] = field(default_factory=list)
+    opaque_deletes: List[KeySite] = field(default_factory=list)
+    rpc_sites: List[RpcSite] = field(default_factory=list)
+    frame_sites: List[FrameSite] = field(default_factory=list)
+    crash_sites: List[CrashSite] = field(default_factory=list)
+    write_seqs: List[WriteSeq] = field(default_factory=list)
+    declared_crashpoints: Dict[str, int] = field(default_factory=dict)
+    declared_rpc_ops: Dict[str, int] = field(default_factory=dict)
+
+    # -- derived views ----------------------------------------------------
+
+    def families(self) -> Dict[str, List[KeySite]]:
+        """Key sites grouped by exact template."""
+        out: Dict[str, List[KeySite]] = {}
+        for site in self.key_sites:
+            out.setdefault(site.template, []).append(site)
+        return out
+
+    def namespaces(self) -> List[str]:
+        """Reserved dunder namespaces (first template segment)."""
+        seen: Set[str] = set()
+        for site in self.key_sites:
+            head = site.template.split("/", 1)[0]
+            if head.startswith("__") and PLACEHOLDER not in head:
+                seen.add(head)
+        return sorted(seen)
+
+    def as_dict(self) -> Dict:
+        """The ``--protocol-dump`` inventory: one entry per key family
+        (who sets/reads/waits/deletes, under which guards), the RPC op
+        table, and the crash-point registry."""
+        fam_rows = []
+        for template in sorted(self.families()):
+            sites = self.families()[template]
+            row: Dict = {"template": template, "ops": {}}
+            for site in sites:
+                row["ops"].setdefault(site.role, []).append(
+                    {
+                        "path": site.relpath,
+                        "line": site.line,
+                        "op": site.op,
+                        "rank_guarded": site.rank_guarded,
+                        "knob_guarded": site.knob_guarded,
+                    }
+                )
+            fam_rows.append(row)
+        rpc_rows: Dict[str, Dict[str, List]] = {}
+        for site in self.rpc_sites:
+            rpc_rows.setdefault(site.op, {}).setdefault(site.role, []).append(
+                f"{site.relpath}:{site.line}"
+            )
+        return {
+            "version": 1,
+            "namespaces": self.namespaces(),
+            "key_families": fam_rows,
+            "opaque_deletes": [
+                f"{s.relpath}:{s.line}" for s in self.opaque_deletes
+            ],
+            "rpc_ops": rpc_rows,
+            "declared_rpc_ops": sorted(self.declared_rpc_ops),
+            "crashpoints": {
+                const: sorted(
+                    f"{s.relpath}:{s.line}"
+                    for s in self.crash_sites
+                    if s.const == const
+                )
+                for const in sorted(self.declared_crashpoints)
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# Template machinery
+
+
+def collapse(template: str) -> str:
+    return _MULTI_PLACEHOLDER_RE.sub(PLACEHOLDER, template)
+
+
+def segments(template: str) -> List[str]:
+    return [
+        PLACEHOLDER if PLACEHOLDER in seg else seg
+        for seg in collapse(template).split("/")
+    ]
+
+
+def unifies(a: str, b: str) -> bool:
+    """Do two templates describe the same key family? Segment-wise:
+    equal literals, or either side a placeholder."""
+    sa, sb = segments(a), segments(b)
+    if len(sa) != len(sb):
+        return False
+    return all(
+        x == y or x == PLACEHOLDER or y == PLACEHOLDER
+        for x, y in zip(sa, sb)
+    )
+
+
+def is_opaque(template: str) -> bool:
+    """No literal content survived normalization."""
+    return all(seg == PLACEHOLDER for seg in segments(template))
+
+
+class _Env:
+    """Name-resolution context for one call site: locals of the
+    enclosing function, explicit parameter bindings (helper inlining),
+    module constants, ``self.X`` class attributes, and the key-helper
+    tables."""
+
+    def __init__(
+        self,
+        extractor: "_Extractor",
+        module: ModuleInfo,
+        local_templates: Dict[str, str],
+        bindings: Optional[Dict[str, str]] = None,
+        cls: Optional[str] = None,
+    ) -> None:
+        self.extractor = extractor
+        self.module = module
+        self.local_templates = local_templates
+        self.bindings = bindings or {}
+        self.cls = cls
+
+
+def _normalize(expr: ast.AST, env: _Env, depth: int = 0) -> str:
+    """Best-effort key template for ``expr`` (always returns a string;
+    unresolvable parts become placeholders)."""
+    if depth > _INLINE_DEPTH:
+        return PLACEHOLDER
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            return expr.value
+        return PLACEHOLDER
+    if isinstance(expr, ast.JoinedStr):
+        parts = []
+        for part in expr.values:
+            if isinstance(part, ast.FormattedValue):
+                parts.append(_normalize(part.value, env, depth + 1))
+            else:
+                parts.append(_normalize(part, env, depth + 1))
+        return collapse("".join(parts))
+    if isinstance(expr, ast.Name):
+        if expr.id in env.bindings:
+            return env.bindings[expr.id]
+        if expr.id in env.local_templates:
+            return env.local_templates[expr.id]
+        const = env.extractor.module_consts.get(env.module.relpath, {}).get(
+            expr.id
+        )
+        if const is not None:
+            return const
+        return PLACEHOLDER
+    if isinstance(expr, ast.Attribute):
+        chain = scopes.attr_chain(expr)
+        if len(chain) == 2 and chain[0] == "self" and env.cls:
+            attr = env.extractor.class_attrs.get(
+                (env.module.relpath, env.cls), {}
+            ).get(chain[1])
+            if attr is not None:
+                return attr
+        if len(chain) == 2:
+            # MODULE.CONST through an import is rare for keys; try the
+            # bare constant name in any package module as a fallback.
+            const = env.extractor.global_consts.get(chain[1])
+            if const is not None:
+                return const
+        return PLACEHOLDER
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return collapse(
+            _normalize(expr.left, env, depth + 1)
+            + _normalize(expr.right, env, depth + 1)
+        )
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mod):
+        left = _normalize(expr.left, env, depth + 1)
+        return collapse(_PRINTF_FIELD_RE.sub(PLACEHOLDER, left))
+    if isinstance(expr, ast.Call):
+        chain = scopes.call_chain(expr)
+        terminal = chain[-1] if chain else None
+        # The receiver of ``.format`` is often a string literal, where
+        # attr_chain (and thus ``terminal``) is empty — match on the
+        # attribute itself.
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "format":
+            base = _normalize(expr.func.value, env, depth + 1)
+            return collapse(_FORMAT_FIELD_RE.sub(PLACEHOLDER, base))
+        if terminal == "str" and expr.args:
+            return _normalize(expr.args[0], env, depth + 1)
+        helper = env.extractor.resolve_helper(env, chain)
+        if helper is not None:
+            h_module, h_fn, h_cls = helper
+            params = [
+                a.arg
+                for a in h_fn.args.args
+                if a.arg not in ("self", "cls")
+            ]
+            bound: Dict[str, str] = {}
+            for i, param in enumerate(params):
+                if i < len(expr.args):
+                    bound[param] = _normalize(expr.args[i], env, depth + 1)
+                else:
+                    bound[param] = PLACEHOLDER
+            for kw in expr.keywords:
+                if kw.arg:
+                    bound[kw.arg] = _normalize(kw.value, env, depth + 1)
+            ret = env.extractor.helper_return(h_fn)
+            if ret is not None:
+                h_env = _Env(
+                    env.extractor,
+                    h_module,
+                    {},
+                    bindings=bound,
+                    cls=h_cls,
+                )
+                return _normalize(ret, h_env, depth + 1)
+        return PLACEHOLDER
+    return PLACEHOLDER
+
+
+def _key_args(call: ast.Call, op: str) -> Tuple[List[ast.AST], bool]:
+    """The key expression(s) of a store-op call, plus whether the arg
+    shape itself was resolvable (a Name arg is resolved later)."""
+    if not call.args:
+        return [], False
+    return [call.args[0]], True
+
+
+def _iter_container_keys(
+    expr: ast.AST, env: _Env, fn: Optional[ast.AST]
+) -> Tuple[List[str], bool]:
+    """Key templates flowing into a list/dict argument (``multi_set``
+    items, ``multi_get``/``multi_delete`` key lists). Returns
+    ``(templates, resolved)`` — ``resolved`` False means the container
+    could not be traced (an opaque batch)."""
+    if isinstance(expr, ast.Dict):
+        return [_normalize(k, env) for k in expr.keys if k is not None], True
+    if isinstance(expr, ast.DictComp):
+        return [_normalize(expr.key, env)], True
+    if isinstance(expr, (ast.List, ast.Tuple, ast.Set)):
+        out: List[str] = []
+        ok = True
+        for elt in expr.elts:
+            if isinstance(elt, ast.Starred):
+                sub, sub_ok = _iter_container_keys(elt.value, env, fn)
+                out.extend(sub)
+                ok = ok and sub_ok
+            else:
+                out.append(_normalize(elt, env))
+        return out, ok
+    if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return [_normalize(expr.elt, env)], True
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left, lok = _iter_container_keys(expr.left, env, fn)
+        right, rok = _iter_container_keys(expr.right, env, fn)
+        return left + right, lok and rok
+    if isinstance(expr, ast.Call):
+        chain = scopes.call_chain(expr)
+        if chain and chain[-1] in ("list", "sorted", "set", "tuple") and expr.args:
+            return _iter_container_keys(expr.args[0], env, fn)
+        return [], False
+    if isinstance(expr, ast.Name) and fn is not None:
+        # Resolve the container through local dataflow: literal/comp
+        # assignments, ``name.append(...)`` and ``name[key] = ...``.
+        templates: List[str] = []
+        resolved = False
+        opaque_flow = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                        sub, ok = _iter_container_keys(node.value, env, fn)
+                        if isinstance(node.value, (ast.List, ast.Dict,
+                                                   ast.ListComp, ast.DictComp,
+                                                   ast.SetComp, ast.BinOp,
+                                                   ast.Tuple, ast.Set,
+                                                   ast.GeneratorExp, ast.Call)):
+                            templates.extend(sub)
+                            resolved = resolved or ok
+                            opaque_flow = opaque_flow or not ok
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == expr.id
+                    ):
+                        templates.append(_normalize(tgt.slice, env))
+                        resolved = True
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("append", "add", "extend")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == expr.id
+                and node.args
+            ):
+                tpl = _normalize(node.args[0], env)
+                templates.append(tpl)
+                resolved = True
+                if is_opaque(tpl):
+                    opaque_flow = True
+        if opaque_flow:
+            return templates, False
+        return templates, resolved
+    return [], False
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+
+
+def _is_store_receiver(
+    chain: List[str], store_params: Container[str] = ()
+) -> bool:
+    """Does the call receiver look like a coordination store? Matches
+    ``store.set`` / ``self._store.multi_set`` / ``cas_store.delete``,
+    plus any receiver named in ``store_params`` (parameters of the
+    enclosing function annotated ``Store`` — the bootstrap helpers call
+    theirs ``base``/``kv``); excludes bare ``self.try_get`` (a Store
+    subclass's own primitive implementation) and unrelated dicts
+    (``d.get``)."""
+    if len(chain) < 2:
+        return False
+    receiver = chain[:-1]
+    if receiver[0] in store_params:
+        return True
+    return any("store" in part.lower() for part in receiver)
+
+
+def _store_annotated_params(
+    fn: Optional[ast.AST],
+) -> FrozenSet[str]:
+    """Names of ``fn``'s parameters whose annotation mentions Store."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return frozenset()
+    names = set()
+    args = fn.args
+    for arg in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+        ann = arg.annotation
+        if ann is not None and "Store" in ast.dump(ann):
+            names.add(arg.arg)
+    return frozenset(names)
+
+
+def _qualname(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Tuple[str, Optional[str]]:
+    """(dotted function qualname, enclosing class name) for a node."""
+    names: List[str] = []
+    cls: Optional[str] = None
+    for anc in scopes.ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.append(anc.name)
+        elif isinstance(anc, ast.ClassDef):
+            if cls is None:
+                cls = anc.name
+            names.append(anc.name)
+    return ".".join(reversed(names)), cls
+
+
+def _outermost_function(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.AST]:
+    """The outermost enclosing def — nested helpers/closures attribute
+    their sites to the top-level function for sequencing purposes."""
+    out = None
+    for anc in scopes.ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out = anc
+    return out
+
+
+class _Extractor:
+    def __init__(self, modules: Sequence[ModuleInfo]) -> None:
+        self.modules = list(modules)
+        # relpath -> {NAME: template} for module-level string constants
+        self.module_consts: Dict[str, Dict[str, str]] = {}
+        # bare constant name -> template (cross-module fallback; only
+        # kept when unambiguous)
+        self.global_consts: Dict[str, str] = {}
+        # (relpath, class) -> {attr: template}
+        self.class_attrs: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # key helpers: functions/methods whose last statement returns a
+        # string expression. name -> [(module, fn_node, class or None)]
+        self.helpers: Dict[str, List[Tuple[ModuleInfo, ast.AST, Optional[str]]]] = {}
+        self.model = ProtocolModel()
+
+    # -- symbol tables ----------------------------------------------------
+
+    def _collect_tables(self) -> None:
+        ambiguous: Set[str] = set()
+        for module in self.modules:
+            consts: Dict[str, str] = {}
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant
+                ):
+                    if isinstance(node.value.value, str):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                consts[tgt.id] = node.value.value
+            self.module_consts[module.relpath] = consts
+            for name, value in consts.items():
+                if name in self.global_consts and self.global_consts[name] != value:
+                    ambiguous.add(name)
+                self.global_consts.setdefault(name, value)
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _, cls = _qualname(node, module.parents)
+                    if self.helper_return(node) is not None:
+                        self.helpers.setdefault(node.name, []).append(
+                            (module, node, cls)
+                        )
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        chain = scopes.attr_chain(tgt)
+                        if len(chain) == 2 and chain[0] == "self":
+                            _, cls = _qualname(node, module.parents)
+                            if cls is None:
+                                continue
+                            attrs = self.class_attrs.setdefault(
+                                (module.relpath, cls), {}
+                            )
+                            if chain[1] not in attrs:
+                                env = _Env(self, module, {}, cls=cls)
+                                tpl = _normalize(node.value, env)
+                                if not is_opaque(tpl):
+                                    attrs[chain[1]] = tpl
+        for name in ambiguous:
+            self.global_consts.pop(name, None)
+
+    @staticmethod
+    def helper_return(fn: ast.AST) -> Optional[ast.AST]:
+        """The returned expression of a single-return key helper."""
+        body = getattr(fn, "body", [])
+        rets = [n for n in body if isinstance(n, ast.Return)]
+        if len(rets) == 1 and rets[0].value is not None:
+            val = rets[0].value
+            if isinstance(
+                val, (ast.JoinedStr, ast.Constant, ast.BinOp, ast.Name, ast.Call)
+            ):
+                return val
+        return None
+
+    def resolve_helper(
+        self, env: _Env, chain: List[str]
+    ) -> Optional[Tuple[ModuleInfo, ast.AST, Optional[str]]]:
+        """Resolve a call chain to a key-helper def: ``self._key`` binds
+        to the enclosing class's method; a bare/imported name binds to
+        the project-wide def when unambiguous."""
+        if not chain:
+            return None
+        name = chain[-1]
+        candidates = self.helpers.get(name, [])
+        if not candidates:
+            return None
+        if len(chain) == 2 and chain[0] == "self" and env.cls:
+            for module, fn, cls in candidates:
+                if cls == env.cls and module.relpath == env.module.relpath:
+                    return module, fn, cls
+            return None
+        free = [c for c in candidates if c[2] is None]
+        same_module = [c for c in free if c[0].relpath == env.module.relpath]
+        if same_module:
+            return same_module[0]
+        if len(free) == 1:
+            return free[0]
+        return None
+
+    # -- per-module extraction --------------------------------------------
+
+    def _local_templates(
+        self, fn: Optional[ast.AST], module: ModuleInfo, cls: Optional[str]
+    ) -> Dict[str, str]:
+        """One pass of simple-assignment resolution inside ``fn`` (two
+        rounds, so ``p = f"{prefix}/fanout"; k = f"{p}/needs"`` chains)."""
+        scope = fn if fn is not None else module.tree
+        out: Dict[str, str] = {}
+        for _ in range(2):
+            env = _Env(self, module, out, cls=cls)
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name):
+                        tpl = _normalize(node.value, env)
+                        if not is_opaque(tpl):
+                            out[tgt.id] = tpl
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if isinstance(node.target, ast.Name):
+                        tpl = _normalize(node.value, env)
+                        if not is_opaque(tpl):
+                            out[node.target.id] = tpl
+        return out
+
+    def _guards(
+        self,
+        node: ast.AST,
+        module: ModuleInfo,
+        fn: Optional[ast.AST],
+        taint_cache: Dict,
+        knob_names: Set[str],
+    ) -> Tuple[bool, bool]:
+        scope = fn if fn is not None else module.tree
+        if scope not in taint_cache:
+            taint_cache[scope] = scopes.tainted_names(scope, knob_names)
+        knob_taint, rank_taint = taint_cache[scope]
+        rank_guarded = knob_guarded = False
+        for test, _guard in scopes.guard_tests(node, module.parents, stop_at=fn):
+            if scopes.expr_rank_tainted(test, rank_taint):
+                rank_guarded = True
+            if scopes.expr_knob_tainted(test, knob_taint, knob_names):
+                knob_guarded = True
+        return rank_guarded, knob_guarded
+
+    def _extract_module(self, module: ModuleInfo) -> None:
+        parents = module.parents
+        knob_names = scopes.knob_import_names(module.tree)
+        taint_cache: Dict = {}
+        local_cache: Dict = {}
+        seqs: Dict[Tuple[str, str], WriteSeq] = {}
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = scopes.call_chain(node)
+            terminal = chain[-1] if chain else None
+
+            # crashpoint(names.CRASH_*) / _crashpoint(...) / arm(...)
+            if terminal in ("crashpoint", "_crashpoint") and node.args:
+                arg_chain = scopes.attr_chain(node.args[0])
+                const = arg_chain[-1] if arg_chain else None
+                if isinstance(node.args[0], ast.Name):
+                    const = node.args[0].id
+                if const and const.startswith("CRASH_"):
+                    self.model.crash_sites.append(
+                        CrashSite(module.relpath, node.lineno, const)
+                    )
+                outer = _outermost_function(node, parents)
+                if outer is not None:
+                    qn, _ = _qualname(node, parents)
+                    key = (module.relpath, outer.name)
+                    seq = seqs.setdefault(
+                        key, WriteSeq(module.relpath, outer.name)
+                    )
+                    seq.crash_lines.append(node.lineno)
+
+            # RPC sites: *.request(RPC_*) / *.propagate(RPC_*) and
+            # handler comparisons are collected in a separate walk below.
+            if terminal in ("request", "propagate") and node.args:
+                arg_chain = scopes.attr_chain(node.args[0])
+                const = arg_chain[-1] if arg_chain else None
+                if const and const.startswith("RPC_"):
+                    self.model.rpc_sites.append(
+                        RpcSite(
+                            module.relpath,
+                            node.lineno,
+                            "propagate" if terminal == "propagate" else "request",
+                            const,
+                        )
+                    )
+
+            # send_frame / recv_frame coverage
+            if terminal in ("send_frame", "recv_frame", "_send_msg", "_recv_msg"):
+                fn = scopes.enclosing_function(node, parents)
+                qn, _cls = _qualname(node, parents)
+                in_prop = False
+                for anc in scopes.ancestors(node, parents):
+                    if isinstance(anc, ast.With):
+                        for ctx in scopes.with_context_exprs(anc):
+                            for sub in ast.walk(ctx):
+                                if isinstance(sub, ast.Call):
+                                    c = scopes.call_chain(sub)
+                                    if c and c[-1] == "propagate":
+                                        in_prop = True
+                    if anc is fn:
+                        break
+                adopts = False
+                scope = fn if fn is not None else module.tree
+                for sub in ast.walk(scope):
+                    if isinstance(sub, ast.Call):
+                        c = scopes.call_chain(sub)
+                        if c and c[-1] in (
+                            "last_received_context",
+                            "set_received_context",
+                        ):
+                            adopts = True
+                self.model.frame_sites.append(
+                    FrameSite(
+                        module.relpath,
+                        node.lineno,
+                        "send" if terminal in ("send_frame", "_send_msg") else "recv",
+                        qn,
+                        in_prop,
+                        adopts,
+                    )
+                )
+
+            # Store key ops
+            if (
+                terminal in STORE_OPS
+                and isinstance(node.func, ast.Attribute)
+                and _is_store_receiver(
+                    chain,
+                    _store_annotated_params(
+                        scopes.enclosing_function(node, parents)
+                    ),
+                )
+            ):
+                fn = scopes.enclosing_function(node, parents)
+                qn, cls = _qualname(node, parents)
+                cache_key = id(fn) if fn is not None else id(module.tree)
+                if cache_key not in local_cache:
+                    outer = _outermost_function(node, parents)
+                    local_cache[cache_key] = self._local_templates(
+                        outer if outer is not None else fn, module, cls
+                    )
+                env = _Env(self, module, local_cache[cache_key], cls=cls)
+                outer = _outermost_function(node, parents)
+                templates: List[str] = []
+                resolved = True
+                if terminal in (
+                    "multi_set",
+                    "multi_get",
+                    "multi_delete",
+                    "wait_any",
+                ):
+                    if node.args:
+                        templates, resolved = _iter_container_keys(
+                            node.args[0], env, outer
+                        )
+                    else:
+                        resolved = False
+                elif terminal == "scan":
+                    if node.args:
+                        templates = [
+                            collapse(
+                                _normalize(node.args[0], env).rstrip("/")
+                                + "/"
+                                + PLACEHOLDER
+                            )
+                        ]
+                elif node.args:
+                    templates = [_normalize(node.args[0], env)]
+                else:
+                    resolved = False
+                rank_g, knob_g = self._guards(
+                    node, module, fn, taint_cache, knob_names
+                )
+                for tpl in templates:
+                    site = KeySite(
+                        relpath=module.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        op=terminal,
+                        template=collapse(tpl),
+                        func=qn,
+                        rank_guarded=rank_g,
+                        knob_guarded=knob_g,
+                    )
+                    self.model.key_sites.append(site)
+                    if terminal in SET_OPS and terminal != "add":
+                        outer2 = _outermost_function(node, parents)
+                        if outer2 is not None:
+                            key = (module.relpath, outer2.name)
+                            seq = seqs.setdefault(
+                                key, WriteSeq(module.relpath, outer2.name)
+                            )
+                            seq.writes.append(site)
+                if terminal in DELETE_OPS and (
+                    not resolved or all(is_opaque(t) for t in templates)
+                ):
+                    self.model.opaque_deletes.append(
+                        KeySite(
+                            relpath=module.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            op=terminal,
+                            template=PLACEHOLDER,
+                            func=qn,
+                        )
+                    )
+
+        # handler comparisons: ``cmd == names.RPC_*``
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                for operand in operands:
+                    op_chain = scopes.attr_chain(operand)
+                    const = op_chain[-1] if op_chain else None
+                    if const and const.startswith("RPC_"):
+                        others = [o for o in operands if o is not operand]
+                        if any(
+                            isinstance(o, ast.Name)
+                            or isinstance(o, ast.Attribute)
+                            for o in others
+                        ):
+                            self.model.rpc_sites.append(
+                                RpcSite(
+                                    module.relpath, node.lineno, "handler", const
+                                )
+                            )
+
+        self.model.write_seqs.extend(
+            seqs[k] for k in sorted(seqs, key=lambda k: (k[0], k[1]))
+        )
+
+    def _collect_declarations(self) -> None:
+        for module in self.modules:
+            if module.relpath != NAMES_RELPATH:
+                continue
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant
+                ):
+                    for tgt in node.targets:
+                        if not isinstance(tgt, ast.Name):
+                            continue
+                        if tgt.id.startswith("CRASH_"):
+                            self.model.declared_crashpoints[tgt.id] = node.lineno
+                        elif tgt.id.startswith("RPC_"):
+                            self.model.declared_rpc_ops[tgt.id] = node.lineno
+
+    def build(self) -> ProtocolModel:
+        self._collect_tables()
+        for module in self.modules:
+            self._extract_module(module)
+        self._collect_declarations()
+        self.model.key_sites.sort(key=lambda s: (s.relpath, s.line, s.col))
+        self.model.rpc_sites.sort(key=lambda s: (s.relpath, s.line))
+        return self.model
+
+
+def package_modules(project: Project) -> List[ModuleInfo]:
+    """Every package module — the loaded ones, plus a disk fallback so
+    the cross-module model holds even on a partial-path run (the
+    names-lint discipline). Uses the shared parse cache."""
+    modules = {
+        m.relpath: m
+        for m in project.modules
+        if m.relpath.startswith(PACKAGE_PREFIX)
+    }
+    pkg_root = project.root / "torchsnapshot_tpu"
+    if pkg_root.is_dir():
+        for path in sorted(pkg_root.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.resolve().relative_to(project.root.resolve()).as_posix()
+            if rel in modules:
+                continue
+            try:
+                modules[rel] = load_module_cached(path, project.root)
+            except (OSError, SyntaxError):
+                continue
+    return [modules[k] for k in sorted(modules)]
+
+
+def get_model(project: Project) -> ProtocolModel:
+    """Build (or reuse) the protocol model for this project — one
+    extraction shared by every protocol rule in the run."""
+    cached = getattr(project, "_protocol_model", None)
+    if cached is not None:
+        return cached
+    model = _Extractor(package_modules(project)).build()
+    project._protocol_model = model  # type: ignore[attr-defined]
+    return model

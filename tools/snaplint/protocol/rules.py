@@ -1,0 +1,584 @@
+"""The protocol rule family: checkers over the extracted coordination
+model (see ``model.py`` for the templates/sites they consume).
+
+All six are *project-level* rules in the names-lint discipline: they
+judge cross-module invariants against the whole package (with the disk
+fallback), so a partial-path run still sees the full protocol surface.
+Inline suppressions apply at the reported site; the shipped baseline
+for this family is empty and must stay empty.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from .. import scopes
+from ..core import Finding, Project, Rule, register
+from . import model as m
+
+# The rule names the CLI's ``--protocol`` lane selects.
+PROTOCOL_RULE_NAMES = [
+    "store-key-leak",
+    "rank-asymmetric-protocol",
+    "wait-without-error-poll",
+    "rpc-unpaired",
+    "commit-ordering",
+    "store-namespace-docs",
+]
+
+SCALING_DOC_RELPATH = "docs/scaling.md"
+
+# Modules whose rank-conditional traffic IS the protocol they implement.
+_IMPL_EXEMPT = (
+    "torchsnapshot_tpu/dist_store.py",
+    "torchsnapshot_tpu/pg_wrapper.py",
+)
+
+
+def _in_package(relpath: str) -> bool:
+    return relpath.startswith(m.PACKAGE_PREFIX)
+
+
+# ---------------------------------------------------------------------------
+# store-key-leak
+
+
+@register
+class StoreKeyLeak(Rule):
+    name = "store-key-leak"
+    description = (
+        "store key family set on some path but deleted on none — a "
+        "coordination-store leak at scale (registry namespaces need an "
+        "inline justification)"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        mdl = m.get_model(project)
+        deletes = [s for s in mdl.key_sites if s.role == "delete"]
+        opaque_modules = {s.relpath for s in mdl.opaque_deletes}
+        reported: Set[str] = set()
+        for site in mdl.key_sites:
+            if site.role != "set":
+                continue
+            tpl = site.template
+            if m.is_opaque(tpl):
+                continue  # nothing to judge: the key never normalized
+            if tpl in reported:
+                continue
+            if any(
+                m.unifies(tpl, d.template) and not m.is_opaque(d.template)
+                for d in deletes
+            ):
+                continue
+            if site.relpath in opaque_modules:
+                # A delete whose key list could not be traced lives in
+                # this module; static analysis cannot prove it does NOT
+                # cover this family. Conservative: no finding.
+                continue
+            reported.add(tpl)
+            yield Finding(
+                rule=self.name,
+                path=site.relpath,
+                line=site.line,
+                col=site.col,
+                message=(
+                    f"store key family '{tpl}' is written here but no "
+                    f"delete in the project covers it — every write "
+                    f"grows the coordination store forever at scale; "
+                    f"tear the family down (multi_delete/counter "
+                    f"cleanup) or mark the registry semantics with an "
+                    f"inline justification"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# rank-asymmetric-protocol
+
+
+def _collective_call(node: ast.Call) -> Optional[str]:
+    from ..rules.collective_under_conditional import (
+        COLLECTIVE_METHODS,
+        _NON_COLLECTIVE_ROOTS,
+    )
+
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr not in COLLECTIVE_METHODS:
+        return None
+    chain = scopes.attr_chain(func)
+    if chain and chain[0] in _NON_COLLECTIVE_ROOTS:
+        return None
+    return func.attr
+
+
+@register
+class RankAsymmetricProtocol(Rule):
+    name = "rank-asymmetric-protocol"
+    description = (
+        "rank/knob asymmetry across function boundaries: a knob-guarded "
+        "set whose waiters are unguarded, or a collective reachable "
+        "through a call chain under non-laundered per-rank state"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        mdl = m.get_model(project)
+
+        # Part 1 — key families whose every writer is knob-guarded while
+        # some blocking wait for the family is not: a knob skewed across
+        # ranks strands the waiters for the full store timeout.
+        families = mdl.families()
+        for tpl in sorted(families):
+            sites = families[tpl]
+            sets = [s for s in sites if s.role == "set"]
+            waits = [s for s in sites if s.role == "wait"]
+            if not sets or not waits:
+                continue
+            if all(s.knob_guarded for s in sets):
+                for wait in waits:
+                    if not wait.knob_guarded:
+                        yield Finding(
+                            rule=self.name,
+                            path=wait.relpath,
+                            line=wait.line,
+                            col=wait.col,
+                            message=(
+                                f"blocking wait for store key family "
+                                f"'{tpl}' is unguarded, but every write "
+                                f"of the family sits under a knob/env "
+                                f"guard (e.g. "
+                                f"{sets[0].relpath}:{sets[0].line}) — a "
+                                f"knob skewed across ranks strands this "
+                                f"wait for the full store timeout"
+                            ),
+                        )
+                        break
+
+        # Part 2 — the PR 8 taint, extended across function boundaries:
+        # a call chain that reaches a collective, invoked under a
+        # non-laundered rank/knob guard. (Direct guarded collectives are
+        # collective-under-conditional's finding; this rule owns the
+        # indirect case it cannot see.) The call graph is name-based, so
+        # it only admits names defined EXACTLY ONCE in the package —
+        # `get`/`set`/`close` live on dozens of classes and a bare-name
+        # edge through them would convict half the codebase. Same
+        # uniqueness discipline as the model's key-helper inlining.
+        from ..rules.collective_under_conditional import COLLECTIVE_METHODS
+
+        modules = {mod.relpath: mod for mod in m.package_modules(project)}
+        defs: Dict[str, List[str]] = {}
+        for relpath, mod in modules.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, []).append(relpath)
+        unique = {
+            name
+            for name, where in defs.items()
+            if len(where) == 1
+            and not where[0].endswith(_IMPL_EXEMPT)
+            and name not in COLLECTIVE_METHODS
+        }
+        contains: Set[str] = set()  # unique functions directly holding one
+        calls: Dict[str, Set[str]] = {}  # unique fn -> unique callee names
+        for relpath, mod in modules.items():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if node.name not in unique:
+                    continue
+                callees: Set[str] = calls.setdefault(node.name, set())
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        if _collective_call(sub) is not None:
+                            contains.add(node.name)
+                        chain = scopes.call_chain(sub)
+                        if chain and chain[-1] in unique:
+                            callees.add(chain[-1])
+        # Transitive closure, bounded by the function-name graph size.
+        reaches = set(contains)
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in calls.items():
+                if name not in reaches and callees & reaches:
+                    reaches.add(name)
+                    changed = True
+
+        for relpath, mod in modules.items():
+            if relpath.endswith(_IMPL_EXEMPT):
+                continue
+            knob_names = scopes.knob_import_names(mod.tree)
+            taint_cache: Dict = {}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = scopes.call_chain(node)
+                if not chain:
+                    continue
+                callee = chain[-1]
+                if callee not in reaches or callee in COLLECTIVE_METHODS:
+                    continue
+                fn = scopes.enclosing_function(node, mod.parents)
+                scope = fn if fn is not None else mod.tree
+                if scope not in taint_cache:
+                    taint_cache[scope] = scopes.tainted_names(
+                        scope, knob_names
+                    )
+                knob_taint, rank_taint = taint_cache[scope]
+                for test, guard in scopes.guard_tests(
+                    node, mod.parents, stop_at=fn
+                ):
+                    kinds = []
+                    if scopes.expr_knob_tainted(test, knob_taint, knob_names):
+                        kinds.append("knob/env")
+                    if scopes.expr_rank_tainted(test, rank_taint):
+                        kinds.append("rank")
+                    if kinds:
+                        yield Finding(
+                            rule=self.name,
+                            path=relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"call to {callee}() — which reaches a "
+                                f"cross-rank collective — is guarded by "
+                                f"a {'/'.join(kinds)}-dependent test "
+                                f"(line {guard.lineno}); a skewed guard "
+                                f"strands the rendezvous inside the "
+                                f"callee"
+                            ),
+                        )
+                        break
+
+
+# ---------------------------------------------------------------------------
+# wait-without-error-poll
+
+
+@register
+class WaitWithoutErrorPoll(Rule):
+    name = "wait-without-error-poll"
+    description = (
+        "hand-rolled store wait loop that neither polls its round's "
+        "error key nor rides _PollPacer — peers cannot fail it fast"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        for mod in m.package_modules(project):
+            for loop in ast.walk(mod.tree):
+                if not isinstance(loop, ast.While):
+                    continue
+                store_reads: List[ast.Call] = []
+                sleeps: List[List[str]] = []
+                reads_error = False
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = scopes.call_chain(node)
+                    terminal = chain[-1] if chain else None
+                    if (
+                        terminal in ("try_get", "multi_get", "get")
+                        and isinstance(node.func, ast.Attribute)
+                        and m._is_store_receiver(chain)
+                    ):
+                        store_reads.append(node)
+                        for arg in ast.walk(node):
+                            if (
+                                isinstance(arg, ast.Constant)
+                                and isinstance(arg.value, str)
+                                and (
+                                    arg.value == "error"
+                                    or arg.value.endswith("/error")
+                                )
+                            ):
+                                reads_error = True
+                            if (
+                                isinstance(arg, ast.Name)
+                                and "error" in arg.id.lower()
+                            ):
+                                reads_error = True
+                            if (
+                                isinstance(arg, ast.JoinedStr)
+                                and any(
+                                    isinstance(p, ast.Constant)
+                                    and isinstance(p.value, str)
+                                    and "error" in p.value
+                                    for p in arg.values
+                                )
+                            ):
+                                reads_error = True
+                    if terminal == "sleep":
+                        sleeps.append(chain)
+                if not store_reads or not sleeps:
+                    continue
+                if reads_error:
+                    continue
+                # A pacer ride: any sleep whose receiver is not the
+                # ``time`` module is the shared exponential-backoff
+                # pacer (``pacer.sleep`` / ``self._pacer.sleep``).
+                if any(chain[:-1] != ["time"] for chain in sleeps):
+                    continue
+                yield Finding(
+                    rule=self.name,
+                    path=mod.relpath,
+                    line=loop.lineno,
+                    col=loop.col_offset,
+                    message=(
+                        "store wait loop polls with a fixed time.sleep "
+                        "and never reads its round's error key — a peer "
+                        "that failed cannot fail this waiter fast "
+                        "(multi_get the error key with the data keys, "
+                        "or ride _PollPacer; see the PR 8 fail-fast "
+                        "discipline in docs/scaling.md)"
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# rpc-unpaired
+
+
+@register
+class RpcUnpaired(Rule):
+    name = "rpc-unpaired"
+    description = (
+        "RPC op with a client and no server handler (or vice versa), or "
+        "a raw frame call outside any wire.propagate scope — invisible "
+        "to the wire observatory"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        mdl = m.get_model(project)
+        by_role: Dict[str, Dict[str, List[m.RpcSite]]] = {}
+        for site in mdl.rpc_sites:
+            if not _in_package(site.relpath):
+                continue
+            by_role.setdefault(site.op, {}).setdefault(site.role, []).append(
+                site
+            )
+
+        # Pairing applies to the request/response families — ops that
+        # appear in a dispatch comparison or a ``.request()`` call.
+        # One-sided round scopes (RPC_FANOUT_*, RPC_CDN_*) and the
+        # store's cmd-int wire ops (RPC_STORE_*, mapped through
+        # _store_rpc_ids) have no handler-comparison shape to pair.
+        for op in sorted(by_role):
+            roles = by_role[op]
+            requests = roles.get("request", [])
+            handlers = roles.get("handler", [])
+            if requests and not handlers:
+                site = requests[0]
+                yield Finding(
+                    rule=self.name,
+                    path=site.relpath,
+                    line=site.line,
+                    message=(
+                        f"client sends RPC op {op} but no server "
+                        f"dispatch handles it — the request can only "
+                        f"fail at the peer"
+                    ),
+                )
+            elif handlers and not requests:
+                site = handlers[0]
+                yield Finding(
+                    rule=self.name,
+                    path=site.relpath,
+                    line=site.line,
+                    message=(
+                        f"server dispatch handles RPC op {op} but no "
+                        f"client call site sends it — dead protocol "
+                        f"surface (add the client wrapper or retire "
+                        f"the handler)"
+                    ),
+                )
+
+        # Frame-coverage: every raw send_frame/recv_frame outside the
+        # framing layer itself must either sit inside a
+        # ``with wire.propagate(...)`` scope (client side) or adopt the
+        # received context (server side) — otherwise its traffic
+        # vanishes from the wire observatory's merged traces.
+        for site in mdl.frame_sites:
+            if not _in_package(site.relpath):
+                continue
+            if site.relpath in _IMPL_EXEMPT or site.relpath.endswith(
+                "dist_store.py"
+            ):
+                continue
+            if site.in_propagate or site.adopts_context:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=site.relpath,
+                line=site.line,
+                message=(
+                    f"raw {site.kind}_frame call in {site.func or '<module>'} "
+                    f"is outside any wire.propagate scope and never adopts "
+                    f"the received wire context — this RPC is invisible to "
+                    f"the wire observatory"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# commit-ordering
+
+
+_MARKER_SEGMENTS = {"head"}
+_MARKER_SUBSTRINGS = ("commit", "marker")
+
+
+def _is_marker(template: str) -> bool:
+    segs = m.segments(template)
+    last_literal = next(
+        (s for s in reversed(segs) if s != m.PLACEHOLDER), None
+    )
+    if last_literal is None:
+        return False
+    return last_literal in _MARKER_SEGMENTS or any(
+        sub in last_literal for sub in _MARKER_SUBSTRINGS
+    )
+
+
+def _namespace_root(template: str) -> Optional[str]:
+    head = m.segments(template)[0]
+    return None if head == m.PLACEHOLDER else head
+
+
+@register
+class CommitOrdering(Rule):
+    name = "commit-ordering"
+    description = (
+        "durable marker/head write statically reachable before its "
+        "payload writes, a marker-last sequence with no declared crash "
+        "point, or a declared CRASH_* id threaded through no code path"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        mdl = m.get_model(project)
+        for seq in mdl.write_seqs:
+            if not _in_package(seq.relpath):
+                continue
+            markers = [w for w in seq.writes if _is_marker(w.template)]
+            payloads = [w for w in seq.writes if not _is_marker(w.template)]
+            for marker in markers:
+                ns = _namespace_root(marker.template)
+                related = [
+                    p
+                    for p in payloads
+                    if ns is not None and _namespace_root(p.template) == ns
+                ]
+                late = [p for p in related if p.line > marker.line]
+                if late:
+                    yield Finding(
+                        rule=self.name,
+                        path=marker.relpath,
+                        line=marker.line,
+                        col=marker.col,
+                        message=(
+                            f"durable marker '{marker.template}' is "
+                            f"written before payload "
+                            f"'{late[0].template}' (line {late[0].line}) "
+                            f"in {seq.func}() — a kill between the "
+                            f"writes publishes a marker whose payload "
+                            f"does not exist; write the payload first"
+                        ),
+                    )
+                    continue
+                early = [p for p in related if p.line < marker.line]
+                if early and not any(
+                    early[-1].line <= cl <= marker.line
+                    for cl in seq.crash_lines
+                ):
+                    yield Finding(
+                        rule=self.name,
+                        path=marker.relpath,
+                        line=marker.line,
+                        col=marker.col,
+                        message=(
+                            f"marker-last sequence in {seq.func}() "
+                            f"(payload '{early[-1].template}' then "
+                            f"marker '{marker.template}') has no "
+                            f"crashpoint() between the writes — the "
+                            f"chaos matrix cannot kill the torn-state "
+                            f"window; declare a CRASH_* id and thread "
+                            f"it (docs/chaos.md)"
+                        ),
+                    )
+
+        # Registry cross-check: every declared CRASH_* id must be
+        # threaded through at least one crashpoint() site — a declared
+        # point no code path hits is a crash-matrix row that can never
+        # fire, which reads as coverage that does not exist.
+        threaded = {s.const for s in mdl.crash_sites}
+        for const in sorted(mdl.declared_crashpoints):
+            if const not in threaded:
+                yield Finding(
+                    rule=self.name,
+                    path=m.NAMES_RELPATH,
+                    line=mdl.declared_crashpoints[const],
+                    message=(
+                        f"declared crash point {const} is threaded "
+                        f"through no crashpoint() call site — the crash "
+                        f"matrix sweeps a row that can never fire; "
+                        f"thread it or retire the declaration"
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# store-namespace-docs (drive-by: the dump doubles as an inventory)
+
+
+_DOC_NAMESPACE_RE = re.compile(r"`(__[a-z_]+)/")
+
+
+@register
+class StoreNamespaceDocs(Rule):
+    name = "store-namespace-docs"
+    description = (
+        "the store-key namespace table in docs/scaling.md must match "
+        "the namespaces the protocol model extracts from the code"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        doc_path = project.root / SCALING_DOC_RELPATH
+        if not doc_path.exists():
+            return
+        mdl = m.get_model(project)
+        extracted = set(mdl.namespaces())
+        if not extracted:
+            return  # partial fixture layouts: nothing to sync
+        text = doc_path.read_text()
+        documented: Dict[str, int] = {}
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            if not line.lstrip().startswith("|"):
+                continue
+            for match in _DOC_NAMESPACE_RE.finditer(line):
+                documented.setdefault(match.group(1), lineno)
+        for ns in sorted(extracted - set(documented)):
+            yield Finding(
+                rule=self.name,
+                path=SCALING_DOC_RELPATH,
+                line=1,
+                message=(
+                    f"store namespace '{ns}/' is used in the code but "
+                    f"missing from the namespace table in "
+                    f"docs/scaling.md (regenerate with "
+                    f"python -m tools.snaplint --protocol-dump)"
+                ),
+            )
+        for ns in sorted(set(documented) - extracted):
+            yield Finding(
+                rule=self.name,
+                path=SCALING_DOC_RELPATH,
+                line=documented[ns],
+                message=(
+                    f"namespace table documents '{ns}/' but the "
+                    f"protocol model extracts no such namespace — "
+                    f"stale row (regenerate with "
+                    f"python -m tools.snaplint --protocol-dump)"
+                ),
+            )

@@ -12,3 +12,8 @@ from . import (  # noqa: F401
     span_budget_balance,
     tiered_markers,
 )
+
+# The protocol family lives beside the per-file rules, one package up:
+# its rules consume the extracted coordination-plane model rather than
+# walking single modules.
+from ..protocol import rules as protocol_rules  # noqa: E402,F401

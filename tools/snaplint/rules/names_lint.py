@@ -28,7 +28,14 @@ import re
 from pathlib import Path
 from typing import Iterable, Iterator, List, Tuple
 
-from ..core import Finding, ModuleInfo, Project, Rule, register
+from ..core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    load_module_cached,
+    register,
+)
 
 _SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
 _COLON_CASE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)+$")
@@ -560,14 +567,13 @@ def _package_trees(
         if "__pycache__" in py.parts or resolved in seen:
             continue
         try:
-            tree = ast.parse(py.read_text())
+            # The shared process-wide parse cache: four project-level
+            # rules plus the protocol model all fall back to disk for
+            # the same package files on a partial-path run.
+            module = load_module_cached(py, project.root)
         except (OSError, SyntaxError):
             continue
-        try:
-            rel = resolved.relative_to(project.root.resolve()).as_posix()
-        except ValueError:
-            rel = py.as_posix()
-        yield rel, tree
+        yield module.relpath, module.tree
 
 
 def _decl_findings(

@@ -30,6 +30,13 @@ from .topic import Announce, announce_key, head_key, manifest_digest, read_head
 
 logger = logging.getLogger(__name__)
 
+# Announce records kept behind the head. Subscribers only ever read the
+# announce the head names, so one extra record is already enough slack
+# for a poll that read the head just before a publish; two keeps a
+# record around for post-mortem reads of "what did the previous publish
+# say" without growing the store.
+_ANNOUNCE_RETAIN = 2
+
 
 class CdnPublisher:
     """Publish committed steps' chunk sets to one topic.
@@ -92,6 +99,10 @@ class CdnPublisher:
                 # nothing a subscriber can observe.
                 self._store.set(announce_key(self.topic, seq), encoded)
                 crashpoint(metric_names.CRASH_CDN_PUBLISH_ANNOUNCED)
+                # One head key per topic, overwritten in place: bounded
+                # by topic count, and deleting it would un-commit the
+                # topic for every subscriber.
+                # snaplint: disable=store-key-leak
                 self._store.set(head_key(self.topic), str(seq).encode())
         except Exception as e:  # noqa: BLE001 - never fail the training job
             logger.warning(
@@ -103,6 +114,19 @@ class CdnPublisher:
             self._seq = None  # head state unknown: re-read next publish
             return None
         self._seq = seq
+        # Reap the announce that just fell out of the retention window.
+        # The publisher is the topic's single writer and ``seq`` is
+        # continuous across restarts (``last_seq`` re-reads the head),
+        # so this one delete per publish eventually covers every record
+        # ever written — the store holds at most ``_ANNOUNCE_RETAIN``
+        # announces per topic instead of one per publish forever.
+        if seq > _ANNOUNCE_RETAIN:
+            try:
+                self._store.delete(
+                    announce_key(self.topic, seq - _ANNOUNCE_RETAIN)
+                )
+            except Exception:  # noqa: BLE001 - retention is best-effort
+                pass
         registry = telemetry.metrics()
         registry.counter_inc(metric_names.CDN_PUBLISHES_TOTAL)
         registry.counter_inc(

@@ -298,6 +298,12 @@ class FanoutRestoreContext:
             for idx, loc in enumerate(locs)
             if self.owners[loc] == self.rank
         ]
+        # Every "ok" window this rank publishes for its consumers. On a
+        # failing round consumers abort through the error key without
+        # reading their windows, so the publisher must reap them — blob
+        # payloads are the round's big bytes, and an orphaned window
+        # outlives the round in the store.
+        published_ok: List[str] = []
         if owned:
             io_slots = asyncio.Semaphore(
                 max(1, knobs.get_per_rank_io_concurrency())
@@ -343,6 +349,7 @@ class FanoutRestoreContext:
                     )
                 if payloads:
                     self.store.multi_set(payloads)
+                    published_ok.extend(payloads)
                 if loc in needs:
                     self.cache[loc] = ((lo, hi), data)
 
@@ -354,7 +361,17 @@ class FanoutRestoreContext:
                 errors = [r for r in results if isinstance(r, BaseException)]
                 if errors:
                     # Every owned blob settled (data or error marker on
-                    # the wire) before the first failure surfaces.
+                    # the wire) before the first failure surfaces. The
+                    # round is now failing: reap the windows this rank
+                    # already published — its consumers abort via the
+                    # markers/error key and will never read them. The
+                    # markers themselves stay: they ARE the fail-fast
+                    # channel, and whoever consumes one deletes it.
+                    if published_ok:
+                        try:
+                            self.store.multi_delete(published_ok)
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
                     raise errors[0]
 
             event_loop.run_until_complete(_fetch_owned())
@@ -390,13 +407,23 @@ class FanoutRestoreContext:
         if awaited:
             try:
                 self._poll_all(list(awaited), error_key, timeout, _consume)
-            finally:
-                # Tear down what we actually read, even on the error
-                # path (an owner's error marker is consumed too); keys
-                # we never saw stay for their owner — the round is
-                # nonce-scoped either way.
-                if consumed:
-                    self.store.multi_delete(consumed)
+            except BaseException:
+                # The round is failing (a peer's error marker or the
+                # poisoned error key). Tear down what we read AND what
+                # we published — our consumers are aborting through the
+                # same error key and will never read their windows.
+                teardown = consumed + published_ok
+                if teardown:
+                    try:
+                        self.store.multi_delete(teardown)
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
+                raise
+            # Tear down what we actually read (an owner's error marker
+            # is consumed too); keys we never saw stay for their owner —
+            # the round is nonce-scoped either way.
+            if consumed:
+                self.store.multi_delete(consumed)
         return cached
 
     def drop(self, locations: List[str]) -> None:

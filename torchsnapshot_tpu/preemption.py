@@ -289,6 +289,11 @@ class PreemptionSaver:
         store = self._pg.store
         if store is not None and self._pg.get_world_size() > 1:
             try:
+                # Session-namespaced tombstone: it must outlive this
+                # process so a straggler's rendezvous can see the peer
+                # finished. The session id scopes the whole family; a
+                # new job incarnation starts a fresh namespace.
+                # snaplint: disable=store-key-leak
                 store.set(self._key(f"done/{self._pg.get_rank()}"), b"1")
             except Exception:  # noqa: BLE001 - teardown path
                 logger.debug("preemption done-marker publish failed")
@@ -317,6 +322,9 @@ class PreemptionSaver:
             # rank publishes it once.
             self._ensure_poller(store)
             if self._flagged.is_set() and not self._flag_published:
+                # One sticky flag per session: deleting it could lose
+                # the notice for ranks that have not polled yet.
+                # snaplint: disable=store-key-leak
                 store.set(self._key("flag"), b"1")
                 self._flag_published = True
                 self._remote_flagged.set()
@@ -368,6 +376,11 @@ class PreemptionSaver:
         save."""
         time.sleep(self.peer_grace)
         deadline = time.monotonic() + max(2.0, self.peer_grace)
+        # The abandoned marker IS this loop's abort channel (there is no
+        # round error key — preemption is not a fan-out round), and the
+        # loop only spins on *store read failures*, bounded by the
+        # deadline above.
+        # snaplint: disable=wait-without-error-poll
         while True:
             try:
                 return store.try_get(self._key("abandoned")) is not None
@@ -430,6 +443,9 @@ class PreemptionSaver:
         self._gave_up = True
         self._post_ledger(gave_up=True)
         try:
+            # Sticky per-session tombstone, same contract as done/:
+            # peers must read it after this process is gone.
+            # snaplint: disable=store-key-leak
             store.set(self._key("abandoned"), b"1")
         except Exception:  # noqa: BLE001 - already giving up
             logger.debug("preemption abandoned-marker publish failed")
@@ -447,8 +463,13 @@ class PreemptionSaver:
         store = self._pg.store
         rank = self._pg.get_rank()
         world = self._pg.get_world_size()
+        # The rendezvous happens at most once per session (the process
+        # is being evicted); its keys are session-namespaced and must
+        # survive until the last straggler reads them — there is no
+        # safe point to delete (a late joiner re-reads every step key).
+        # snaplint: disable=store-key-leak
         store.set(self._key(f"step/{rank}"), str(step).encode())
-        joined = store.add(self._key("step_count"), 1)
+        joined = store.add(self._key("step_count"), 1)  # snaplint: disable=store-key-leak
         deadline = time.monotonic() + self.rendezvous_timeout
         # Steady wait costs ONE coordinator RPC per 50ms tick (the join
         # counter); per-rank step keys are read once, after the counter
@@ -456,6 +477,11 @@ class PreemptionSaver:
         # (a finished or timed-out peer aborts the save either way):
         # checked ~1/s.
         next_abort_check = 0.0
+        # abandoned/done ARE the abort channels here (checked ~1/s in
+        # the loop body), and the fixed 50ms tick is the documented cost
+        # model above — a pacer's backoff would slow the join counter,
+        # the thing this loop exists to watch.
+        # snaplint: disable=wait-without-error-poll
         while time.monotonic() < deadline:
             if time.monotonic() >= next_abort_check:
                 next_abort_check = time.monotonic() + 1.0

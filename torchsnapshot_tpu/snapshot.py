@@ -1094,6 +1094,11 @@ class Snapshot:
             pipeline = telemetry.merge_pipeline_telemetry(pipeline_sink)
             _merge_fanout_telemetry(pipeline, fanout_ctx)
             _merge_peer_telemetry(pipeline, peer_ctx)
+            # Round the parts BEFORE summing: the report layer rounds
+            # each part to 6dp on serialization, so deriving the total
+            # from the raw values can disagree with the serialized
+            # parts by 1e-06 for unlucky timings.
+            cold_start = {k: round(v, 6) for k, v in cold_start.items()}
             pipeline["cold_start"] = cold_start
             pipeline["cold_start_s"] = round(sum(cold_start.values()), 6)
             _emit_snapshot_report(

@@ -617,6 +617,15 @@ class PeerClient:
     def stats(self) -> Dict[str, Any]:
         return dict(self.request(metric_names.RPC_PEER_STATS))
 
+    def ping(self) -> bool:
+        """Liveness probe: a full request/response round trip through
+        the peer's dispatch loop (not just a TCP connect), so a hung
+        server reads as dead. True iff the peer answered."""
+        try:
+            return self.request(metric_names.RPC_PEER_PING) == "pong"
+        except (OSError, RuntimeError):
+            return False
+
 
 # ---------------------------------------------------------------------------
 # The replicator (the pushing side's background worker)
