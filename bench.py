@@ -768,6 +768,40 @@ def _ledger_goodput(root: str) -> dict:
         return {}
 
 
+def _slo_summary(root: str) -> dict:
+    """SLO verdicts for a RESULT leg (telemetry/slo.py): the max burn
+    rate, which objectives are breaching, and the per-objective burn —
+    a bench record says not just how fast the leg was but whether the
+    run kept its declared promises. {} when no ledger (fail-soft)."""
+    try:
+        from torchsnapshot_tpu.telemetry import slo as ts_slo
+
+        result = ts_slo.evaluate_root(root)
+        if result is None:
+            return {}
+        enabled = [
+            o for o in result["objectives"] if not o["disabled"]
+        ]
+        return {
+            "burn_rate": max(
+                (o["burn_rate"] for o in enabled), default=0.0
+            ),
+            "breaching": result["breaching"],
+            "objectives": {
+                o["objective"]: {
+                    "burn_rate": o["burn_rate"],
+                    "samples": o["samples"],
+                    "target": o["target"],
+                }
+                for o in enabled
+                if o["samples"]
+            },
+        }
+    except Exception as e:  # noqa: BLE001 - context data, fail-soft
+        _log(f"bench: slo summary failed: {e!r}")
+        return {}
+
+
 def preemption_leg(workdir: str, total_bytes: int, est_take_s: float) -> None:
     """Leg 8: preemption recovery cost, ledger-accounted.
 
@@ -837,6 +871,7 @@ def preemption_leg(workdir: str, total_bytes: int, est_take_s: float) -> None:
                 recovery_report.tier_split if recovery_report else None
             ),
             "goodput": _ledger_goodput(root),
+            "slo": _slo_summary(root),
         }
         _log(
             f"bench: preemption leg restored step {restored} in "
@@ -1079,6 +1114,10 @@ def steady_state_leg(
             # ate, and the storage spend per retained step — BENCH_r06+
             # carries run-level numbers, not just per-op medians.
             "goodput": _ledger_goodput(root),
+            # The same ledger judged against the declared SLOs: did
+            # the steady-state loop keep its promises, and how fast
+            # was it spending error budget at the end.
+            "slo": _slo_summary(root),
         }
         if effs:
             RESULT["steady_state_final_efficiency"] = round(effs[-1], 3)

@@ -96,6 +96,17 @@ os.environ.setdefault("TORCHSNAPSHOT_TPU_CDN", "0")
 # multiprocess workers.
 os.environ.setdefault("TORCHSNAPSHOT_TPU_FLEET_OBS", "0")
 
+# The SLO engine is pinned off in the suite ("0"): tier-1 manager
+# tests run with tiny synthetic budgets where normal operations would
+# look like breaches, and must not see slo-breach ledger events or
+# burn gauges they didn't ask for. SLO tests opt back in via
+# knobs.enable_slo(). Incident-bundle capture is likewise disabled
+# (max bytes 0 = no capture) so tier-1 roots never grow a .bundles/
+# dir from an injected failure; bundle tests opt back in via
+# knobs.override_bundle_max_bytes().
+os.environ.setdefault("TORCHSNAPSHOT_TPU_SLO", "0")
+os.environ.setdefault("TORCHSNAPSHOT_TPU_BUNDLE_MAX_BYTES", "0")
+
 if os.environ.get("TS_TEST_ON_TPU") != "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,301 @@
+"""Incident black-box bundles: bounded capture, rate limiting, the
+snapshot-dir-shaped layout, watchdog one-bundle-per-episode, and the
+acceptance reproduction — an injected SLO breach on a real manager run
+yields exactly one breach event + one bundle, and a relocated copy of
+that bundle reproduces the live doctor verdicts with the original root
+deleted.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+import torchsnapshot_tpu as ts
+from torchsnapshot_tpu import knobs, telemetry
+from torchsnapshot_tpu.telemetry import bundle, doctor, ledger, names, slo
+from torchsnapshot_tpu.telemetry.watchdog import reset_watchdog
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    telemetry.reset_metrics()
+    telemetry.reset_trace()
+    reset_watchdog()
+    ledger.reset_owned_roots()
+    slo.reset_slo_state()
+    bundle.reset_bundle_state()
+    yield
+    reset_watchdog()
+    telemetry.reset_metrics()
+    telemetry.reset_trace()
+    ledger.reset_owned_roots()
+    slo.reset_slo_state()
+    bundle.reset_bundle_state()
+
+
+def _state(n=2, size=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": rng.standard_normal(size).astype(np.float32)
+        for i in range(n)
+    }
+
+
+def _run_manager(root, steps=(0, 1)):
+    mgr = ts.CheckpointManager(root, keep_last_n=4)
+    for step in steps:
+        mgr.save(step, {"s": ts.PyTreeState(_state(seed=step))})
+    return mgr
+
+
+# ---------------------------------------------------------------------------
+# capture mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_capture_assembles_bounded_snapshot_shaped_dir(tmp_path):
+    root = str(tmp_path)
+    with knobs.enable_ledger(), knobs.enable_telemetry():
+        _run_manager(root)
+        with knobs.override_bundle_max_bytes(1 << 20):
+            path = bundle.capture_bundle(
+                root, trigger="manual", reason="unit test", step=1
+            )
+    assert path is not None and bundle.is_bundle(path)
+    assert os.path.dirname(path) == os.path.join(root, ".bundles")
+    manifest = bundle.load_manifest(path)
+    assert manifest["trigger"] == "manual"
+    assert manifest["reason"] == "unit test"
+    assert manifest["step"] == 1
+    assert manifest["root"] == root
+    assert manifest["bytes"] <= manifest["max_bytes"]
+    copied = {f["name"] for f in manifest["files"]}
+    # The bundle mimics a snapshot dir: the run ledger and the
+    # triggering op's reports land under their live basenames.
+    assert ".ledger.jsonl" in copied
+    assert ".telemetry.jsonl" in copied
+    # The knob fingerprint records the operator surface verbatim, and
+    # the tunable vector the effective values.
+    assert any(k.startswith("TORCHSNAPSHOT_TPU_") for k in manifest["knobs"])
+    assert "env" in manifest and manifest["env"]["pid"] == os.getpid()
+    assert isinstance(manifest["tunables"], dict)
+    assert isinstance(manifest["verdicts"], list)
+    # The offline stack reads the bundle like a root: its own ledger
+    # resolves first.
+    assert ledger.find_ledger_for(path) == os.path.join(
+        path, ".ledger.jsonl"
+    )
+    listed = bundle.list_bundles(root)
+    assert [b["path"] for b in listed] == [path]
+
+
+def test_capture_disabled_and_rate_limited(tmp_path):
+    root = str(tmp_path)
+    with knobs.enable_ledger():
+        assert ledger.open_run(root) is not None
+        # conftest pins max bytes to 0: capture is off.
+        assert bundle.capture_bundle(root, trigger="manual") is None
+        with knobs.override_bundle_max_bytes(1 << 20):
+            first = bundle.capture_bundle(root, trigger="manual")
+            assert first is not None
+            # Default 5-minute rate limit: a breach storm produces one
+            # black box.
+            assert bundle.capture_bundle(root, trigger="manual") is None
+            with knobs.override_bundle_min_interval_seconds(0.0):
+                assert bundle.capture_bundle(root, trigger="manual")
+
+
+def test_tiny_budget_keeps_the_newest_ledger_tail(tmp_path):
+    root = str(tmp_path)
+    with knobs.enable_ledger():
+        assert ledger.open_run(root) is not None
+        for i in range(200):
+            ledger.post_event(
+                root, names.EVENT_STEP_COMMITTED, step=i, bytes_new=1
+            )
+        with knobs.override_bundle_max_bytes(2048):
+            path = bundle.capture_bundle(root, trigger="manual")
+    assert path is not None
+    manifest = bundle.load_manifest(path)
+    entry = next(
+        f for f in manifest["files"] if f["name"] == ".ledger.jsonl"
+    )
+    assert entry["truncated"]
+    assert manifest["bytes"] <= 2048
+    records = ledger.load_ledger(os.path.join(path, ".ledger.jsonl"))
+    # Newest-last truncation: the tail ends at the newest record.
+    assert records[-1]["step"] == 199
+
+
+def test_step_dir_root_lands_at_the_manager_root(tmp_path):
+    """The failed-op trigger hands in the op's own step dir; the bundle
+    must land at the manager root (the step dir is what retention GC
+    deletes)."""
+    root = str(tmp_path)
+    with knobs.enable_ledger(), knobs.enable_telemetry():
+        _run_manager(root, steps=(3,))
+        with knobs.override_bundle_max_bytes(1 << 20):
+            path = bundle.capture_bundle(
+                os.path.join(root, "step_3"), trigger="failed-op"
+            )
+    assert path is not None
+    assert os.path.dirname(path) == os.path.join(root, ".bundles")
+    manifest = bundle.load_manifest(path)
+    assert manifest["root"] == root
+    assert manifest["snapshot_path"] == os.path.join(root, "step_3")
+
+
+# ---------------------------------------------------------------------------
+# watchdog stall episodes
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_stall_captures_exactly_one_bundle(
+    tmp_path, monkeypatch, caplog
+):
+    """A stall episode produces exactly one bundle, and both the log
+    line and the ``watchdog:stall`` instant name it."""
+    from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+    orig_write = FSStoragePlugin.write
+    injected = []
+
+    async def slow_write(self, write_io):
+        if not injected:
+            injected.append(write_io.path)
+            await asyncio.sleep(0.7)
+        await orig_write(self, write_io)
+
+    monkeypatch.setattr(FSStoragePlugin, "write", slow_write)
+    root = str(tmp_path)
+    snap = os.path.join(root, "snap")
+    with knobs.enable_ledger(), knobs.override_bundle_max_bytes(
+        1 << 20
+    ), knobs.override_bundle_min_interval_seconds(0.0):
+        assert ledger.open_run(root) is not None
+        with knobs.override_watchdog_deadline_seconds(
+            0.15
+        ), knobs.enable_trace():
+            with caplog.at_level("ERROR"):
+                ts.Snapshot.take(
+                    snap, {"s": ts.PyTreeState(_state(n=1, size=64))}
+                )
+        time.sleep(0.3)  # grace: further scans must not re-capture
+    bundles = bundle.list_bundles(root)
+    assert len(bundles) == 1
+    assert bundles[0]["trigger"] == "watchdog-stall"
+    assert "fs" in str(bundle.load_manifest(bundles[0]["path"])["reason"]) or (
+        "span" in str(bundle.load_manifest(bundles[0]["path"])["reason"])
+    )
+    stall_logs = [
+        r.message for r in caplog.records if "incident bundle" in r.message
+    ]
+    assert any(bundles[0]["path"] in m for m in stall_logs)
+    with open(os.path.join(snap, ".trace-take-rank0.json")) as f:
+        doc = json.load(f)
+    stalls = [
+        e
+        for e in doc["traceEvents"]
+        if e["ph"] == "i" and e["name"] == names.INSTANT_WATCHDOG_STALL
+    ]
+    assert len(stalls) == 1
+    assert stalls[0]["args"]["bundle"] == bundles[0]["path"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: injected breach end-to-end + offline reproduction
+# ---------------------------------------------------------------------------
+
+
+def _breach_overrides():
+    """The injection geometry: an impossible visible budget makes every
+    take a bad sample; the overhead objective is disabled so exactly
+    ONE objective breaches (real sleeps would make the overhead
+    fraction nondeterministic)."""
+    return (
+        knobs.override_async_visible_budget_seconds(0.0001),
+        knobs.override_slo_overhead_fraction(0),
+    )
+
+
+def test_injected_breach_posts_one_event_and_one_bundle(tmp_path):
+    root = str(tmp_path)
+    o1, o2 = _breach_overrides()
+    with knobs.enable_ledger(), knobs.enable_telemetry(), knobs.enable_slo(), (
+        knobs.override_bundle_max_bytes(1 << 20)
+    ), knobs.override_bundle_min_interval_seconds(0.0), o1, o2:
+        _run_manager(root, steps=(0, 1, 2))
+    records = ledger.load_ledger(ledger.ledger_path_for(root))
+    breaches = [
+        r for r in records if r.get("event") == names.EVENT_SLO_BREACH
+    ]
+    # Edge-triggered: three breaching evaluations, ONE event.
+    assert len(breaches) == 1
+    assert breaches[0]["objective"] == names.SLO_TAKE_VISIBLE_STALL
+    bundles = bundle.list_bundles(root)
+    # One fresh-breach evaluation, ONE bundle (later evaluations saw a
+    # level, not an edge).
+    assert len(bundles) == 1
+    assert bundles[0]["trigger"] == "slo-breach"
+    manifest = bundle.load_manifest(bundles[0]["path"])
+    assert names.SLO_TAKE_VISIBLE_STALL in manifest["reason"]
+    # The bundle's own ledger tail contains the breach that triggered
+    # it — the black box records its own cause.
+    bundled = ledger.load_ledger(
+        os.path.join(bundles[0]["path"], ".ledger.jsonl")
+    )
+    assert any(
+        r.get("event") == names.EVENT_SLO_BREACH for r in bundled
+    )
+
+
+def test_relocated_bundle_reproduces_doctor_verdicts_offline(
+    tmp_path, capsys
+):
+    """THE acceptance pin: capture on a real run, move the bundle away,
+    delete the root, and ``doctor --bundle`` over the copy emits the
+    same verdict ids the live capture-time diagnosis recorded."""
+    root = str(tmp_path / "run")
+    os.makedirs(root)
+    o1, o2 = _breach_overrides()
+    with knobs.enable_ledger(), knobs.enable_telemetry(), knobs.enable_slo(), (
+        knobs.override_bundle_max_bytes(1 << 20)
+    ), knobs.override_bundle_min_interval_seconds(0.0), o1, o2:
+        _run_manager(root, steps=(0, 1))
+        bundles = bundle.list_bundles(root)
+        assert len(bundles) == 1
+        live_ids = sorted(
+            {
+                v["rule"]
+                for v in bundle.load_manifest(bundles[0]["path"])["verdicts"]
+            }
+        )
+        assert names.RULE_SLO_BURNING in live_ids
+
+        # Relocate the black box; destroy the run it came from.
+        relocated = str(tmp_path / "evidence" / "incident")
+        shutil.copytree(bundles[0]["path"], relocated)
+        shutil.rmtree(root)
+
+        # The SLO judgment reproduces offline (exit 2 = burning).
+        assert slo.main([relocated]) == 2
+        capsys.readouterr()
+
+        # doctor --bundle over the copy: same verdict ids as live. The
+        # judgment re-applies the recorded knob geometry — the
+        # manifest's ``knobs`` map is exactly what an operator replays.
+        rc = doctor.main(["--bundle", relocated, "--json"])
+        assert rc == 2
+        offline_ids = sorted(
+            {v["rule"] for v in json.loads(capsys.readouterr().out)}
+        )
+        assert offline_ids == live_ids
+
+    # Not-a-bundle paths are rejected with a pointer, not a traceback.
+    assert doctor.main(["--bundle", str(tmp_path)]) == 1
+    assert "not an incident bundle" in capsys.readouterr().out

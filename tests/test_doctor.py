@@ -826,3 +826,46 @@ def test_history_append_is_bounded_and_atomic(tmp_path):
     ) as f:
         f.write("{torn\n")
     assert len(history.load_history(history.history_path_for(str(tmp_path)))) == 2
+
+
+def test_restore_cold_start_slow_rule():
+    """restore-cold-start-slow fires when the recorded cold_start_s
+    dominates the op wall beyond the knob budget, citing the
+    {event_loop_s, plugin_open_s, native_load_s} split (the r06
+    first-trial-restore soft spot as a ranked verdict)."""
+    cold = _report(
+        kind="restore",
+        phases={"loading": 2.0},
+        cold_start_s=8.0,
+        cold_start={
+            "event_loop_s": 1.0,
+            "plugin_open_s": 2.5,
+            "native_load_s": 4.5,
+        },
+    )
+    verdicts = [
+        v
+        for v in doctor.diagnose_reports([cold])
+        if v.rule == names.RULE_RESTORE_COLD_START_SLOW
+    ]
+    assert verdicts
+    ev = verdicts[0].evidence
+    # cold_start_s runs before the phase clocks: wall = phases + cold.
+    assert ev["wall_s"] == 10.0
+    assert ev["cold_fraction"] == 0.8
+    assert ev["budget_fraction"] == 0.5
+    assert ev["plugin_open_s"] == 2.5
+    assert ev["native_load_s"] == 4.5
+
+    # Warm restores (sub-second cold start) stay quiet even at a high
+    # fraction — the floor keeps trivial ops out of the report.
+    warm = _report(kind="restore", phases={"loading": 0.1}, cold_start_s=0.4)
+    assert names.RULE_RESTORE_COLD_START_SLOW not in _rules_for([warm])
+    # Cold-but-within-budget restores stay quiet.
+    within = _report(kind="restore", phases={"loading": 9.0}, cold_start_s=2.0)
+    assert names.RULE_RESTORE_COLD_START_SLOW not in _rules_for([within])
+    # Takes never fire it, and <= 0 budget disables the rule outright.
+    take = dict(cold, kind="take")
+    assert names.RULE_RESTORE_COLD_START_SLOW not in _rules_for([take])
+    with knobs.override_cold_start_budget_fraction(0):
+        assert names.RULE_RESTORE_COLD_START_SLOW not in _rules_for([cold])

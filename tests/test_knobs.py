@@ -407,3 +407,84 @@ def test_coordination_knobs_are_tunables() -> None:
     finally:
         knobs.clear_tuner_override(knobs._BARRIER_FANOUT_ENV)
     assert knobs.get_barrier_fanout() == 16
+
+
+def test_slo_knobs() -> None:
+    """Suite default (conftest) is "0" = off; the packaged default (no
+    env var) is ON — the SLO evaluation rides every committed step
+    unless explicitly killed. Window/threshold/budget knobs carry the
+    multi-window burn-rate geometry."""
+    assert not knobs.is_slo_enabled()  # conftest pin
+    with knobs.enable_slo():
+        assert knobs.is_slo_enabled()
+    assert not knobs.is_slo_enabled()
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_SLO", None)
+    try:
+        assert knobs.is_slo_enabled()  # packaged default: on
+        with knobs.disable_slo():
+            assert not knobs.is_slo_enabled()
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_SLO"] = prev
+
+    assert knobs.get_slo_fast_window() == 8
+    assert knobs.get_slo_slow_window() == 64
+    with knobs.override_slo_windows(3, 12):
+        assert knobs.get_slo_fast_window() == 3
+        assert knobs.get_slo_slow_window() == 12
+    assert knobs.get_slo_fast_window() == 8
+    assert knobs.get_slo_fast_burn_threshold() == 2.0
+    assert knobs.get_slo_slow_burn_threshold() == 1.0
+    assert knobs.get_slo_error_budget_fraction() == 0.1
+
+    # Per-objective targets; each override context restores the prior
+    # geometry, and a <= 0 target disables the objective (asserted in
+    # test_slo.py).
+    assert knobs.get_slo_restore_seconds() == 60.0
+    with knobs.override_slo_restore_seconds(0.5):
+        assert knobs.get_slo_restore_seconds() == 0.5
+    assert knobs.get_slo_restore_seconds() == 60.0
+    assert knobs.get_slo_mirror_lag_seconds() == 120.0
+    with knobs.override_slo_mirror_lag_seconds(2.0):
+        assert knobs.get_slo_mirror_lag_seconds() == 2.0
+    assert knobs.get_slo_overhead_fraction() == 0.1
+    with knobs.override_slo_overhead_fraction(0.5):
+        assert knobs.get_slo_overhead_fraction() == 0.5
+    assert knobs.get_slo_coordination_fraction() == 0.3
+    with knobs.override_slo_coordination_fraction(0.9):
+        assert knobs.get_slo_coordination_fraction() == 0.9
+
+
+def test_bundle_knobs(tmp_path) -> None:
+    """Suite default (conftest) zeroes the size cap = capture disabled;
+    the packaged default is a 64 MiB cap with a 5-minute per-dir rate
+    limit. The bundle dir defaults to <root>/.bundles (getter: None)."""
+    assert knobs.get_bundle_max_bytes() == 0  # conftest pin
+    with knobs.override_bundle_max_bytes(1024):
+        assert knobs.get_bundle_max_bytes() == 1024
+    assert knobs.get_bundle_max_bytes() == 0
+    prev = os.environ.pop("TORCHSNAPSHOT_TPU_BUNDLE_MAX_BYTES", None)
+    try:
+        assert knobs.get_bundle_max_bytes() == 64 * 1024 * 1024
+    finally:
+        if prev is not None:
+            os.environ["TORCHSNAPSHOT_TPU_BUNDLE_MAX_BYTES"] = prev
+
+    assert knobs.get_bundle_dir() is None
+    with knobs.override_bundle_dir(str(tmp_path)):
+        assert knobs.get_bundle_dir() == str(tmp_path)
+    assert knobs.get_bundle_dir() is None
+
+    assert knobs.get_bundle_min_interval_seconds() == 300.0
+    with knobs.override_bundle_min_interval_seconds(0.0):
+        assert knobs.get_bundle_min_interval_seconds() == 0.0
+    assert knobs.get_bundle_min_interval_seconds() == 300.0
+
+
+def test_cold_start_budget_fraction_knob() -> None:
+    assert knobs.get_cold_start_budget_fraction() == 0.5
+    with knobs.override_cold_start_budget_fraction(0.1):
+        assert knobs.get_cold_start_budget_fraction() == 0.1
+    with knobs.override_cold_start_budget_fraction(0):
+        assert knobs.get_cold_start_budget_fraction() == 0  # rule off
+    assert knobs.get_cold_start_budget_fraction() == 0.5

@@ -1,0 +1,248 @@
+"""SLO engine: burn-window math pins, edge-triggered breach events,
+gauge export, and the CLI judgment.
+
+The burn arithmetic tests use the packaged geometry (fast 8 @ 2.0,
+slow 64 @ 1.0, error budget 0.1) so the numbers here double as the
+documented examples: a cliff burns the fast window at 10.0, a 1-in-7
+drift burns the slow window at ~1.41 while the fast window sits at
+1.25 (below its 2.0 bar).
+"""
+
+import pytest
+
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.telemetry import ledger, names, slo
+import torchsnapshot_tpu.telemetry as telemetry
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    telemetry.reset_metrics()
+    ledger.reset_owned_roots()
+    slo.reset_slo_state()
+    yield
+    telemetry.reset_metrics()
+    ledger.reset_owned_roots()
+    slo.reset_slo_state()
+
+
+def _stall_records(values):
+    """Synthetic visible-stall ledger records, one second apart."""
+    return [
+        {
+            "event": names.EVENT_VISIBLE_STALL,
+            "unix_ts": T0 + i,
+            "step": i,
+            "visible_s": v,
+        }
+        for i, v in enumerate(values)
+    ]
+
+
+def _entry(results, slo_id):
+    return next(o for o in results if o["objective"] == slo_id)
+
+
+# ---------------------------------------------------------------------------
+# burn-window arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_cliff_fires_the_fast_window():
+    """60 healthy samples then 8 bad ones: the fast window burns at
+    (8/8)/0.1 = 10.0 >= 2.0 and the objective breaches immediately."""
+    records = _stall_records([0.1] * 60 + [10.0] * 8)
+    entry = _entry(slo.evaluate(records), names.SLO_TAKE_VISIBLE_STALL)
+    assert not entry["disabled"]
+    assert entry["samples"] == 68
+    assert entry["last_value"] == 10.0
+    assert entry["fast"]["bad"] == 8
+    assert entry["fast"]["burn"] == 10.0
+    assert entry["breaching"]
+    assert entry["burn_rate"] == 10.0
+
+
+def test_drift_fires_the_slow_window_only():
+    """One bad take in seven, sustained for 64 samples: the slow
+    window burns at (9/64)/0.1 ~ 1.41 >= 1.0, while the fast window's
+    single bad sample burns at 1.25 < 2.0 — exactly the shape a short
+    window averages away."""
+    values = [10.0 if i % 7 == 6 else 0.1 for i in range(64)]
+    entry = _entry(
+        slo.evaluate(_stall_records(values)), names.SLO_TAKE_VISIBLE_STALL
+    )
+    assert entry["slow"]["bad"] == 9
+    assert entry["slow"]["burn"] == pytest.approx(1.4062, abs=1e-3)
+    assert entry["fast"]["bad"] == 1
+    assert entry["fast"]["burn"] == 1.25
+    assert entry["breaching"]
+    # The breach is the slow window's alone.
+    assert entry["fast"]["burn"] < entry["fast"]["threshold"]
+    assert entry["slow"]["burn"] >= entry["slow"]["threshold"]
+
+
+def test_healthy_run_reports_zero_burn():
+    records = _stall_records([0.1] * 100)
+    entry = _entry(slo.evaluate(records), names.SLO_TAKE_VISIBLE_STALL)
+    assert entry["burn_rate"] == 0.0
+    assert not entry["breaching"]
+    # No evidence is not a breach either.
+    empty = _entry(slo.evaluate([]), names.SLO_TAKE_VISIBLE_STALL)
+    assert empty["samples"] == 0
+    assert not empty["breaching"]
+
+
+def test_nonpositive_target_disables_one_objective():
+    """<= 0 target disables that objective alone — the rest keep being
+    judged (here restore-wall goes dark while take-visible-stall still
+    breaches)."""
+    records = _stall_records([10.0] * 8) + [
+        {
+            "event": names.EVENT_RESTORE_SERVED,
+            "unix_ts": T0 + 100,
+            "restore_s": 1e6,
+        }
+    ]
+    with knobs.override_slo_restore_seconds(0):
+        results = slo.evaluate(records)
+    restore = _entry(results, names.SLO_RESTORE_WALL)
+    assert restore["disabled"]
+    assert not restore["breaching"]
+    assert restore["fast"] is None and restore["slow"] is None
+    assert _entry(results, names.SLO_TAKE_VISIBLE_STALL)["breaching"]
+
+
+def test_window_knobs_reshape_the_judgment():
+    """A <= 0 window is disabled outright; shrunk windows change what
+    counts as recent."""
+    records = _stall_records([10.0] * 2 + [0.1] * 6)
+    with knobs.override_slo_windows(2, 0):
+        entry = _entry(
+            slo.evaluate(records), names.SLO_TAKE_VISIBLE_STALL
+        )
+    assert entry["slow"] is None
+    assert entry["fast"]["samples"] == 2  # the two newest are healthy
+    assert entry["fast"]["bad"] == 0
+    assert not entry["breaching"]
+
+
+def test_overhead_samples_reset_at_run_start():
+    """The goodput-overhead extractor charges visible stall + restore
+    wall to the commit interval that paid it — and a run restart's gap
+    is never an interval."""
+    records = [
+        {"event": names.EVENT_RUN_START, "unix_ts": T0},
+        # Interval 1: 5s of stall over 10s of wall = 0.5 overhead.
+        {
+            "event": names.EVENT_VISIBLE_STALL,
+            "unix_ts": T0 + 4,
+            "visible_s": 5.0,
+        },
+        {"event": names.EVENT_STEP_COMMITTED, "unix_ts": T0 + 10, "step": 1},
+        # Restart: the 1000s gap must not appear as an interval.
+        {"event": names.EVENT_RUN_START, "unix_ts": T0 + 1000},
+        # Interval 2: clean 10s interval = 0.0 overhead.
+        {
+            "event": names.EVENT_STEP_COMMITTED,
+            "unix_ts": T0 + 1010,
+            "step": 2,
+        },
+    ]
+    samples = slo._overhead_samples(records, [])
+    assert samples == [(T0 + 10, 0.5), (T0 + 1010, 0.0)]
+
+
+def test_coordination_samples_come_from_history():
+    history = [
+        {"kind": "take", "unix_ts": T0, "take_s": 10.0, "coordination_s": 4.0},
+        {"kind": "restore", "unix_ts": T0 + 1, "take_s": 9.0},
+        {
+            "kind": "async_take",
+            "unix_ts": T0 + 2,
+            "take_s": 2.0,
+            "coordination_s": 1.0,
+        },
+    ]
+    samples = slo._coordination_samples([], history)
+    assert samples == [(T0, 0.4), (T0 + 2, 0.5)]
+
+
+# ---------------------------------------------------------------------------
+# evaluate_step: gauges + edge-triggered breach events
+# ---------------------------------------------------------------------------
+
+
+def _breach_ready_root(tmp_path):
+    """A real ledger (written through the API) whose visible stalls
+    blow the 5s async visible budget — take-visible-stall burns."""
+    root = str(tmp_path)
+    assert ledger.open_run(root) is not None
+    for i in range(8):
+        ledger.post_event(
+            root,
+            names.EVENT_VISIBLE_STALL,
+            step=i,
+            kind="async_take",
+            visible_s=50.0,
+            unix_ts=T0 + i,
+        )
+    return root
+
+
+def test_evaluate_step_posts_one_breach_event_per_episode(tmp_path):
+    with knobs.enable_ledger(), knobs.enable_slo():
+        root = _breach_ready_root(tmp_path)
+        first = slo.evaluate_step(root, step=8)
+        assert names.SLO_TAKE_VISIBLE_STALL in first["breaching"]
+        # Still breaching on the next step: edge-triggered, no new event.
+        ledger.post_event(
+            root,
+            names.EVENT_VISIBLE_STALL,
+            step=8,
+            kind="async_take",
+            visible_s=50.0,
+            unix_ts=T0 + 8,
+        )
+        second = slo.evaluate_step(root, step=9)
+        assert names.SLO_TAKE_VISIBLE_STALL in second["breaching"]
+        records = ledger.load_ledger(ledger.ledger_path_for(root))
+        breaches = [
+            r for r in records if r.get("event") == names.EVENT_SLO_BREACH
+        ]
+        assert len(breaches) == 1
+        breach = breaches[0]
+        assert breach["objective"] == names.SLO_TAKE_VISIBLE_STALL
+        assert breach["step"] == 8
+        assert breach["fast_burn"] == 10.0
+        assert breach["last_value"] == 50.0
+
+
+def test_evaluate_step_exports_burn_gauges_and_counter(tmp_path):
+    with knobs.enable_ledger(), knobs.enable_slo():
+        root = _breach_ready_root(tmp_path)
+        slo.evaluate_step(root, step=8)
+    collected = telemetry.metrics().collect()
+    key = telemetry.series_key(
+        names.OBJECTIVE_BURN_RATE,
+        {"objective": names.SLO_TAKE_VISIBLE_STALL},
+    )
+    assert collected["gauges"][key] == 10.0
+    counter_key = telemetry.series_key(
+        names.OBJECTIVE_BREACHES_TOTAL,
+        {"objective": names.SLO_TAKE_VISIBLE_STALL},
+    )
+    assert collected["counters"][counter_key] == 1.0
+    # The fleet plane's published burn is the max across objectives.
+    assert slo.current_burn() == 10.0
+
+
+def test_slo_cli_exit_codes(tmp_path, capsys):
+    with knobs.enable_ledger(), knobs.enable_slo():
+        root = _breach_ready_root(tmp_path)
+        assert slo.main([root]) == 2  # burning
+        out = capsys.readouterr().out
+        assert "BURNING" in out
+        assert names.SLO_TAKE_VISIBLE_STALL in out
+    assert slo.main([str(tmp_path / "nowhere")]) == 1  # no ledger

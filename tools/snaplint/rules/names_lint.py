@@ -44,6 +44,7 @@ _SPAN_PREFIXES = ("SPAN_", "INSTANT_")
 _RULE_PREFIX = "RULE_"
 _EVENT_PREFIX = "EVENT_"
 _CRASH_PREFIX = "CRASH_"
+_SLO_PREFIX = "SLO_"
 _REGISTRY_METHODS = {"counter_inc", "gauge_set", "histogram_observe"}
 _TRACE_CALLABLES = {"trace_annotation", "span", "instant", "begin"}
 # Doctor emit surfaces: the rule-registration decorator and the verdict
@@ -71,6 +72,13 @@ _RPC_PREFIX = "RPC_"
 # means the crash-matrix registry (the CRASH_ constants the harness
 # enumerates) can drift from the threaded points.
 _CRASHPOINT_CALLABLES = {"crashpoint", "_crashpoint", "arm"}
+# SLO declaration surface (telemetry/slo.py): every objective enters
+# the engine through an ``Objective(...)`` construction whose first
+# positional argument (or ``slo_id=`` keyword) is the declared id. A
+# literal id there means the promised-objective namespace — breach
+# events, burn gauges, the doctor's slo-burning evidence — can drift
+# from the names.py registry.
+_SLO_CALLABLES = {"Objective"}
 
 NAMES_RELPATH = "torchsnapshot_tpu/telemetry/names.py"
 TRACE_EXEMPT_RELPATH = "torchsnapshot_tpu/telemetry/trace.py"
@@ -90,16 +98,17 @@ def check_metric_names_file(
     include_event_decls: bool = True,
     include_crash_decls: bool = True,
     include_rpc_decls: bool = True,
+    include_slo_decls: bool = True,
 ) -> List[str]:
     """Errors in the declaration file: malformed values (snake_case for
     metrics, colon-case for SPAN_/INSTANT_ trace names, kebab-case for
-    RULE_ doctor-verdict ids, EVENT_ ledger events, CRASH_ crash points
-    and RPC_ wire op ids), duplicate constants, duplicate values. The
-    ``include_*_decls=False`` flags leave the SPAN_/INSTANT_, RULE_,
-    EVENT_, CRASH_ and RPC_ checks to the span / doctor / ledger /
-    crashpoint / rpc rules (the unified registry runs all six; each
-    defect should report once — with the flag off, those constants are
-    skipped here entirely)."""
+    RULE_ doctor-verdict ids, EVENT_ ledger events, CRASH_ crash points,
+    RPC_ wire op ids and SLO_ objective ids), duplicate constants,
+    duplicate values. The ``include_*_decls=False`` flags leave the
+    SPAN_/INSTANT_, RULE_, EVENT_, CRASH_, RPC_ and SLO_ checks to the
+    span / doctor / ledger / crashpoint / rpc / slo rules (the unified
+    registry runs all seven; each defect should report once — with the
+    flag off, those constants are skipped here entirely)."""
     errors = []
     if not path.exists():
         return [f"{path.name}: missing (metric names must be declared here)"]
@@ -123,6 +132,8 @@ def check_metric_names_file(
             ):
                 continue
             if not include_rpc_decls and target.id.startswith(_RPC_PREFIX):
+                continue
+            if not include_slo_decls and target.id.startswith(_SLO_PREFIX):
                 continue
             if not include_span_decls and target.id.startswith(
                 _SPAN_PREFIXES
@@ -171,6 +182,13 @@ def check_metric_names_file(
                         f"{path.name}:{node.lineno}: {value!r} is not "
                         f"kebab-case (wire RPC op ids look like "
                         f"'layer-operation')"
+                    )
+            elif target.id.startswith(_SLO_PREFIX):
+                if not _KEBAB_CASE.match(value):
+                    errors.append(
+                        f"{path.name}:{node.lineno}: {value!r} is not "
+                        f"kebab-case (slo ids look like "
+                        f"'what-is-promised')"
                     )
             elif not _SNAKE_CASE.match(value):
                 errors.append(
@@ -314,6 +332,21 @@ def check_crashpoint_ids_file(path: Path) -> List[str]:
     )
 
 
+def check_slo_ids_file(path: Path) -> List[str]:
+    """Errors in the declaration file's SLO objective registry: no SLO_
+    constants at all, non-kebab-case values, duplicate
+    constants/values."""
+    return _scan_prefixed_decls(
+        path,
+        (_SLO_PREFIX,),
+        _KEBAB_CASE,
+        "kebab-case ('what-is-promised')",
+        "slo id",
+        "slo ids",
+        "no slo ids declared",
+    )
+
+
 def check_rpc_op_ids_file(path: Path) -> List[str]:
     """Errors in the declaration file's wire RPC op registry: no RPC_
     constants at all, non-kebab-case values, duplicate
@@ -443,6 +476,31 @@ def _iter_crashpoint_literal_sites(
             candidates.append(node.args[0])
         for kw in node.keywords:
             if kw.arg == "name":
+                candidates.append(kw.value)
+        for cand in candidates:
+            if isinstance(cand, ast.Constant) and isinstance(
+                cand.value, str
+            ):
+                yield node.lineno, called, cand.value
+
+
+def _iter_slo_literal_sites(
+    tree: ast.AST,
+) -> Iterator[Tuple[int, str, str]]:
+    """(lineno, callable, literal) for string-literal slo ids at
+    objective declaration sites: the first positional arg of
+    ``Objective(...)`` or its ``slo_id=`` keyword."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        called = _called_name(node.func)
+        if called not in _SLO_CALLABLES:
+            continue
+        candidates = []
+        if node.args:
+            candidates.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "slo_id":
                 candidates.append(kw.value)
         for cand in candidates:
             if isinstance(cand, ast.Constant) and isinstance(
@@ -610,6 +668,7 @@ class MetricNameLiteral(Rule):
                 include_event_decls=False,
                 include_crash_decls=False,
                 include_rpc_decls=False,
+                include_slo_decls=False,
             ),
             project,
         )
@@ -753,6 +812,37 @@ class RpcOpIds(Rule):
                     message=(
                         f"literal rpc op id {literal!r} in {called}() — "
                         f"use a telemetry/names.py RPC_ constant"
+                    ),
+                )
+
+
+@register
+class SloIds(Rule):
+    name = "slo-ids"
+    description = (
+        "slo objective ids: kebab-case, declared exactly once in "
+        "telemetry/names.py (SLO_ constants), no literal ids at "
+        "Objective(...) declaration sites"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        names_file = project.root / NAMES_RELPATH
+        if not _package_dir(project).is_dir() or not names_file.exists():
+            return
+        yield from _decl_findings(
+            self.name, check_slo_ids_file(names_file), project
+        )
+        for relpath, tree in _package_trees(project):
+            if relpath == NAMES_RELPATH:
+                continue
+            for lineno, called, literal in _iter_slo_literal_sites(tree):
+                yield Finding(
+                    rule=self.name,
+                    path=relpath,
+                    line=lineno,
+                    message=(
+                        f"literal slo id {literal!r} in {called}() — "
+                        f"use a telemetry/names.py SLO_ constant"
                     ),
                 )
 

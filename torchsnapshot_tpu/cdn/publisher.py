@@ -134,9 +134,21 @@ class CdnPublisher:
         )
         if self._fleet is not None:
             try:
+                extra = {"seq": seq, "chunks": len(chunks)}
+                # The training job's SLO burn rides the plane so
+                # ``telemetry fleet`` shows which member is spending
+                # its error budget (the BURN column).
+                try:
+                    from ..telemetry import slo
+
+                    burn = slo.current_burn()
+                    if burn is not None:
+                        extra["slo_burn"] = round(burn, 4)
+                except Exception:  # noqa: BLE001
+                    pass
                 self._fleet.publish(
                     phase=f"published:{int(step)}",
-                    extra={"seq": seq, "chunks": len(chunks)},
+                    extra=extra,
                 )
             except Exception:  # noqa: BLE001 - observability never blocks
                 pass

@@ -1249,6 +1249,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "  full attribution: "
                 "python -m torchsnapshot_tpu.telemetry goodput <root>"
             )
+        # Captured incident bundles (telemetry/bundle.py): the black
+        # boxes an SLO breach / watchdog stall / failed op froze —
+        # listed so an audit surfaces them before a cleanup pass does.
+        try:
+            from .telemetry.bundle import list_bundles
+
+            bundles = list_bundles(args.path)
+        except Exception:  # noqa: BLE001 - listing is best-effort
+            bundles = []
+        if bundles:
+            print()
+            print(f"incident bundles ({len(bundles)}):")
+            for b in bundles:
+                print(
+                    f"  {b['path']}: trigger {b.get('trigger')!r}, "
+                    f"{b.get('files', 0)} files, {b.get('bytes', 0)} "
+                    f"bytes"
+                )
+            print(
+                "  analyze: python -m torchsnapshot_tpu.telemetry "
+                "doctor --bundle <path>"
+            )
         verdicts = diagnose_evidence(evidence)
         if verdicts:
             print()

@@ -82,6 +82,24 @@ _TREE_BARRIER_ENV = "TORCHSNAPSHOT_TPU_TREE_BARRIER"
 _BARRIER_FANOUT_ENV = "TORCHSNAPSHOT_TPU_BARRIER_FANOUT"
 _STORE_SHARDS_ENV = "TORCHSNAPSHOT_TPU_STORE_SHARDS"
 _FLEET_OBS_ENV = "TORCHSNAPSHOT_TPU_FLEET_OBS"
+_SLO_ENV = "TORCHSNAPSHOT_TPU_SLO"
+_SLO_FAST_WINDOW_ENV = "TORCHSNAPSHOT_TPU_SLO_FAST_WINDOW"
+_SLO_SLOW_WINDOW_ENV = "TORCHSNAPSHOT_TPU_SLO_SLOW_WINDOW"
+_SLO_FAST_BURN_ENV = "TORCHSNAPSHOT_TPU_SLO_FAST_BURN_THRESHOLD"
+_SLO_SLOW_BURN_ENV = "TORCHSNAPSHOT_TPU_SLO_SLOW_BURN_THRESHOLD"
+_SLO_ERROR_BUDGET_ENV = "TORCHSNAPSHOT_TPU_SLO_ERROR_BUDGET_FRACTION"
+_SLO_RESTORE_BUDGET_ENV = "TORCHSNAPSHOT_TPU_SLO_RESTORE_SECONDS"
+_SLO_MIRROR_LAG_BUDGET_ENV = "TORCHSNAPSHOT_TPU_SLO_MIRROR_LAG_SECONDS"
+_SLO_OVERHEAD_BUDGET_ENV = "TORCHSNAPSHOT_TPU_SLO_OVERHEAD_FRACTION"
+_SLO_COORD_BUDGET_ENV = "TORCHSNAPSHOT_TPU_SLO_COORDINATION_FRACTION"
+_BUNDLE_DIR_ENV = "TORCHSNAPSHOT_TPU_BUNDLE_DIR"
+_BUNDLE_MAX_BYTES_ENV = "TORCHSNAPSHOT_TPU_BUNDLE_MAX_BYTES"
+_BUNDLE_MIN_INTERVAL_ENV = (
+    "TORCHSNAPSHOT_TPU_BUNDLE_MIN_INTERVAL_SECONDS"
+)
+_COLD_START_BUDGET_FRACTION_ENV = (
+    "TORCHSNAPSHOT_TPU_COLD_START_BUDGET_FRACTION"
+)
 
 _DEFAULT_TRACE_BUFFER_EVENTS: int = 16384
 _DEFAULT_WATCHDOG_SECONDS: float = 60.0
@@ -110,6 +128,19 @@ _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES: int = 128 * 1024 * 1024
 _DEFAULT_INCREMENTAL_CHUNK_SIZE_BYTES: int = 16 * 1024 * 1024
 _DEFAULT_RESTORE_FLUSH_BYTES: int = 128 * 1024 * 1024
 _DEFAULT_MEMORY_BUDGET_FRACTION: float = 0.6
+
+_DEFAULT_SLO_FAST_WINDOW: int = 8
+_DEFAULT_SLO_SLOW_WINDOW: int = 64
+_DEFAULT_SLO_FAST_BURN_THRESHOLD: float = 2.0
+_DEFAULT_SLO_SLOW_BURN_THRESHOLD: float = 1.0
+_DEFAULT_SLO_ERROR_BUDGET_FRACTION: float = 0.1
+_DEFAULT_SLO_RESTORE_SECONDS: float = 60.0
+_DEFAULT_SLO_MIRROR_LAG_SECONDS: float = 120.0
+_DEFAULT_SLO_OVERHEAD_FRACTION: float = 0.1
+_DEFAULT_SLO_COORDINATION_FRACTION: float = 0.3
+_DEFAULT_BUNDLE_MAX_BYTES: int = 64 * 1024 * 1024
+_DEFAULT_BUNDLE_MIN_INTERVAL_SECONDS: float = 300.0
+_DEFAULT_COLD_START_BUDGET_FRACTION: float = 0.5
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -621,6 +652,140 @@ def get_cdn_pull_timeout_seconds() -> float:
     if val is not None:
         return float(val)
     return get_peer_transfer_timeout_seconds()
+
+
+def is_slo_enabled() -> bool:
+    """The rank-0 per-step SLO evaluation (telemetry/slo.py): on by
+    default — each committed manager step re-judges the declared
+    objectives with multi-window burn-rate math over the run ledger and
+    step history, exports ``slo_burn_rate{objective}`` gauges, and
+    posts an edge-triggered ``slo-breach`` ledger event when an
+    objective starts burning. Set to ``"0"`` to disable the whole
+    evaluation (the test conftest pins 0 so tier-1 manager runs stay
+    deterministic); needs the ledger on to have samples to judge."""
+    return os.environ.get(_SLO_ENV, "1") != "0"
+
+
+def get_slo_fast_window() -> int:
+    """Sample count of the fast burn window: the last-N-samples look
+    that catches cliffs (a plugin suddenly slow, a tier gone). <= 0
+    disables the fast window (breaches then need the slow window)."""
+    val = os.environ.get(_SLO_FAST_WINDOW_ENV)
+    if val is not None:
+        return int(val)
+    return _DEFAULT_SLO_FAST_WINDOW
+
+
+def get_slo_slow_window() -> int:
+    """Sample count of the slow burn window: the long look that
+    catches drift a fast window averages away. <= 0 disables it."""
+    val = os.environ.get(_SLO_SLOW_WINDOW_ENV)
+    if val is not None:
+        return int(val)
+    return _DEFAULT_SLO_SLOW_WINDOW
+
+
+def get_slo_fast_burn_threshold() -> float:
+    """Burn-rate threshold for the fast window (burn 1.0 = spending
+    error budget exactly at the sustainable rate; the higher fast
+    threshold demands a real cliff, not one unlucky sample)."""
+    val = os.environ.get(_SLO_FAST_BURN_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_FAST_BURN_THRESHOLD
+
+
+def get_slo_slow_burn_threshold() -> float:
+    """Burn-rate threshold for the slow window (1.0 = any sustained
+    overspend of the error budget fires)."""
+    val = os.environ.get(_SLO_SLOW_BURN_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_SLOW_BURN_THRESHOLD
+
+
+def get_slo_error_budget_fraction() -> float:
+    """Allowed bad-sample fraction per objective (the error budget):
+    burn rate = observed bad fraction / this. The 0.1 default tolerates
+    one slow op in ten before an objective burns at rate 1.0."""
+    val = os.environ.get(_SLO_ERROR_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_ERROR_BUDGET_FRACTION
+
+
+def get_slo_restore_seconds() -> float:
+    """Target of the ``restore-wall`` objective: a restore serving
+    slower than this is a bad sample. <= 0 disables the objective."""
+    val = os.environ.get(_SLO_RESTORE_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_RESTORE_SECONDS
+
+
+def get_slo_mirror_lag_seconds() -> float:
+    """Target of the ``mirror-durability-lag`` objective: a step whose
+    bytes existed only on the fast tier longer than this is a bad
+    sample. <= 0 disables the objective."""
+    val = os.environ.get(_SLO_MIRROR_LAG_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_MIRROR_LAG_SECONDS
+
+
+def get_slo_overhead_fraction() -> float:
+    """Target of the ``goodput-overhead`` objective: a commit interval
+    whose checkpoint overhead (visible stall + restore) exceeds this
+    fraction of the interval's wall is a bad sample. <= 0 disables."""
+    val = os.environ.get(_SLO_OVERHEAD_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_OVERHEAD_FRACTION
+
+
+def get_slo_coordination_fraction() -> float:
+    """Target of the ``coordination-fraction`` objective: a take whose
+    coordination share of the op wall exceeds this fraction is a bad
+    sample. <= 0 disables the objective."""
+    val = os.environ.get(_SLO_COORD_BUDGET_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_SLO_COORDINATION_FRACTION
+
+
+def get_bundle_dir() -> Optional[str]:
+    """Where incident bundles land. Unset = ``<root>/.bundles`` next to
+    the snapshot root that triggered the capture (kept on the local
+    tier for tiered roots so a bundle survives remote-tier cleanup)."""
+    return os.environ.get(_BUNDLE_DIR_ENV) or None
+
+
+def get_bundle_max_bytes() -> int:
+    """Size cap per incident bundle: artifact copies stop (JSONL tails
+    are truncated to fit) once the bundle reaches this many bytes. <= 0
+    disables bundle capture entirely (the test conftest pins 0 so no
+    trigger in tier-1 ever writes a ``.bundles/`` dir)."""
+    return _get_int_env(_BUNDLE_MAX_BYTES_ENV, _DEFAULT_BUNDLE_MAX_BYTES)
+
+
+def get_bundle_min_interval_seconds() -> float:
+    """Rate limit between bundle captures per bundle dir: a breach
+    storm produces one black box, not one per step."""
+    val = os.environ.get(_BUNDLE_MIN_INTERVAL_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_BUNDLE_MIN_INTERVAL_SECONDS
+
+
+def get_cold_start_budget_fraction() -> float:
+    """Threshold for the doctor's ``restore-cold-start-slow`` rule: a
+    restore whose recorded ``cold_start_s`` (event-loop spin-up +
+    plugin open + native-module load) exceeds this fraction of the op
+    wall is flagged with its split. <= 0 disables the rule."""
+    val = os.environ.get(_COLD_START_BUDGET_FRACTION_ENV)
+    if val is not None:
+        return float(val)
+    return _DEFAULT_COLD_START_BUDGET_FRACTION
 
 
 def is_write_vectorized_enabled() -> bool:
@@ -1144,4 +1309,93 @@ def override_mirror_progress_window_seconds(
     seconds: float,
 ) -> Generator[None, None, None]:
     with _override_env(_MIRROR_PROGRESS_WINDOW_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def enable_slo() -> Generator[None, None, None]:
+    """Force the per-step SLO evaluation ON for the block (the suite's
+    conftest pins it off so tier-1 manager runs post no slo-breach
+    events; SLO tests opt back in here)."""
+    with _override_env(_SLO_ENV, "1"):
+        yield
+
+
+@contextlib.contextmanager
+def disable_slo() -> Generator[None, None, None]:
+    with _override_env(_SLO_ENV, "0"):
+        yield
+
+
+@contextlib.contextmanager
+def override_slo_windows(
+    fast: int, slow: int
+) -> Generator[None, None, None]:
+    """Pin both burn windows for the block (unit pins drive exact
+    sample counts through them)."""
+    with _override_env(_SLO_FAST_WINDOW_ENV, str(fast)):
+        with _override_env(_SLO_SLOW_WINDOW_ENV, str(slow)):
+            yield
+
+
+@contextlib.contextmanager
+def override_slo_restore_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_SLO_RESTORE_BUDGET_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_slo_mirror_lag_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_SLO_MIRROR_LAG_BUDGET_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_slo_overhead_fraction(
+    fraction: float,
+) -> Generator[None, None, None]:
+    with _override_env(_SLO_OVERHEAD_BUDGET_ENV, str(fraction)):
+        yield
+
+
+@contextlib.contextmanager
+def override_slo_coordination_fraction(
+    fraction: float,
+) -> Generator[None, None, None]:
+    with _override_env(_SLO_COORD_BUDGET_ENV, str(fraction)):
+        yield
+
+
+@contextlib.contextmanager
+def override_bundle_dir(path: str) -> Generator[None, None, None]:
+    with _override_env(_BUNDLE_DIR_ENV, path):
+        yield
+
+
+@contextlib.contextmanager
+def override_bundle_max_bytes(nbytes: int) -> Generator[None, None, None]:
+    """Re-enable (and bound) bundle capture for the block (the suite's
+    conftest pins the cap to 0 = capture disabled; bundle tests opt
+    back in here)."""
+    with _override_env(_BUNDLE_MAX_BYTES_ENV, str(nbytes)):
+        yield
+
+
+@contextlib.contextmanager
+def override_bundle_min_interval_seconds(
+    seconds: float,
+) -> Generator[None, None, None]:
+    with _override_env(_BUNDLE_MIN_INTERVAL_ENV, str(seconds)):
+        yield
+
+
+@contextlib.contextmanager
+def override_cold_start_budget_fraction(
+    fraction: float,
+) -> Generator[None, None, None]:
+    with _override_env(_COLD_START_BUDGET_FRACTION_ENV, str(fraction)):
         yield

@@ -264,6 +264,7 @@ class _PendingManagedSnapshot:
             telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
             self._manager._record_step_history(self._step)
             self._manager._post_step_ledger(self._step, snapshot)
+            self._manager._evaluate_slos(self._step)
             self._manager._publish_cdn_step(self._step, snapshot)
             self._manager._autotune_step(self._step)
             self._committed = True
@@ -490,6 +491,7 @@ class CheckpointManager:
         telemetry.metrics().counter_inc(metric_names.MANAGER_SAVES_TOTAL)
         self._record_step_history(step)
         self._post_step_ledger(step, snapshot)
+        self._evaluate_slos(step)
         self._publish_cdn_step(step, snapshot)
         self._autotune_step(step)
         return snapshot
@@ -629,6 +631,29 @@ class CheckpointManager:
         except Exception as e:  # noqa: BLE001 - ledger is best-effort
             logger.warning(
                 "could not post step %d to the run ledger: %r", step, e
+            )
+
+    def _evaluate_slos(self, step: int) -> None:
+        """Re-judge the declared SLOs against the run's recorded
+        evidence at the retention-visible moment (telemetry/slo.py):
+        refreshes the burn-rate gauges, posts an edge-triggered
+        ``slo-breach`` ledger event per objective episode, and captures
+        one incident bundle per evaluation that saw a fresh breach.
+        Rank 0 only — the evidence it judges is rank-0-recorded;
+        best-effort (a judgment must never fail a save)."""
+        if (
+            self._pg.get_rank() != 0
+            or not knobs.is_slo_enabled()
+            or not knobs.is_ledger_enabled()
+        ):
+            return
+        try:
+            from .telemetry import slo
+
+            slo.evaluate_step(self.root, step)
+        except Exception as e:  # noqa: BLE001 - the SLO engine is best-effort
+            logger.warning(
+                "could not evaluate SLOs at step %d: %r", step, e
             )
 
     def _publish_cdn_step(self, step: int, snapshot: Snapshot) -> None:

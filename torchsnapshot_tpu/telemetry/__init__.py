@@ -37,6 +37,16 @@ evidence-cited verdicts from the recorded artifacts), and a rolling
 per-manager step history with median±MAD trend regression detection
 (history.py, ``doctor --trend``).
 
+At the top of the stack, the **SLO engine** (slo.py) judges the
+recorded signals against declared objectives with multi-window
+burn-rate math at every committed step — ``slo_burn_rate{objective}``
+gauges, edge-triggered ``slo-breach`` ledger events, the fleet table's
+BURN column, the doctor's ``slo-burning`` rule — and **incident
+bundles** (bundle.py) freeze a bounded, self-contained black box of
+the evidence on SLO breach / watchdog stall / failed op, which
+``doctor --bundle``, ``telemetry slo``, and ``telemetry diff``
+re-analyze offline with the original root gone.
+
 See docs/observability.md for the metric inventory, span inventory,
 report schema, sink knobs, and CLI.
 """
@@ -44,6 +54,7 @@ report schema, sink knobs, and CLI.
 from __future__ import annotations
 
 from . import (
+    bundle,
     critpath,
     doctor,
     goodput,
@@ -51,6 +62,7 @@ from . import (
     ledger,
     names,
     progress,
+    slo,
     trace,
     watchdog,
     wire,
@@ -84,6 +96,7 @@ __all__ = [
     "SnapshotReport",
     "aggregate_across_ranks",
     "build_report",
+    "bundle",
     "clock_offsets_from_gather",
     "critpath",
     "current_progress",
@@ -107,6 +120,7 @@ __all__ = [
     "reset_trace",
     "safe_rate_mb_s",
     "series_key",
+    "slo",
     "trace",
     "watchdog",
     "wire",

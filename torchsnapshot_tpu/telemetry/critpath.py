@@ -773,8 +773,10 @@ def diff_main(argv: Optional[Sequence[str]] = None) -> int:
     """``python -m torchsnapshot_tpu.telemetry diff <A> <B>``: compare
     two steps (snapshot dirs / events files, via their recorded
     ``critical_path``) or two ``BENCH_r*.json`` records (declared
-    per-leg tolerances). Exit 0 = no regression, 2 = regression, 1 =
-    operands unusable."""
+    per-leg tolerances). Incident bundle dirs (telemetry/bundle.py)
+    work as operands unchanged — they carry a ``.telemetry.jsonl`` —
+    so two black boxes diff offline with both original roots gone.
+    Exit 0 = no regression, 2 = regression, 1 = operands unusable."""
     import argparse
 
     p = argparse.ArgumentParser(
@@ -785,7 +787,11 @@ def diff_main(argv: Optional[Sequence[str]] = None) -> int:
             "two recorded operations, with span evidence citations."
         ),
     )
-    p.add_argument("before", help="snapshot dir, events file, or BENCH_r*.json")
+    p.add_argument(
+        "before",
+        help="snapshot dir, events file, incident bundle dir, or "
+        "BENCH_r*.json",
+    )
     p.add_argument("after", help="same (compared against `before`)")
     p.add_argument(
         "--kind",

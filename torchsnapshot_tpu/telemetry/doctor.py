@@ -75,6 +75,12 @@ INTERRUPTED_STALE_MIN_S = 30.0
 # restore-read-amplified: the restore's per-plugin/storage read bytes
 # exceed the manifest-needed bytes by this factor.
 READ_AMPLIFIED_FACTOR = 1.5
+# restore-cold-start-slow: the restore's recorded ``cold_start_s``
+# (event-loop spin-up + plugin open + native-module load) exceeds the
+# knob'd fraction of the op wall
+# (TORCHSNAPSHOT_TPU_COLD_START_BUDGET_FRACTION, <= 0 disables), over
+# an absolute floor so ms-scale test restores never flag.
+COLD_START_MIN_S = 1.0
 # tuner-thrashing: an A -> B -> A value cycle for one tunable within
 # this many trailing decision-log entries (aligned with the trend
 # window: oscillation slower than the regression baseline can see is
@@ -282,6 +288,14 @@ class Evidence:
         default_factory=list
     )
     ledger_file: str = ""
+    # The manager root's step-history summaries
+    # (.telemetry-history.jsonl): the coordination-fraction samples the
+    # SLO engine judges, gathered here so ``doctor --bundle`` re-judges
+    # from a bundle's copy with the original root gone.
+    history_records: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    history_file: str = ""
 
 
 def gather_evidence(snapshot_path: str) -> Evidence:
@@ -368,6 +382,24 @@ def gather_evidence(snapshot_path: str) -> Evidence:
             ev.ledger_file = lf
     except Exception as e:  # noqa: BLE001
         logger.warning("doctor: could not load run ledger: %r", e)
+    try:
+        from .history import HISTORY_BASENAME, load_history
+        from .sink import local_fs_root
+
+        local = local_fs_root(snapshot_path)
+        if local is not None:
+            # Same two-dir probe as the tuner state above: a step dir's
+            # history lives at the manager root, a root's (or bundle's)
+            # sits adjacent.
+            parent = os.path.dirname(os.path.abspath(local))
+            for cand_dir in (local, parent):
+                cand = os.path.join(cand_dir, HISTORY_BASENAME)
+                if os.path.exists(cand):
+                    ev.history_records = load_history(cand)
+                    ev.history_file = cand
+                    break
+    except Exception as e:  # noqa: BLE001
+        logger.warning("doctor: could not load step history: %r", e)
     return ev
 
 
@@ -637,6 +669,51 @@ def _peer_tier_degraded(report: Dict[str, Any]):
             "peer_bytes": int(tier_split.get("peer", 0)),
             "fast_bytes": int(tier_split.get("fast", 0)),
             "durable_bytes": int(tier_split.get("durable", 0)),
+        },
+    }
+
+
+@doctor_rule(names.RULE_RESTORE_COLD_START_SLOW)
+def _restore_cold_start_slow(report: Dict[str, Any]):
+    """The restore spent most of its wall on process cold start —
+    event-loop spin-up, storage-plugin opens, native-module load — not
+    on moving bytes (the r06 soft spot: first-trial restores 10-28x
+    their warm cost). A warm pool / pre-opened plugin fixes this class;
+    faster storage does not. Evidence cites the recorded
+    ``{event_loop_s, plugin_open_s, native_load_s}`` split."""
+    if report.get("kind") not in ("restore", "async_restore"):
+        return None
+    budget = knobs.get_cold_start_budget_fraction()
+    if budget <= 0:
+        return None
+    cold = report.get("cold_start_s")
+    if not cold or float(cold) < COLD_START_MIN_S:
+        return None
+    cold = float(cold)
+    phases = report.get("phases") or {}
+    wall = max((float(v) for v in phases.values()), default=0.0)
+    # cold_start_s is measured before the phase clocks start: the op's
+    # true wall is the pipeline wall plus the cold start itself.
+    wall = max(wall, 0.0) + cold
+    fraction = cold / wall
+    if fraction <= budget:
+        return None
+    split = report.get("cold_start") or {}
+    return {
+        "summary": (
+            "the restore's wall is dominated by cold start (event-loop "
+            "spin-up + plugin open + native-module load), not data "
+            "movement — a warm process pool or pre-opened plugins "
+            "would cut it; faster storage would not"
+        ),
+        "evidence": {
+            "cold_start_s": round(cold, 3),
+            "wall_s": round(wall, 3),
+            "cold_fraction": round(fraction, 3),
+            "budget_fraction": budget,
+            "event_loop_s": split.get("event_loop_s"),
+            "plugin_open_s": split.get("plugin_open_s"),
+            "native_load_s": split.get("native_load_s"),
         },
     }
 
@@ -1071,6 +1148,59 @@ def _cdn_staleness_high(ev: Evidence):
         },
         "source": os.path.basename(ev.ledger_file),
     }
+
+
+@doctor_rule(names.RULE_SLO_BURNING, scope="evidence")
+def _slo_burning(ev: Evidence):
+    """A declared SLO objective is burning its error budget
+    (telemetry/slo.py): the fast window caught a cliff or the slow
+    window caught drift. One verdict per breaching objective, citing
+    the per-window burn/bad-sample counts and any ``slo-breach``
+    ledger events the live evaluation already posted. Re-judged from
+    the gathered evidence (not the live engine's state), so a bundle's
+    relocated copy reproduces the live run's verdicts exactly."""
+    if not ev.ledger_records:
+        return None
+    from . import slo
+
+    out = []
+    for obj in slo.evaluate(ev.ledger_records, ev.history_records):
+        if not obj["breaching"]:
+            continue
+        breach_events = sum(
+            1
+            for r in ev.ledger_records
+            if r.get("event") == names.EVENT_SLO_BREACH
+            and r.get("objective") == obj["objective"]
+        )
+        fast = obj["fast"] or {}
+        slow = obj["slow"] or {}
+        out.append(
+            {
+                "summary": (
+                    f"SLO objective {obj['objective']!r} "
+                    f"({obj['description']}) is burning its error "
+                    f"budget: target {obj['target']}{obj['unit']}, "
+                    f"burn rate {obj['burn_rate']:.2f}"
+                ),
+                "evidence": {
+                    "objective": obj["objective"],
+                    "target": obj["target"],
+                    "unit": obj["unit"],
+                    "last_value": obj["last_value"],
+                    "samples": obj["samples"],
+                    "fast_bad": fast.get("bad"),
+                    "fast_window": fast.get("window"),
+                    "fast_burn": fast.get("burn"),
+                    "slow_bad": slow.get("bad"),
+                    "slow_window": slow.get("window"),
+                    "slow_burn": slow.get("burn"),
+                    "breach_events": breach_events,
+                },
+                "source": os.path.basename(ev.ledger_file),
+            }
+        )
+    return out or None
 
 
 @doctor_rule(names.RULE_GOODPUT_DEGRADED, scope="evidence")
@@ -1569,8 +1699,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     p.add_argument(
         "target",
+        nargs="?",
+        default=None,
         help="snapshot path, or (with --trend) a manager root / "
         ".telemetry-history.jsonl file",
+    )
+    p.add_argument(
+        "--bundle",
+        default=None,
+        metavar="PATH",
+        help="diagnose a captured incident bundle (telemetry/bundle.py) "
+        "— the full offline analysis against the bundle's frozen "
+        "artifacts, with the original root gone",
     )
     p.add_argument(
         "--trend",
@@ -1590,6 +1730,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="machine-readable verdict list instead of the text report",
     )
     args = p.parse_args(list(argv) if argv is not None else None)
+
+    if args.bundle is not None:
+        from .bundle import is_bundle, load_manifest
+
+        if not is_bundle(args.bundle):
+            print(
+                f"doctor: {args.bundle!r} is not an incident bundle "
+                f"(no manifest.json); capture one with "
+                f"`telemetry bundle <root> --capture`"
+            )
+            return 1
+        manifest = load_manifest(args.bundle) or {}
+        if not args.json:
+            print(
+                f"doctor bundle: {args.bundle} "
+                f"(trigger {manifest.get('trigger')!r}"
+                + (
+                    f", reason {manifest.get('reason')!r}"
+                    if manifest.get("reason")
+                    else ""
+                )
+                + f", captured from {manifest.get('root')!r})"
+            )
+        # The bundle dir mimics a snapshot dir's layout, so the normal
+        # gather/diagnose path below reads it unchanged.
+        args.target = args.bundle
+    if args.target is None:
+        p.error("a target (or --bundle PATH) is required")
 
     if args.trend:
         from .history import HISTORY_BASENAME, load_history

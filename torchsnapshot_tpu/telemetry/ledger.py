@@ -321,6 +321,15 @@ def reset_owned_roots() -> None:
         _READ_CACHE.clear()
 
 
+def owned_roots() -> List[str]:
+    """Ledger paths this process opened runs for (abspaths). Rootless
+    layers needing a capture target — the stall watchdog's incident
+    bundle — resolve one here: owning the ledger is what makes this
+    process the root's rank 0."""
+    with _LOCK:
+        return sorted(_OWNED)
+
+
 def prune_steps(root: str, steps: Iterable[int]) -> Optional[str]:
     """Drop deleted steps' ``step-committed`` storage records (atomic
     rewrite) so the ledger's storage-cost view tracks what retention

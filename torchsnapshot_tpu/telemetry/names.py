@@ -196,6 +196,17 @@ GOODPUT_LOST_STEPS = "goodput_lost_steps"
 GOODPUT_STORAGE_BYTES_PER_STEP = "goodput_storage_bytes_per_step"
 GOODPUT_INCREMENTAL_REUSE_RATIO = "goodput_incremental_reuse_ratio"
 
+# -- SLO engine & incident bundles (telemetry/slo.py, telemetry/bundle.py) ---
+#
+# Per-objective burn-rate gauges refreshed by the rank-0 per-step SLO
+# evaluation (labelled ``objective=<SLO_* id>``), the breach counter the
+# edge-triggered ledger posting bumps, and the black-box capture
+# counter. See docs/observability.md "SLOs & incident bundles".
+
+OBJECTIVE_BURN_RATE = "slo_burn_rate"
+OBJECTIVE_BREACHES_TOTAL = "slo_breaches_total"
+BUNDLE_CAPTURES_TOTAL = "bundle_captures_total"
+
 # ---------------------------------------------------------------------------
 # Flight-recorder span/instant names (telemetry/trace.py).
 #
@@ -420,6 +431,19 @@ RULE_CRITICAL_PATH_SHIFTED = "critical-path-shifted"
 # drift): the regression is in the code, not the noise. Emitted by the
 # diff engine / ``tools/bench_diff.py``, never from a live op.
 RULE_BENCH_REGRESSION = "bench-regression"
+# A declared SLO objective is burning its error budget: the fast window
+# caught a cliff or the slow window caught drift (telemetry/slo.py's
+# multi-window burn-rate math over the ledger/history samples). Cites
+# the per-window burn, bad-sample counts and any slo-breach ledger
+# events already posted for the objective.
+RULE_SLO_BURNING = "slo-burning"
+# A restore's cold-start split (event-loop spin-up + plugin open +
+# native-module load, recorded since PR 15) dominates the op wall
+# beyond the knob'd fraction budget
+# (TORCHSNAPSHOT_TPU_COLD_START_BUDGET_FRACTION): the r06 "first-trial
+# restores 10-28 s vs sub-1 s warm" soft spot, ranked. Cites the
+# ``{event_loop_s, plugin_open_s, native_load_s}`` breakdown.
+RULE_RESTORE_COLD_START_SLOW = "restore-cold-start-slow"
 
 # ---------------------------------------------------------------------------
 # Run-ledger event ids (telemetry/ledger.py).
@@ -471,6 +495,12 @@ EVENT_CDN_PUBLISHED = "cdn-published"
 # carries the subscriber id, step, publish-to-swap staleness and the
 # bytes-on-wire split (durable read vs. peer pull vs. already held).
 EVENT_CDN_SWAPPED = "cdn-swapped"
+# The rank-0 SLO evaluation saw an objective transition into breach
+# (edge-triggered: one record per episode, not per evaluated step):
+# carries the objective id, the target, both window burns and the
+# offending last sample. The ``slo-burning`` doctor rule and the
+# incident-bundle trigger both key off these records.
+EVENT_SLO_BREACH = "slo-breach"
 
 # ---------------------------------------------------------------------------
 # Crash-point ids (chaos/crashpoints.py).
@@ -570,3 +600,36 @@ RPC_PEER_PING = "peer-ping"
 RPC_FANOUT_EXCHANGE = "fanout-exchange"
 RPC_CDN_SYNC = "cdn-sync"
 RPC_CDN_PUBLISH = "cdn-publish"
+
+# ---------------------------------------------------------------------------
+# SLO objective ids (telemetry/slo.py).
+#
+# Same single-registration rule as the families above, kebab-case
+# ("what-is-promised"). ``SLO_``-prefixed constants name the declared
+# service-level objectives the rank-0 per-step evaluation judges with
+# multi-window burn-rate math; the id labels the ``slo_burn_rate``
+# gauge, keys the per-objective target/disable knobs, and travels in
+# ``slo-breach`` ledger events. snaplint's ``slo-ids`` rule lints both
+# halves: declared exactly once here, kebab-case values, no literal ids
+# at ``Objective(...)`` declaration sites.
+# ---------------------------------------------------------------------------
+
+# Visible training stall per take/async_take stays under the async
+# visible budget (TORCHSNAPSHOT_TPU_ASYNC_VISIBLE_BUDGET_SECONDS).
+SLO_TAKE_VISIBLE_STALL = "take-visible-stall"
+# A restore/async_restore serves within the restore wall budget
+# (TORCHSNAPSHOT_TPU_SLO_RESTORE_SECONDS).
+SLO_RESTORE_WALL = "restore-wall"
+# A step's bytes exist only on the fast tier no longer than the mirror
+# durability-lag budget (TORCHSNAPSHOT_TPU_SLO_MIRROR_LAG_SECONDS).
+SLO_MIRROR_LAG = "mirror-durability-lag"
+# CDN publish-to-swap staleness per subscriber swap stays under the
+# staleness budget (TORCHSNAPSHOT_TPU_CDN_STALENESS_BUDGET_SECONDS).
+SLO_CDN_STALENESS = "cdn-staleness"
+# Checkpoint overhead (visible stall + restore) per commit interval
+# stays under the overhead fraction budget
+# (TORCHSNAPSHOT_TPU_SLO_OVERHEAD_FRACTION).
+SLO_GOODPUT_OVERHEAD = "goodput-overhead"
+# Coordination's share of a take's wall stays under the coordination
+# fraction budget (TORCHSNAPSHOT_TPU_SLO_COORDINATION_FRACTION).
+SLO_COORDINATION_FRACTION = "coordination-fraction"

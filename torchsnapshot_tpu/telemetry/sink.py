@@ -155,6 +155,24 @@ def emit_report(
             logger.warning(
                 "telemetry: could not write prometheus file %r: %r", prom, e
             )
+    if report.error and report.rank == 0:
+        # A failed op is a black-box trigger: freeze the evidence now,
+        # while the failure's traces/heartbeats/ledger tail still
+        # exist. Rate-limited + size-capped inside capture_bundle;
+        # best-effort like every other sink write here.
+        try:
+            from .bundle import capture_bundle
+
+            capture_bundle(
+                report.path,
+                trigger="failed-op",
+                reason=f"{report.kind}: {report.error}"[:200],
+                snapshot_path=report.path,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "telemetry: failed-op bundle capture failed: %r", e
+            )
     return path
 
 

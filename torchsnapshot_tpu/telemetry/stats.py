@@ -19,7 +19,15 @@ CLI (also ``python -m torchsnapshot_tpu.telemetry`` and
                                           # (telemetry/goodput.py)
     snapshot-stats diff <before> <after>  # critical-path / bench-record
                                           # differential comparison
-                                          # (telemetry/critpath.py)
+                                          # (telemetry/critpath.py;
+                                          # operands may be incident
+                                          # bundle dirs)
+    snapshot-stats slo <root>             # judge the declared SLOs with
+                                          # burn-rate math
+                                          # (telemetry/slo.py)
+    snapshot-stats bundle <root>          # list / capture incident
+                                          # black-box bundles
+                                          # (telemetry/bundle.py)
 
 Output: one row per (path, kind, rank) record — phase durations,
 bytes, throughput, budget wait, retries — followed by a per-tier
@@ -223,6 +231,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .wire import fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "slo":
+        # ``python -m torchsnapshot_tpu.telemetry slo <root>``: judge
+        # the declared SLOs over a root's (or bundle's) run ledger +
+        # step history with burn-rate math (telemetry/slo.py).
+        from .slo import main as slo_main
+
+        return slo_main(argv[1:])
+    if argv and argv[0] == "bundle":
+        # ``python -m torchsnapshot_tpu.telemetry bundle <root>``: list
+        # (or --capture) incident black-box bundles
+        # (telemetry/bundle.py).
+        from .bundle import main as bundle_main
+
+        return bundle_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="snapshot-stats",

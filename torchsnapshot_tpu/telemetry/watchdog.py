@@ -191,6 +191,30 @@ class StallWatchdog:
         critical_prefix = [
             f"{s['name']}@{s['age_s']}s" for s in track[:16]
         ]
+        # One black box per stall episode (``_in_stall`` above IS the
+        # episode edge): freeze the evidence while the stall is live,
+        # before progress resumes and overwrites it. The watchdog has
+        # no root of its own — it captures for the first root this
+        # process opened a run ledger at; best-effort + rate-limited.
+        bundle_path = ""
+        try:
+            from . import bundle as bundle_mod
+
+            capture_root = bundle_mod.default_capture_root()
+            if capture_root is not None:
+                bundle_path = (
+                    bundle_mod.capture_bundle(
+                        capture_root,
+                        trigger="watchdog-stall",
+                        reason=(
+                            f"span {culprit['name']} open "
+                            f"{culprit['age_s']}s"
+                        ),
+                    )
+                    or ""
+                )
+        except Exception as e:  # noqa: BLE001 - capture must not kill the scan
+            logger.warning("watchdog: bundle capture failed: %r", e)
         # count_as_progress=False: the stall marker itself must not
         # reset the idle clock and make the stall look resolved.
         self._recorder.instant(
@@ -207,6 +231,7 @@ class StallWatchdog:
                 f"{s['name']}@{s['age_s']}s" for s in open_spans[:16]
             ],
             progress=progress_rows,
+            bundle=bundle_path,
         )
         from . import metrics
 
@@ -214,7 +239,7 @@ class StallWatchdog:
         logger.error(
             "watchdog: span %r open for %.1fs with no recorder activity "
             "for %.1fs (deadline %.1fs); gating segment %s, critical "
-            "path %s; open-span tree:\n%s\n"
+            "path %s; incident bundle %s; open-span tree:\n%s\n"
             "op progress:\n%s\nthread stacks:\n%s",
             culprit["name"],
             culprit["age_s"],
@@ -222,6 +247,7 @@ class StallWatchdog:
             deadline_s,
             segment_for(culprit["name"]),
             " -> ".join(critical_prefix) or "(none)",
+            bundle_path or "(not captured)",
             tree,
             "\n".join(f"  {row}" for row in progress_rows) or "  (none)",
             _thread_stacks(),

@@ -615,6 +615,7 @@ def render_fleet_table(entries: Sequence[Mapping[str, Any]]) -> str:
         "FRAMES",
         "WIRE_MB",
         "DIAL_P95_S",
+        "BURN",
         "NOTE",
     )
     rows: List[Tuple[str, ...]] = [header]
@@ -624,11 +625,17 @@ def render_fleet_table(entries: Sequence[Mapping[str, Any]]) -> str:
         frames = sum(float(ep.get("frames", 0)) for ep in eps.values())
         mb = sum(float(ep.get("bytes", 0)) for ep in eps.values()) / 1024**2
         age = float(e.get("age_s", 0.0))
+        # The publisher's SLO burn rate rides the plane as an extra
+        # (telemetry/slo.py): >= 1.0 means that member is spending its
+        # error budget faster than sustainable.
+        burn = (e.get("extra") or {}).get("slo_burn")
         notes = []
         if max_seq - int(e.get("seq", 0)) >= 2:
             notes.append("straggler")
         if len(entries) >= 3 and median_age > 0 and age > 3 * median_age:
             notes.append("stale")
+        if isinstance(burn, (int, float)) and float(burn) >= 1.0:
+            notes.append("burning")
         rows.append(
             (
                 str(e.get("role", "?")),
@@ -640,6 +647,9 @@ def render_fleet_table(entries: Sequence[Mapping[str, Any]]) -> str:
                 f"{frames:.0f}",
                 f"{mb:.2f}",
                 f"{float(wire.get('dial_p95_s', 0.0)):.3f}",
+                f"{float(burn):.2f}"
+                if isinstance(burn, (int, float))
+                else "-",
                 ",".join(notes) or "-",
             )
         )
